@@ -1,0 +1,14 @@
+"""kubernetriks-tpu: a TPU-native, batched re-implementation of the Kubernetriks
+Kubernetes-cluster simulator (reference: jellythefish/kubernetriks).
+
+Two execution paths share one semantic model:
+
+- ``kubernetriks_tpu.sim`` + ``kubernetriks_tpu.core``: a scalar, single-cluster,
+  deterministic discrete-event path that preserves the reference's exact
+  event-ordering semantics (reference: src/simulator.rs, src/core/*).
+- ``kubernetriks_tpu.batched``: a vectorized JAX path where cluster state lives in
+  dense arrays of shape (clusters, nodes, ...) / (clusters, pods, ...) and thousands
+  of simulated clusters step in lockstep on a TPU mesh.
+"""
+
+__version__ = "0.1.0"

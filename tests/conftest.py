@@ -1,0 +1,16 @@
+"""Force a deterministic 8-device virtual CPU platform for all tests.
+
+Multi-chip sharding tests run against a virtual CPU mesh
+(xla_force_host_platform_device_count) since only one real TPU chip is
+available in dev; the driver validates real multi-chip paths separately via
+__graft_entry__.dryrun_multichip.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,113 @@
+"""Config parsing incl. serde-style YAML tags (reference: src/config.yaml)."""
+
+from kubernetriks_tpu.config import SimulationConfig, load_yaml_with_tags
+
+FULL_CONFIG = """
+sim_name: "kubernetriks"
+seed: 123
+
+metrics_printer:
+  format: !PrettyTable
+  output_file: /tmp/metrics.txt
+
+horizontal_pod_autoscaler:
+  enabled: false
+  autoscaler_type: kube_horizontal_pod_autoscaler
+
+cluster_autoscaler:
+  enabled: true
+  autoscaler_type: kube_cluster_autoscaler
+  max_node_count: 200
+  node_groups:
+  - max_count: 50
+    node_template:
+      metadata:
+        name: autoscaler_128cpu_256gb_node
+      status:
+        capacity:
+          cpu: 128000
+          ram: 274877906944
+  - node_template:
+      metadata:
+        name: autoscaler_64cpu_128gb_node
+      status:
+        capacity:
+          cpu: 64000
+          ram: 137438953472
+
+trace_config:
+  generic_trace:
+    workload_trace_path: workload.yaml
+    cluster_trace_path: cluster.yaml
+
+default_cluster:
+- node_count: 10
+  node_template:
+    metadata:
+      name: default_128cpu_256gb_node
+    status:
+      capacity:
+        cpu: 128000
+        ram: 274877906944
+
+scheduling_cycle_interval: 10.0
+enable_unscheduled_pods_conditional_move: false
+
+as_to_ps_network_delay: 0.050
+ps_to_sched_network_delay: 0.089
+sched_to_as_network_delay: 0.023
+as_to_node_network_delay: 0.152
+as_to_ca_network_delay: 0.67
+as_to_hpa_network_delay: 0.50
+"""
+
+
+def test_full_config_parse():
+    config = SimulationConfig.from_yaml(FULL_CONFIG)
+    assert config.sim_name == "kubernetriks"
+    assert config.seed == 123
+    assert config.metrics_printer.format == "PrettyTable"
+    assert config.cluster_autoscaler.enabled
+    assert config.cluster_autoscaler.max_node_count == 200
+    assert len(config.cluster_autoscaler.node_groups) == 2
+    assert config.cluster_autoscaler.node_groups[0].max_count == 50
+    assert config.cluster_autoscaler.node_groups[1].max_count is None
+    template = config.cluster_autoscaler.node_groups[0].node_template
+    assert template.metadata.name == "autoscaler_128cpu_256gb_node"
+    assert template.status.capacity.cpu == 128000
+    assert template.status.capacity.ram == 274877906944
+    assert config.trace_config.generic_trace.workload_trace_path == "workload.yaml"
+    assert config.trace_config.alibaba_cluster_trace_v2017 is None
+    assert config.default_cluster[0].node_count == 10
+    assert config.scheduling_cycle_interval == 10.0
+    assert config.as_to_ps_network_delay == 0.050
+    assert config.as_to_hpa_network_delay == 0.50
+
+
+def test_defaults():
+    config = SimulationConfig.from_yaml("sim_name: x\nseed: 1\nscheduling_cycle_interval: 5.0")
+    assert not config.cluster_autoscaler.enabled
+    assert config.cluster_autoscaler.scan_interval == 10.0
+    assert config.cluster_autoscaler.autoscaler_type == "kube_cluster_autoscaler"
+    assert not config.horizontal_pod_autoscaler.enabled
+    assert config.horizontal_pod_autoscaler.scan_interval == 60.0
+    assert config.metrics_printer is None
+    assert config.default_cluster is None
+    assert config.as_to_ps_network_delay == 0.0
+
+
+def test_tagged_yaml_loader():
+    doc = load_yaml_with_tags(
+        """
+events:
+- timestamp: 550
+  event_type:
+    !CreatePod
+      pod:
+        metadata:
+          name: pod_16
+"""
+    )
+    event = doc["events"][0]["event_type"]
+    assert event["__tag__"] == "CreatePod"
+    assert event["pod"]["metadata"]["name"] == "pod_16"

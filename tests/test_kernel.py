@@ -1,0 +1,107 @@
+"""Kernel semantics: event ordering, FIFO tie-break, cancellation, determinism.
+
+Mirrors the behaviors the reference inherits from dslab-core (reference:
+src/simulator.rs:74-186 usage; tests/test_cast_box.rs event shape).
+"""
+
+from dataclasses import dataclass
+
+from kubernetriks_tpu.sim.kernel import EventHandler, Simulation
+
+
+@dataclass
+class Ping:
+    tag: str
+
+
+class Recorder(EventHandler):
+    def __init__(self):
+        self.seen = []
+
+    def on_ping(self, data: Ping, time: float) -> None:
+        self.seen.append((time, data.tag))
+
+
+def test_time_ordering_and_fifo_tiebreak():
+    sim = Simulation(seed=1)
+    rec = Recorder()
+    dst = sim.add_handler("rec", rec)
+    ctx = sim.create_context("src")
+
+    ctx.emit(Ping("late"), dst, 5.0)
+    ctx.emit(Ping("first_at_2"), dst, 2.0)
+    ctx.emit(Ping("second_at_2"), dst, 2.0)
+    ctx.emit(Ping("early"), dst, 1.0)
+
+    sim.step_until_no_events()
+    assert rec.seen == [
+        (1.0, "early"),
+        (2.0, "first_at_2"),
+        (2.0, "second_at_2"),
+        (5.0, "late"),
+    ]
+    assert sim.time() == 5.0
+    assert sim.event_count() == 4
+
+
+def test_cancellation():
+    sim = Simulation(seed=1)
+    rec = Recorder()
+    dst = sim.add_handler("rec", rec)
+    ctx = sim.create_context("src")
+
+    keep = ctx.emit(Ping("keep"), dst, 1.0)
+    drop = ctx.emit(Ping("drop"), dst, 2.0)
+    ctx.cancel_event(drop)
+    sim.step_until_no_events()
+    assert [tag for _, tag in rec.seen] == ["keep"]
+    assert keep != drop
+
+
+def test_step_until_time_advances_clock_without_events():
+    sim = Simulation(seed=1)
+    rec = Recorder()
+    dst = sim.add_handler("rec", rec)
+    ctx = sim.create_context("src")
+    ctx.emit(Ping("a"), dst, 3.0)
+    sim.step_until_time(2.0)
+    assert sim.time() == 2.0
+    assert rec.seen == []
+    sim.step_until_time(10.0)
+    assert rec.seen == [(3.0, "a")]
+    assert sim.time() == 10.0
+
+
+def test_rng_determinism():
+    draws = []
+    for _ in range(2):
+        sim = Simulation(seed=46)
+        ctx = sim.create_context("c")
+        draws.append(
+            [ctx.gen_range_float(0.0, 1.0) for _ in range(100)]
+            + [float(ctx.gen_range_int(0, 1000)) for _ in range(100)]
+        )
+    assert draws[0] == draws[1]
+
+
+def test_handler_self_events():
+    class SelfTicker(EventHandler):
+        def __init__(self, sim):
+            self.ctx = sim.create_context("ticker")
+            sim.add_handler("ticker", self)
+            self.ticks = 0
+
+        def start(self):
+            self.ctx.emit_self(Ping("tick"), 1.0)
+
+        def on_ping(self, data: Ping, time: float) -> None:
+            self.ticks += 1
+            if self.ticks < 5:
+                self.ctx.emit_self(Ping("tick"), 1.0)
+
+    sim = Simulation(seed=0)
+    ticker = SelfTicker(sim)
+    ticker.start()
+    sim.step_until_no_events()
+    assert ticker.ticks == 5
+    assert sim.time() == 5.0

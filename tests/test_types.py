@@ -1,0 +1,82 @@
+"""Object-model semantics (reference: src/core/node.rs, src/core/pod.rs)."""
+
+from kubernetriks_tpu.core.types import (
+    Node,
+    NodeConditionType,
+    Pod,
+    PodConditionType,
+    RuntimeResources,
+)
+
+
+def test_node_new_sets_allocatable_to_capacity():
+    node = Node.new("n1", 16000, 32 * 1024**3)
+    assert node.status.allocatable == node.status.capacity
+    assert node.status.allocatable is not node.status.capacity
+
+
+def test_condition_upsert():
+    node = Node.new("n1", 1000, 1000)
+    node.update_condition("True", NodeConditionType.NODE_CREATED, 1.0)
+    node.update_condition("True", NodeConditionType.NODE_READY, 2.0)
+    node.update_condition("False", NodeConditionType.NODE_CREATED, 3.0)
+    assert len(node.status.conditions) == 2
+    created = node.get_condition(NodeConditionType.NODE_CREATED)
+    assert created.status == "False" and created.last_transition_time == 3.0
+
+
+def test_pod_conditions_and_duration():
+    pod = Pod.new("p1", 4000, 8 * 1024**3, 21.0)
+    assert pod.spec.running_duration == 21.0
+    service = Pod.new("svc", 100, 100, None)
+    assert service.spec.running_duration is None
+    pod.update_condition("True", PodConditionType.POD_CREATED, 0.5)
+    assert pod.get_condition(PodConditionType.POD_CREATED).status == "True"
+    assert pod.get_condition(PodConditionType.POD_RUNNING) is None
+
+
+def test_runtime_resources_arithmetic():
+    a = RuntimeResources(4000, 100)
+    b = RuntimeResources(1000, 40)
+    assert (a - b) == RuntimeResources(3000, 60)
+    assert (a + b) == RuntimeResources(5000, 140)
+    assert a.fits(b)
+    assert not b.fits(a)
+    assert RuntimeResources(0, 0).is_zero()
+
+
+def test_node_from_dict_defaults_allocatable_to_capacity():
+    node = Node.from_dict(
+        {"metadata": {"name": "n"}, "status": {"capacity": {"cpu": 64000, "ram": 1000}}}
+    )
+    assert node.status.allocatable == RuntimeResources(64000, 1000)
+    assert node.status.allocatable is not node.status.capacity
+
+
+def test_pod_from_dict_yaml_shape():
+    pod = Pod.from_dict(
+        {
+            "metadata": {"name": "pod_16", "labels": {"scheduler_name": "custom"}},
+            "spec": {
+                "resources": {
+                    "requests": {"cpu": 4000, "ram": 8589934592},
+                    "limits": {"cpu": 8000, "ram": 17179869184},
+                },
+                "running_duration": 21.0,
+            },
+        }
+    )
+    assert pod.metadata.name == "pod_16"
+    assert pod.metadata.labels["scheduler_name"] == "custom"
+    assert pod.spec.resources.requests.cpu == 4000
+    assert pod.spec.resources.limits.ram == 17179869184
+    assert pod.spec.running_duration == 21.0
+
+
+def test_copy_is_deep():
+    node = Node.new("n", 100, 100)
+    clone = node.copy()
+    clone.status.allocatable.cpu = 1
+    clone.metadata.labels["x"] = "y"
+    assert node.status.allocatable.cpu == 100
+    assert "x" not in node.metadata.labels
