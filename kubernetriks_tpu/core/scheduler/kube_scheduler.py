@@ -1,0 +1,107 @@
+"""Default profile-based filter->score scheduling algorithm
+(reference: src/core/scheduler/kube_scheduler.rs)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from kubernetriks_tpu.core.scheduler.interface import (
+    PodSchedulingAlgorithm,
+    ScheduleError,
+    SchedulingFailure,
+)
+from kubernetriks_tpu.core.scheduler.plugins import (
+    FilterPlugin,
+    PLUGIN_REGISTRY,
+    ScorePlugin,
+)
+from kubernetriks_tpu.core.types import Node, Pod
+
+DEFAULT_SCHEDULER_NAME = "default_scheduler"
+
+
+@dataclass
+class Plugin:
+    name: str
+    weight: Optional[float] = None
+
+
+@dataclass
+class Plugins:
+    filter: List[Plugin] = field(default_factory=list)
+    score: List[Plugin] = field(default_factory=list)
+
+
+@dataclass
+class KubeSchedulerProfile:
+    scheduler_name: str
+    plugins: Plugins
+
+
+@dataclass
+class KubeSchedulerConfig:
+    profiles: Dict[str, KubeSchedulerProfile] = field(default_factory=dict)
+
+
+def default_kube_scheduler_config() -> KubeSchedulerConfig:
+    """Fit filter + LeastAllocatedResources score at weight 1.0
+    (reference: src/core/scheduler/kube_scheduler.rs:44-61)."""
+    profile = KubeSchedulerProfile(
+        scheduler_name=DEFAULT_SCHEDULER_NAME,
+        plugins=Plugins(
+            filter=[Plugin(name="Fit")],
+            score=[Plugin(name="LeastAllocatedResources", weight=1.0)],
+        ),
+    )
+    return KubeSchedulerConfig(profiles={DEFAULT_SCHEDULER_NAME: profile})
+
+
+class KubeScheduler(PodSchedulingAlgorithm):
+    def __init__(self, config: Optional[KubeSchedulerConfig] = None) -> None:
+        self.config = config or default_kube_scheduler_config()
+
+    def schedule_one(self, pod: Pod, nodes: Dict[str, Node]) -> str:
+        """Filter then weighted-score over name-sorted nodes; argmax keeps the
+        reference's `>=` tie-break: among equal max scores the last node in
+        sorted-name order wins (reference: src/core/scheduler/kube_scheduler.rs:63-152)."""
+        requests = pod.spec.resources.requests
+        if requests.cpu == 0 and requests.ram == 0:
+            raise SchedulingFailure(ScheduleError.REQUESTED_RESOURCES_ARE_ZEROS)
+        if not nodes:
+            raise SchedulingFailure(ScheduleError.NO_NODES_IN_CLUSTER)
+
+        scheduler_name = pod.metadata.labels.get("scheduler_name", DEFAULT_SCHEDULER_NAME)
+        profile = self.config.profiles[scheduler_name]
+
+        filtered_nodes = [nodes[name] for name in sorted(nodes)]
+        for filter_ref in profile.plugins.filter:
+            plugin = PLUGIN_REGISTRY[filter_ref.name]
+            assert isinstance(plugin, FilterPlugin), (
+                f"{filter_ref.name!r} plugin is not a FilterPlugin"
+            )
+            filtered_nodes = plugin.filter(pod, filtered_nodes)
+
+        if not filtered_nodes:
+            raise SchedulingFailure(ScheduleError.NO_SUFFICIENT_RESOURCES)
+
+        node_scores: Dict[str, float] = {
+            node.metadata.name: 0.0 for node in filtered_nodes
+        }
+        for scorer_ref in profile.plugins.score:
+            plugin = PLUGIN_REGISTRY[scorer_ref.name]
+            assert isinstance(plugin, ScorePlugin), (
+                f"{scorer_ref.name!r} plugin is not a ScorePlugin"
+            )
+            for node in filtered_nodes:
+                node_scores[node.metadata.name] += (
+                    plugin.score(pod, node) * scorer_ref.weight
+                )
+
+        assigned_node = filtered_nodes[0].metadata.name
+        max_score = node_scores[assigned_node]
+        for node_name in sorted(node_scores):
+            if node_scores[node_name] >= max_score:
+                assigned_node = node_name
+                max_score = node_scores[node_name]
+        return assigned_node

@@ -1,0 +1,56 @@
+"""Scheduler plugin registry with Fit and LeastAllocatedResources built-ins
+(reference: src/core/scheduler/plugin.rs)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Union
+
+from kubernetriks_tpu.core.types import Node, Pod
+
+
+class FilterPlugin:
+    def filter(self, pod: Pod, nodes: List[Node]) -> List[Node]:
+        raise NotImplementedError
+
+
+class ScorePlugin:
+    def score(self, pod: Pod, node: Node) -> float:
+        raise NotImplementedError
+
+
+class Fit(FilterPlugin):
+    """Keep nodes whose allocatable covers the pod's requests
+    (reference: src/core/scheduler/plugin.rs:33-45)."""
+
+    def filter(self, pod: Pod, nodes: List[Node]) -> List[Node]:
+        requests = pod.spec.resources.requests
+        return [
+            node
+            for node in nodes
+            if requests.cpu <= node.status.allocatable.cpu
+            and requests.ram <= node.status.allocatable.ram
+        ]
+
+
+class LeastAllocatedResources(ScorePlugin):
+    """Mean of the percentage of cpu+ram left after placement, relative to the
+    node's current allocatable (reference: src/core/scheduler/plugin.rs:47-63)."""
+
+    def score(self, pod: Pod, node: Node) -> float:
+        requests = pod.spec.resources.requests
+        allocatable = node.status.allocatable
+        cpu_score = (allocatable.cpu - requests.cpu) * 100.0 / allocatable.cpu
+        ram_score = (allocatable.ram - requests.ram) * 100.0 / allocatable.ram
+        return (cpu_score + ram_score) / 2.0
+
+
+PLUGIN_REGISTRY: Dict[str, Union[FilterPlugin, ScorePlugin]] = {
+    "Fit": Fit(),
+    "LeastAllocatedResources": LeastAllocatedResources(),
+}
+
+
+def register_plugin(name: str, plugin: Union[FilterPlugin, ScorePlugin]) -> None:
+    """Extension point for custom plugins (the reference's registry is a static
+    map; here plugins may be registered at runtime)."""
+    PLUGIN_REGISTRY[name] = plugin

@@ -1,0 +1,100 @@
+"""End-of-run metric dump as JSON or aligned text table
+(reference: src/metrics/printer.rs:20-164)."""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Any, Dict, Optional, TextIO
+
+from kubernetriks_tpu.config import MetricsPrinterConfig
+from kubernetriks_tpu.metrics.collector import MetricsCollector
+
+
+def metrics_as_dict(collector: MetricsCollector) -> Dict[str, Any]:
+    """The JSON schema mirrors the reference's MetricsJSON
+    (reference: src/metrics/printer.rs:83-109)."""
+    metrics = collector.accumulated_metrics
+    return {
+        "counters": {
+            "total_nodes_in_trace": metrics.total_nodes_in_trace,
+            "total_pods_in_trace": metrics.total_pods_in_trace,
+            "pods_succeeded": metrics.pods_succeeded,
+            "pods_unschedulable": metrics.pods_unschedulable,
+            "pods_failed": metrics.pods_failed,
+            "pods_removed": metrics.pods_removed,
+            "total_scaled_up_nodes": metrics.total_scaled_up_nodes,
+            "total_scaled_down_nodes": metrics.total_scaled_down_nodes,
+            "total_scaled_up_pods": metrics.total_scaled_up_pods,
+            "total_scaled_down_pods": metrics.total_scaled_down_pods,
+        },
+        "timings": {
+            "pod_duration": metrics.pod_duration_stats.as_dict(),
+            "pod_schedule_time": metrics.pod_scheduling_algorithm_latency_stats.as_dict(),
+            "pod_queue_time": metrics.pod_queue_time_stats.as_dict(),
+        },
+    }
+
+
+def _format_table(rows: list, header: list) -> str:
+    widths = [
+        max(len(str(row[i])) for row in [header] + rows) for i in range(len(header))
+    ]
+
+    def fmt_row(row):
+        return "| " + " | ".join(str(v).ljust(w) for v, w in zip(row, widths)) + " |"
+
+    sep = "+" + "+".join("-" * (w + 2) for w in widths) + "+"
+    lines = [sep, fmt_row(header), sep]
+    lines += [fmt_row(row) for row in rows]
+    lines.append(sep)
+    return "\n".join(lines)
+
+
+def metrics_as_pretty_table(collector: MetricsCollector) -> str:
+    d = metrics_as_dict(collector)
+    counter_rows = [
+        ["Total nodes in trace", d["counters"]["total_nodes_in_trace"]],
+        ["Total pods in trace", d["counters"]["total_pods_in_trace"]],
+        ["Pods succeeded", d["counters"]["pods_succeeded"]],
+        ["Pods unschedulable", d["counters"]["pods_unschedulable"]],
+        ["Pods failed", d["counters"]["pods_failed"]],
+        ["Pods removed", d["counters"]["pods_removed"]],
+        ["Total scaled up nodes", d["counters"]["total_scaled_up_nodes"]],
+        ["Total scaled down nodes", d["counters"]["total_scaled_down_nodes"]],
+        ["Total scaled up pods", d["counters"]["total_scaled_up_pods"]],
+        ["Total scaled down pods", d["counters"]["total_scaled_down_pods"]],
+    ]
+    timing_rows = [
+        [name, *(stats[k] for k in ("min", "max", "mean", "variance"))]
+        for name, stats in [
+            ("Pod duration", d["timings"]["pod_duration"]),
+            ("Pod schedule time", d["timings"]["pod_schedule_time"]),
+            ("Pod queue time", d["timings"]["pod_queue_time"]),
+        ]
+    ]
+    return (
+        _format_table(counter_rows, ["Metric", "Count"])
+        + "\n"
+        + _format_table(timing_rows, ["Metric", "Min", "Max", "Mean", "Variance"])
+    )
+
+
+def print_metrics(
+    collector: MetricsCollector,
+    config: Optional[MetricsPrinterConfig],
+    stream: Optional[TextIO] = None,
+) -> None:
+    """Write metrics per config; without a config (or output_file), write JSON
+    to ``stream`` (stdout by default)."""
+    fmt = config.format if config else "JSON"
+    if fmt == "PrettyTable":
+        text = metrics_as_pretty_table(collector)
+    else:
+        text = json.dumps(metrics_as_dict(collector), indent=2)
+
+    if config and config.output_file:
+        with open(config.output_file, "w") as f:
+            f.write(text)
+    else:
+        print(text, file=stream or sys.stdout)

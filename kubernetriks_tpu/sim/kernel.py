@@ -91,7 +91,7 @@ class SimulationContext:
     # that call order fully determines the stream (DSLab equivalent:
     # ctx.gen_range / ctx.random_string, used by tests and the trace generator).
     def rand(self) -> float:
-        return self._sim.rng.random()
+        return self._sim.rand()
 
     def gen_range_float(self, low: float, high: float) -> float:
         return self._sim.rng.uniform(low, high)
@@ -101,8 +101,7 @@ class SimulationContext:
         return self._sim.rng.randrange(low, high)
 
     def random_string(self, length: int) -> str:
-        alphabet = string.ascii_letters + string.digits
-        return "".join(self._sim.rng.choice(alphabet) for _ in range(length))
+        return self._sim.random_string(length)
 
 
 class Simulation:
@@ -123,6 +122,12 @@ class Simulation:
     # --- component registry -------------------------------------------------
 
     def create_context(self, name: str) -> SimulationContext:
+        """Get-or-create by name (DSLab semantics): a second create_context with
+        the same name returns a context with the same component id, so a
+        handler registered under that name receives its self-events."""
+        existing = self._contexts.get(name)
+        if existing is not None:
+            return existing
         comp_id = self._next_component_id
         self._next_component_id += 1
         ctx = SimulationContext(self, name, comp_id)
@@ -199,6 +204,14 @@ class Simulation:
 
     def time(self) -> float:
         return self._time
+
+    # Simulation-level RNG helpers (DSLab exposes the same on Simulation).
+    def rand(self) -> float:
+        return self.rng.random()
+
+    def random_string(self, length: int) -> str:
+        alphabet = string.ascii_letters + string.digits
+        return "".join(self.rng.choice(alphabet) for _ in range(length))
 
     def event_count(self) -> int:
         """Number of events processed so far."""

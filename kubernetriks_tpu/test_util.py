@@ -1,0 +1,52 @@
+"""Shared test fixtures and cross-component consistency asserts
+(reference: src/test_util/helpers.rs)."""
+
+from __future__ import annotations
+
+from kubernetriks_tpu.config import SimulationConfig
+from kubernetriks_tpu.core.types import Node
+from kubernetriks_tpu.sim.simulator import KubernetriksSimulation
+
+DEFAULT_TEST_CONFIG_YAML = """
+sim_name: "test_kubernetriks"
+seed: 123
+scheduling_cycle_interval: 10.0
+as_to_ps_network_delay: 0.050
+ps_to_sched_network_delay: 0.010
+sched_to_as_network_delay: 0.020
+as_to_node_network_delay: 0.150
+as_to_ca_network_delay: 0.30
+as_to_hpa_network_delay: 0.40
+"""
+
+
+def default_test_simulation_config(with_suffix: str = "") -> SimulationConfig:
+    """reference: src/test_util/helpers.rs:60-80."""
+    return SimulationConfig.from_yaml(DEFAULT_TEST_CONFIG_YAML + with_suffix)
+
+
+def check_expected_node_is_equal_to_nodes_in_components(
+    expected_node: Node, kube_sim: KubernetriksSimulation
+) -> None:
+    """State must agree in api server, storage and scheduler at once
+    (reference: src/test_util/helpers.rs:7-33)."""
+    name = expected_node.metadata.name
+    assert expected_node == kube_sim.api_server.get_node_component(name).get_node()
+    assert expected_node == kube_sim.persistent_storage.get_node(name)
+    assert expected_node == kube_sim.scheduler.get_node(name)
+
+
+def check_count_of_nodes_in_components_equals_to(
+    count: int, kube_sim: KubernetriksSimulation
+) -> None:
+    assert count == kube_sim.api_server.node_count()
+    assert count == kube_sim.persistent_storage.node_count()
+    assert count == kube_sim.scheduler.node_count()
+
+
+def check_expected_node_appeared_in_components(
+    node_name: str, kube_sim: KubernetriksSimulation
+) -> None:
+    assert kube_sim.api_server.get_node_component(node_name) is not None
+    assert kube_sim.persistent_storage.get_node(node_name) is not None
+    kube_sim.scheduler.get_node(node_name)

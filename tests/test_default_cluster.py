@@ -1,0 +1,140 @@
+"""Default-cluster node-group naming rules, checked across all three components
+(port of reference tests/test_default_cluster.rs)."""
+
+from kubernetriks_tpu.core.types import Node, NodeConditionType
+from kubernetriks_tpu.sim.simulator import KubernetriksSimulation
+from kubernetriks_tpu.test_util import (
+    check_count_of_nodes_in_components_equals_to,
+    check_expected_node_is_equal_to_nodes_in_components,
+    default_test_simulation_config,
+)
+
+
+def make_default_node(name: str, cpu: int, ram: int) -> Node:
+    node = Node.new(name, cpu, ram)
+    node.update_condition("True", NodeConditionType.NODE_CREATED, 0.0)
+    return node
+
+
+def test_config_default_cluster_is_none():
+    sim = KubernetriksSimulation(default_test_simulation_config())
+    sim.initialize_default_cluster()
+    check_count_of_nodes_in_components_equals_to(0, sim)
+
+
+def test_config_default_cluster_with_no_name_prefix():
+    config = default_test_simulation_config(
+        """
+default_cluster:
+- node_count: 10
+  node_template:
+      metadata:
+        labels:
+          storage_type: ssd
+          proc_type: intel
+      status:
+        capacity:
+          cpu: 18000
+          ram: 18589934592
+- node_count: 20
+  node_template:
+      status:
+        capacity:
+          cpu: 24000
+          ram: 18589934592
+"""
+    )
+    sim = KubernetriksSimulation(config)
+    sim.initialize_default_cluster()
+    check_count_of_nodes_in_components_equals_to(30, sim)
+
+    for idx in range(10):
+        expected = make_default_node(f"default_node_{idx}", 18000, 18589934592)
+        expected.metadata.labels = {"storage_type": "ssd", "proc_type": "intel"}
+        check_expected_node_is_equal_to_nodes_in_components(expected, sim)
+    for idx in range(10, 30):
+        expected = make_default_node(f"default_node_{idx}", 24000, 18589934592)
+        check_expected_node_is_equal_to_nodes_in_components(expected, sim)
+
+
+def test_config_default_cluster_with_name_prefix():
+    config = default_test_simulation_config(
+        """
+default_cluster:
+- node_count: 5
+  node_template:
+      metadata:
+        name: group_a
+      status:
+        capacity:
+          cpu: 18000
+          ram: 18589934592
+"""
+    )
+    sim = KubernetriksSimulation(config)
+    sim.initialize_default_cluster()
+    check_count_of_nodes_in_components_equals_to(5, sim)
+    for idx in range(5):
+        expected = make_default_node(f"group_a_{idx}", 18000, 18589934592)
+        check_expected_node_is_equal_to_nodes_in_components(expected, sim)
+
+
+def test_config_default_cluster_single_named_node():
+    config = default_test_simulation_config(
+        """
+default_cluster:
+- node_template:
+      metadata:
+        name: super_node
+      status:
+        capacity:
+          cpu: 1024000
+          ram: 549755813888
+- node_count: 1
+  node_template:
+      metadata:
+        name: another_single
+      status:
+        capacity:
+          cpu: 2000
+          ram: 4294967296
+"""
+    )
+    sim = KubernetriksSimulation(config)
+    sim.initialize_default_cluster()
+    check_count_of_nodes_in_components_equals_to(2, sim)
+    check_expected_node_is_equal_to_nodes_in_components(
+        make_default_node("super_node", 1024000, 549755813888), sim
+    )
+    check_expected_node_is_equal_to_nodes_in_components(
+        make_default_node("another_single", 2000, 4294967296), sim
+    )
+
+
+def test_mixed_groups_share_global_index():
+    """Unnamed/named multi-node groups share one running node index
+    (reference: simulator.rs:322-343 `total_nodes` spans groups)."""
+    config = default_test_simulation_config(
+        """
+default_cluster:
+- node_count: 2
+  node_template:
+      metadata:
+        name: prefix_a
+      status:
+        capacity:
+          cpu: 1000
+          ram: 1000
+- node_count: 2
+  node_template:
+      status:
+        capacity:
+          cpu: 2000
+          ram: 2000
+"""
+    )
+    sim = KubernetriksSimulation(config)
+    sim.initialize_default_cluster()
+    check_count_of_nodes_in_components_equals_to(4, sim)
+    for name in ["prefix_a_0", "prefix_a_1", "default_node_2", "default_node_3"]:
+        assert sim.persistent_storage.get_node(name) is not None

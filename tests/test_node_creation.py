@@ -1,0 +1,53 @@
+"""Trace node + default node creation (port of reference tests/test_node_creation.rs)."""
+
+from kubernetriks_tpu.sim.simulator import KubernetriksSimulation
+from kubernetriks_tpu.test_util import (
+    check_count_of_nodes_in_components_equals_to,
+    check_expected_node_appeared_in_components,
+    default_test_simulation_config,
+)
+from kubernetriks_tpu.trace.generic import GenericClusterTrace, GenericWorkloadTrace
+
+CLUSTER_TRACE = """
+events:
+- timestamp: 100
+  event_type:
+    !CreateNode
+      node:
+        metadata:
+          name: trace_node
+        status:
+          capacity:
+            cpu: 2000
+            ram: 4294967296
+"""
+
+
+def test_node_creation_from_trace_and_default_cluster():
+    config = default_test_simulation_config(
+        """
+default_cluster:
+- node_template:
+      metadata:
+        name: default_super_node
+      status:
+        capacity:
+          cpu: 64000
+          ram: 137438953472
+"""
+    )
+    sim = KubernetriksSimulation(config)
+    sim.initialize(
+        GenericClusterTrace.from_yaml(CLUSTER_TRACE),
+        GenericWorkloadTrace.from_yaml(""),
+    )
+    # Default node exists immediately; the trace node appears only after its
+    # timestamp + control-plane round trips.
+    check_count_of_nodes_in_components_equals_to(1, sim)
+    check_expected_node_appeared_in_components("default_super_node", sim)
+
+    sim.step_for_duration(1000.0)
+    check_count_of_nodes_in_components_equals_to(2, sim)
+    check_expected_node_appeared_in_components("trace_node", sim)
+    assert sim.metrics_collector.accumulated_metrics.total_nodes_in_trace == 1
+    assert sim.metrics_collector.accumulated_metrics.internal.processed_nodes == 1

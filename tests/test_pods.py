@@ -1,0 +1,262 @@
+"""End-to-end pod lifecycle scenarios (port of reference tests/test_pods.rs)."""
+
+import pytest
+
+from kubernetriks_tpu.core.types import PodConditionType
+from kubernetriks_tpu.sim.callbacks import RunUntilAllPodsAreFinishedCallbacks
+from kubernetriks_tpu.sim.simulator import KubernetriksSimulation
+from kubernetriks_tpu.test_util import default_test_simulation_config
+from kubernetriks_tpu.trace.generic import GenericClusterTrace, GenericWorkloadTrace
+
+CLUSTER_TRACE = """
+events:
+- timestamp: 30
+  event_type:
+    !CreateNode
+      node:
+        metadata:
+          name: trace_node_42
+        status:
+          capacity:
+            cpu: 2000
+            ram: 4294967296
+"""
+
+
+def make_pod_event(name: str, cpu: int, ram: int, duration, ts: float) -> str:
+    duration_line = f"running_duration: {duration}" if duration is not None else ""
+    return f"""
+- timestamp: {ts}
+  event_type:
+    !CreatePod
+      pod:
+        metadata:
+          name: {name}
+        spec:
+          resources:
+            requests:
+              cpu: {cpu}
+              ram: {ram}
+            limits:
+              cpu: {cpu}
+              ram: {ram}
+          {duration_line}
+"""
+
+
+def run_sim(cluster_yaml: str, workload_yaml: str, config_suffix: str = ""):
+    sim = KubernetriksSimulation(default_test_simulation_config(config_suffix))
+    sim.initialize(
+        GenericClusterTrace.from_yaml(cluster_yaml),
+        GenericWorkloadTrace.from_yaml(workload_yaml),
+    )
+    return sim
+
+
+def test_pod_arrived_before_a_node():
+    """reference: tests/test_pods.rs:75-116."""
+    workload = "events:" + make_pod_event("pod_16", 2000, 4294967296, 100.0, 5)
+    sim = run_sim(CLUSTER_TRACE, workload)
+    sim.run_with_callbacks(RunUntilAllPodsAreFinishedCallbacks())
+
+    pod = sim.persistent_storage.succeeded_pods["pod_16"]
+    running = pod.get_condition(PodConditionType.POD_RUNNING)
+    assert running.last_transition_time > 30.0
+    assert pod.get_condition(PodConditionType.POD_SUCCEEDED) is not None
+
+
+def test_many_pods_running_one_at_a_time_at_slow_node():
+    """Node fits one pod at a time; pods serialize
+    (reference: tests/test_pods.rs:119-215)."""
+    workload = "events:" + "".join(
+        make_pod_event(f"pod_{i}", 2000, 4294967296, 50.0, 10 + i) for i in range(3)
+    )
+    sim = run_sim(CLUSTER_TRACE, workload)
+    sim.run_with_callbacks(RunUntilAllPodsAreFinishedCallbacks())
+
+    finish_times = []
+    for i in range(3):
+        pod = sim.persistent_storage.succeeded_pods[f"pod_{i}"]
+        succeeded = pod.get_condition(PodConditionType.POD_SUCCEEDED)
+        assert succeeded is not None
+        finish_times.append(succeeded.last_transition_time)
+    finish_times.sort()
+    # Each run takes 50s on a node that fits exactly one pod: finishes are
+    # spaced at least ~50s apart.
+    assert finish_times[1] - finish_times[0] >= 50.0
+    assert finish_times[2] - finish_times[1] >= 50.0
+    assert sim.metrics_collector.accumulated_metrics.pods_succeeded == 3
+
+
+def test_pods_run_in_parallel_when_fitting():
+    """Three pods all fit the node simultaneously
+    (reference: tests/test_pods.rs:218-313)."""
+    workload = "events:" + "".join(
+        make_pod_event(f"pod_{i}", 600, 1000000, 50.0, 10) for i in range(3)
+    )
+    sim = run_sim(CLUSTER_TRACE, workload)
+    sim.run_with_callbacks(RunUntilAllPodsAreFinishedCallbacks())
+
+    finish_times = [
+        sim.persistent_storage.succeeded_pods[f"pod_{i}"]
+        .get_condition(PodConditionType.POD_SUCCEEDED)
+        .last_transition_time
+        for i in range(3)
+    ]
+    assert max(finish_times) - min(finish_times) < 50.0
+    assert sim.metrics_collector.accumulated_metrics.pods_succeeded == 3
+
+
+def test_node_remove_while_pods_were_running():
+    """Node removed mid-run, returns at t=1100; pods reschedule and succeed
+    (reference: tests/test_pods.rs:316-364)."""
+    cluster = (
+        CLUSTER_TRACE
+        + """
+- timestamp: 60
+  event_type:
+    !RemoveNode
+      node_name: trace_node_42
+- timestamp: 1100
+  event_type:
+    !CreateNode
+      node:
+        metadata:
+          name: trace_node_42
+        status:
+          capacity:
+            cpu: 2000
+            ram: 4294967296
+"""
+    )
+    workload = "events:" + make_pod_event(
+        "pod_0", 333, 4967296, 100.0, 41
+    ) + make_pod_event("pod_1", 333, 4967296, 100.0, 42)
+    sim = run_sim(cluster, workload)
+    sim.step_for_duration(1000.0)
+
+    metrics = sim.metrics_collector.accumulated_metrics
+    assert metrics.total_pods_in_trace == 2
+    assert metrics.pods_succeeded == 0
+
+    sim.step_for_duration(2000.0)
+    assert sim.metrics_collector.accumulated_metrics.pods_succeeded == 2
+
+
+def test_node_removed_at_the_same_time_as_assignment():
+    """Same-tick race: removal at t=50 coincides with the scheduling cycle;
+    the api server's pending-removal guard drops the assignment
+    (reference: tests/test_pods.rs:366-398)."""
+    cluster = (
+        CLUSTER_TRACE
+        + """
+- timestamp: 50
+  event_type:
+    !RemoveNode
+      node_name: trace_node_42
+"""
+    )
+    workload = "events:" + make_pod_event(
+        "pod_0", 333, 4967296, 100.0, 41
+    ) + make_pod_event("pod_1", 333, 4967296, 100.0, 42)
+    sim = run_sim(cluster, workload)
+    sim.step_for_duration(1000.0)
+
+    metrics = sim.metrics_collector.accumulated_metrics
+    assert metrics.total_pods_in_trace == 2
+    assert metrics.pods_succeeded == 0
+
+
+def test_pod_removal_before_scheduling():
+    """Remove while still queued (no node yet)
+    (reference: tests/test_pods.rs:401-449)."""
+    workload = (
+        "events:"
+        + make_pod_event("pod_1", 8000, 4294967296, 500.0, 10)
+        + """
+- timestamp: 50
+  event_type:
+    !RemovePod
+      pod_name: pod_1
+"""
+    )
+    # Node too small: pod never schedules, sits in unschedulable queue.
+    sim = run_sim(CLUSTER_TRACE, workload)
+    sim.step_for_duration(1000.0)
+    assert sim.persistent_storage.get_pod("pod_1") is None
+    assert sim.metrics_collector.accumulated_metrics.pods_removed == 0
+    # Not marked removed from a node since it never ran; it was dropped from
+    # queues. Unscheduled cache must not retain it.
+    assert "pod_1" not in sim.persistent_storage.unscheduled_pods_cache
+
+
+def test_pod_removal_while_running():
+    """Remove a running pod: node frees resources, metrics count removal
+    (reference: tests/test_pods.rs:401-510)."""
+    workload = (
+        "events:"
+        + make_pod_event("pod_1", 2000, 4294967296, 500.0, 10)
+        + """
+- timestamp: 100
+  event_type:
+    !RemovePod
+      pod_name: pod_1
+"""
+    )
+    sim = run_sim(CLUSTER_TRACE, workload)
+    sim.step_for_duration(2000.0)
+
+    assert sim.metrics_collector.accumulated_metrics.pods_removed == 1
+    assert "pod_1" not in sim.persistent_storage.succeeded_pods
+    node_component = sim.api_server.get_node_component("trace_node_42")
+    assert node_component.runtime.node.status.allocatable.cpu == 2000
+    assert not node_component.running_pods
+
+
+def test_pod_removal_after_finish():
+    """Remove request lands after the pod finished: removed=False path
+    (reference: tests/test_pods.rs:597-637)."""
+    workload = (
+        "events:" + make_pod_event("pod_1", 2000, 4294967296, 50.0, 10) + """
+- timestamp: 500
+  event_type:
+    !RemovePod
+      pod_name: pod_1
+"""
+    )
+    sim = run_sim(CLUSTER_TRACE, workload)
+    sim.step_for_duration(2000.0)
+
+    assert sim.metrics_collector.accumulated_metrics.pods_removed == 0
+    assert sim.metrics_collector.accumulated_metrics.pods_succeeded == 1
+    assert "pod_1" in sim.persistent_storage.succeeded_pods
+
+
+def test_node_removal_frees_space_for_unschedulable_pod():
+    """Big pod unschedulable while a small node is full; removing the blocker
+    node is irrelevant — port covers removal freeing space scenario
+    (reference: tests/test_pods.rs:513-594): a second bigger node joins later."""
+    cluster = (
+        CLUSTER_TRACE
+        + """
+- timestamp: 300
+  event_type:
+    !CreateNode
+      node:
+        metadata:
+          name: big_node
+        status:
+          capacity:
+            cpu: 16000
+            ram: 34359738368
+"""
+    )
+    workload = "events:" + make_pod_event("pod_big", 8000, 8589934592, 50.0, 10)
+    sim = run_sim(cluster, workload)
+    sim.run_with_callbacks(RunUntilAllPodsAreFinishedCallbacks())
+
+    pod = sim.persistent_storage.succeeded_pods["pod_big"]
+    scheduled = pod.get_condition(PodConditionType.POD_SCHEDULED)
+    assert scheduled.status == "True"
+    assert pod.status.assigned_node == "big_node"
+    assert scheduled.last_transition_time > 300.0
