@@ -17,6 +17,11 @@ from kubernetriks_tpu.core.types import (
     RuntimeResourcesUsageModelConfig,
 )
 
+# Label value marking nodes created by the cluster autoscaler; shared by the
+# CA (labeling), storage (scale-down info filter) and scale-down matching
+# (reference: src/autoscalers/cluster_autoscaler/kube_cluster_autoscaler.rs:13).
+CLUSTER_AUTOSCALER_ORIGIN_LABEL = "cluster autoscaler"
+
 
 # --- cluster autoscaler -----------------------------------------------------
 

@@ -8,6 +8,7 @@ from __future__ import annotations
 from typing import Dict, List, Optional
 
 from kubernetriks_tpu.autoscalers.interface import (
+    CLUSTER_AUTOSCALER_ORIGIN_LABEL,
     AutoscaleInfo,
     AutoscaleInfoRequestType,
     CaNodeGroup,
@@ -19,10 +20,6 @@ from kubernetriks_tpu.autoscalers.interface import (
 )
 from kubernetriks_tpu.config import KubeClusterAutoscalerConfig
 from kubernetriks_tpu.core.types import Node, Pod
-
-# Label marking nodes created by the cluster autoscaler
-# (reference: src/autoscalers/cluster_autoscaler/kube_cluster_autoscaler.rs:13).
-CLUSTER_AUTOSCALER_ORIGIN_LABEL = "cluster autoscaler"
 
 
 def _node_fits_pod(pod: Pod, node: Node) -> bool:

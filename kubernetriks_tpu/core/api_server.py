@@ -210,7 +210,13 @@ class KubeApiServer(EventHandler):
 
     def on_remove_pod_response(self, data: RemovePodResponse, time: float) -> None:
         if data.assigned_node is not None:
-            node_component = self.created_nodes[data.assigned_node]
+            node_component = self.created_nodes.get(data.assigned_node)
+            if node_component is None:
+                # The pod's node was removed while this pod-removal was in
+                # flight (its pods were already canceled with it); nothing left
+                # to terminate. (Deviation: the reference unwraps and panics.)
+                self.pending_pod_removal_requests.discard(data.pod_name)
+                return
             self.ctx.emit(
                 RemovePodRequest(pod_name=data.pod_name),
                 node_component.id,

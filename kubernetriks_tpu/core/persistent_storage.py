@@ -49,10 +49,6 @@ if TYPE_CHECKING:
     from kubernetriks_tpu.config import SimulationConfig
     from kubernetriks_tpu.metrics.collector import MetricsCollector
 
-# Label marking nodes created by the cluster autoscaler
-# (reference: src/autoscalers/cluster_autoscaler/kube_cluster_autoscaler.rs:13).
-CLUSTER_AUTOSCALER_ORIGIN_LABEL = "cluster autoscaler"
-
 
 class PersistentStorage(EventHandler):
     def __init__(
@@ -120,7 +116,10 @@ class PersistentStorage(EventHandler):
     def scale_down_info(self):
         """All nodes + pods on autoscaled nodes + assignments snapshot
         (reference: src/core/persistent_storage.rs:148-168)."""
-        from kubernetriks_tpu.autoscalers.interface import ScaleDownInfo
+        from kubernetriks_tpu.autoscalers.interface import (
+            CLUSTER_AUTOSCALER_ORIGIN_LABEL,
+            ScaleDownInfo,
+        )
 
         nodes = [node.copy() for node in self.storage_data.sorted_nodes()]
         pods_on_autoscaled_nodes: Dict[str, Pod] = {}

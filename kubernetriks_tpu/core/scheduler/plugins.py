@@ -39,8 +39,18 @@ class LeastAllocatedResources(ScorePlugin):
     def score(self, pod: Pod, node: Node) -> float:
         requests = pod.spec.resources.requests
         allocatable = node.status.allocatable
-        cpu_score = (allocatable.cpu - requests.cpu) * 100.0 / allocatable.cpu
-        ram_score = (allocatable.ram - requests.ram) * 100.0 / allocatable.ram
+        # Zero allocatable yields NaN, matching the reference's f64 division
+        # (plugin.rs:54-62); NaN never wins the `>=` argmax.
+        cpu_score = (
+            (allocatable.cpu - requests.cpu) * 100.0 / allocatable.cpu
+            if allocatable.cpu
+            else float("nan")
+        )
+        ram_score = (
+            (allocatable.ram - requests.ram) * 100.0 / allocatable.ram
+            if allocatable.ram
+            else float("nan")
+        )
         return (cpu_score + ram_score) / 2.0
 
 

@@ -82,6 +82,12 @@ class UnschedulableQueue:
     def sorted_keys(self) -> List[UnschedulablePodKey]:
         return sorted(self._map, key=UnschedulablePodKey.sort_key)
 
+    def remove_pod(self, pod_name: str) -> None:
+        """Drop every entry for a pod (used when the pod is removed outright)."""
+        stale = [key for key in self._map if key.pod_name == pod_name]
+        for key in stale:
+            del self._map[key]
+
     def __len__(self) -> int:
         return len(self._map)
 

@@ -322,6 +322,10 @@ class Scheduler(EventHandler):
         pod = self.objects_cache.pods.pop(data.pod_name, None)
         if pod is None:
             return  # already finished
+        # Deviation from the reference (which leaks the entry and would panic in
+        # move_to_active_queue_if): a removed pod must leave the unschedulable
+        # queue too, else later queue scans dereference a pod no longer cached.
+        self.unschedulable_pods.remove_pod(data.pod_name)
         assigned_node_name = pod.status.assigned_node
         if assigned_node_name:
             # Node may itself have been removed from cache earlier; only clean

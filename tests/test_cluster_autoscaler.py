@@ -1,0 +1,183 @@
+"""Cluster autoscaler: scale-up on unschedulable pods, scale-down of
+underutilized CA nodes (algorithm unit tests + end-to-end)."""
+
+from kubernetriks_tpu.autoscalers.interface import (
+    AutoscaleInfo,
+    CaNodeGroup,
+    ScaleDownInfo,
+    ScaleUpInfo,
+)
+from kubernetriks_tpu.autoscalers.kube_cluster_autoscaler import (
+    CLUSTER_AUTOSCALER_ORIGIN_LABEL,
+    KubeClusterAutoscaler,
+)
+from kubernetriks_tpu.core.types import Node, Pod
+from kubernetriks_tpu.sim.callbacks import RunUntilAllPodsAreFinishedCallbacks
+from kubernetriks_tpu.sim.simulator import KubernetriksSimulation
+from kubernetriks_tpu.test_util import default_test_simulation_config
+from kubernetriks_tpu.trace.generic import GenericClusterTrace, GenericWorkloadTrace
+
+
+def make_groups():
+    small = Node.new("small_template", 4000, 8 * 1024**3)
+    small.metadata.labels = {
+        "origin": CLUSTER_AUTOSCALER_ORIGIN_LABEL,
+        "node_group": "small_template",
+    }
+    big = Node.new("big_template", 64000, 128 * 1024**3)
+    big.metadata.labels = {
+        "origin": CLUSTER_AUTOSCALER_ORIGIN_LABEL,
+        "node_group": "big_template",
+    }
+    return {
+        "big_template": CaNodeGroup(node_template=big, max_count=2),
+        "small_template": CaNodeGroup(node_template=small),
+    }
+
+
+def test_scale_up_bin_packs_pods_into_planned_nodes():
+    ca = KubeClusterAutoscaler()
+    groups = make_groups()
+    pods = [Pod.new(f"p{i}", 2000, 1024**3, None) for i in range(4)]
+    actions = ca.autoscale(
+        AutoscaleInfo(scale_up=ScaleUpInfo(unscheduled_pods=pods)),
+        groups,
+        max_node_count=10,
+    )
+    # First pod allocates one big node (sorted group order: big_template first);
+    # the triggering pod is NOT packed (reference quirk), so remaining pods
+    # first-fit into that node. One node total.
+    assert len(actions) == 1
+    assert actions[0].node.metadata.name == "big_template_1"
+    assert groups["big_template"].current_count == 1
+    assert actions[0].node.status.allocatable == actions[0].node.status.capacity
+
+
+def test_scale_up_respects_group_max_and_global_max():
+    ca = KubeClusterAutoscaler()
+    groups = make_groups()
+    # Huge pods fit only the big template; its max_count is 2.
+    pods = [Pod.new(f"p{i}", 64000, 100 * 1024**3, None) for i in range(5)]
+    actions = ca.autoscale(
+        AutoscaleInfo(scale_up=ScaleUpInfo(unscheduled_pods=pods)),
+        groups,
+        max_node_count=10,
+    )
+    assert len(actions) == 2
+    assert groups["big_template"].current_count == 2
+
+    # Global cap: reset and bound to 1 node overall.
+    groups = make_groups()
+    actions = ca.autoscale(
+        AutoscaleInfo(scale_up=ScaleUpInfo(unscheduled_pods=pods)),
+        groups,
+        max_node_count=1,
+    )
+    assert len(actions) == 1
+
+
+def test_scale_down_only_underutilized_ca_nodes_with_movable_pods():
+    ca = KubeClusterAutoscaler()
+    groups = make_groups()
+    groups["small_template"].current_count = 2
+
+    # Two CA nodes: one nearly empty (scale-down candidate), one busy.
+    idle = groups["small_template"].node_template.copy()
+    idle.metadata.name = "small_template_1"
+    busy = groups["small_template"].node_template.copy()
+    busy.metadata.name = "small_template_2"
+    busy.status.allocatable.cpu -= 3500  # 87% cpu utilization
+
+    # A non-CA node with room for the idle node's pod.
+    manual = Node.new("manual_node", 64000, 128 * 1024**3)
+
+    pod = Pod.new("pod_on_idle", 100, 1024**2, None)
+    idle.status.allocatable.cpu -= 100
+    idle.status.allocatable.ram -= 1024**2
+
+    info = ScaleDownInfo(
+        nodes=[idle, busy, manual],
+        pods_on_autoscaled_nodes={"pod_on_idle": pod},
+        assignments={
+            "small_template_1": {"pod_on_idle"},
+            "small_template_2": set(),
+            "manual_node": set(),
+        },
+    )
+    actions = ca.autoscale(AutoscaleInfo(scale_down=info), groups, max_node_count=10)
+    assert [a.node_name for a in actions] == ["small_template_1"]
+    assert groups["small_template"].current_count == 1
+
+
+def test_scale_down_blocked_when_pods_cannot_move():
+    ca = KubeClusterAutoscaler()
+    groups = make_groups()
+    groups["small_template"].current_count = 1
+
+    idle = groups["small_template"].node_template.copy()
+    idle.metadata.name = "small_template_1"
+    pod = Pod.new("stuck_pod", 100, 1024**2, None)
+    idle.status.allocatable.cpu -= 100
+    # No other node has capacity.
+    info = ScaleDownInfo(
+        nodes=[idle],
+        pods_on_autoscaled_nodes={"stuck_pod": pod},
+        assignments={"small_template_1": {"stuck_pod"}},
+    )
+    actions = ca.autoscale(AutoscaleInfo(scale_down=info), groups, max_node_count=10)
+    assert actions == []
+    assert groups["small_template"].current_count == 1
+
+
+CA_CONFIG_SUFFIX = """
+cluster_autoscaler:
+  enabled: true
+  scan_interval: 10.0
+  max_node_count: 10
+  node_groups:
+  - node_template:
+      metadata:
+        name: autoscaler_node
+      status:
+        capacity:
+          cpu: 16000
+          ram: 34359738368
+"""
+
+
+def test_end_to_end_scale_up_then_down():
+    """Pods arrive with no cluster; CA scales up; after pods finish, CA scales
+    the idle nodes back down."""
+    config = default_test_simulation_config(CA_CONFIG_SUFFIX)
+    sim = KubernetriksSimulation(config)
+    workload = "events:" + "".join(
+        f"""
+- timestamp: {5 + i}
+  event_type:
+    !CreatePod
+      pod:
+        metadata:
+          name: pod_{i}
+        spec:
+          resources:
+            requests:
+              cpu: 4000
+              ram: 8589934592
+            limits:
+              cpu: 4000
+              ram: 8589934592
+          running_duration: 50.0
+"""
+        for i in range(4)
+    )
+    sim.initialize(
+        GenericClusterTrace.from_yaml(""), GenericWorkloadTrace.from_yaml(workload)
+    )
+    sim.run_with_callbacks(RunUntilAllPodsAreFinishedCallbacks())
+
+    metrics = sim.metrics_collector.accumulated_metrics
+    assert metrics.pods_succeeded == 4
+    assert metrics.total_scaled_up_nodes >= 1
+    # After success, idle CA nodes get scaled down.
+    assert metrics.total_scaled_down_nodes >= 1
+    assert sim.api_server.node_count() < metrics.total_scaled_up_nodes + 1

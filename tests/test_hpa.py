@@ -1,0 +1,100 @@
+"""Golden HPA trajectory: replicas 5->9->14->(hold)->4->(hold)->7->12->14
+(port of reference tests/test_hpa.rs)."""
+
+from kubernetriks_tpu.config import KubeHorizontalPodAutoscalerConfig
+from kubernetriks_tpu.sim.simulator import KubernetriksSimulation
+from kubernetriks_tpu.test_util import default_test_simulation_config
+from kubernetriks_tpu.trace.generic import GenericClusterTrace, GenericWorkloadTrace
+
+CLUSTER_TRACE = """
+events:
+- timestamp: 5.0
+  event_type:
+    !CreateNode
+      node:
+        metadata:
+          name: trace_node_42
+        status:
+          capacity:
+            cpu: 64000
+            ram: 68719476736
+"""
+
+WORKLOAD_TRACE = """
+events:
+- timestamp: 59.5
+  event_type:
+    !CreatePodGroup
+      pod_group:
+        name: pod_group_1
+        initial_pod_count: 5
+        max_pod_count: 100
+        pod_template:
+          metadata:
+            name: pod_group_1
+          spec:
+            resources:
+              requests:
+                cpu: 100
+                ram: 104857600
+              limits:
+                cpu: 100
+                ram: 104857600
+        target_resources_usage:
+          cpu_utilization: 0.6
+        resources_usage_model_config:
+          cpu_config:
+            model_name: pod_group
+            config: |
+              - duration: 500.0
+                total_load: 8
+              - duration: 200.0
+                total_load: 2
+"""
+
+
+def pod_group_len(sim: KubernetriksSimulation) -> int:
+    return len(sim.horizontal_pod_autoscaler.pod_groups["pod_group_1"].created_pods)
+
+
+def test_pod_group_created_and_scaled_by_cpu_utilization():
+    config = default_test_simulation_config()
+    config.horizontal_pod_autoscaler.enabled = True
+    config.horizontal_pod_autoscaler.kube_horizontal_pod_autoscaler_config = (
+        KubeHorizontalPodAutoscalerConfig()
+    )
+
+    sim = KubernetriksSimulation(config)
+    sim.initialize(
+        GenericClusterTrace.from_yaml(CLUSTER_TRACE),
+        GenericWorkloadTrace.from_yaml(WORKLOAD_TRACE),
+    )
+
+    # HPA cycles at 60, 120, 180, ... The expected replica counts below follow
+    # the k8s formula desired = ceil(current * util/target) with util =
+    # min(1, total_load / pod_count), target 0.6, tolerance 0.1
+    # (worked out in the reference test's comments, tests/test_hpa.rs:90-135).
+    sim.step_until_time(61.0)
+    assert pod_group_len(sim) == 5
+    sim.step_until_time(121.0)
+    assert pod_group_len(sim) == 9
+    sim.step_until_time(181.0)
+    assert pod_group_len(sim) == 14
+    sim.step_until_time(450.0)
+    assert pod_group_len(sim) == 14
+    sim.step_until_time(600.5)
+    assert pod_group_len(sim) == 4
+    sim.step_until_time(759.5)
+    assert pod_group_len(sim) == 4
+    sim.step_until_time(781.0)
+    assert pod_group_len(sim) == 7
+    sim.step_until_time(841.0)
+    assert pod_group_len(sim) == 12
+    sim.step_until_time(901.0)
+    assert pod_group_len(sim) == 14
+    sim.step_until_time(1200.0)
+    assert pod_group_len(sim) == 14
+    # Scale metrics reflect the up/down churn.
+    metrics = sim.metrics_collector.accumulated_metrics
+    assert metrics.total_scaled_up_pods == (4 + 5 + 3 + 5 + 2)
+    assert metrics.total_scaled_down_pods == 10

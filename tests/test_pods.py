@@ -232,6 +232,43 @@ def test_pod_removal_after_finish():
     assert "pod_1" in sim.persistent_storage.succeeded_pods
 
 
+def test_remove_unschedulable_pod_then_add_node_conditional_move():
+    """Regression: removing a pod parked in the unschedulable queue must purge
+    its queue entry; a later node arrival with conditional move scans the
+    queue and would otherwise dereference the removed pod."""
+    workload = (
+        "events:"
+        + make_pod_event("doomed", 8000, 4294967296, 500.0, 10)
+        + """
+- timestamp: 50
+  event_type:
+    !RemovePod
+      pod_name: doomed
+"""
+    )
+    cluster = (
+        CLUSTER_TRACE
+        + """
+- timestamp: 100
+  event_type:
+    !CreateNode
+      node:
+        metadata:
+          name: big_late_node
+        status:
+          capacity:
+            cpu: 16000
+            ram: 34359738368
+"""
+    )
+    sim = run_sim(
+        cluster, workload, "enable_unscheduled_pods_conditional_move: true\n"
+    )
+    sim.step_for_duration(1000.0)
+    assert len(sim.scheduler.unschedulable_pods) == 0
+    assert sim.scheduler.pod_count() == 0
+
+
 def test_node_removal_frees_space_for_unschedulable_pod():
     """Big pod unschedulable while a small node is full; removing the blocker
     node is irrelevant — port covers removal freeing space scenario

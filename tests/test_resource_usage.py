@@ -1,0 +1,83 @@
+"""Resource usage models (port of reference src/core/resource_usage tests)."""
+
+import pytest
+
+from kubernetriks_tpu.core.resource_usage import (
+    ConstantResourceUsageModel,
+    PodGroupResourceUsageModel,
+    default_resource_usage_config,
+    resource_usage_model_from_config,
+)
+from kubernetriks_tpu.core.types import ResourceUsageModelConfig
+
+
+def test_constant_usage_any_time():
+    model = ConstantResourceUsageModel.from_str("usage: 27.0")
+    for t in [0.0, 500.0, 500.0, 1000.0, 1001.0]:
+        assert model.current_usage(t) == 27.0
+
+
+def test_pod_group_one_unit():
+    model = PodGroupResourceUsageModel.from_str(
+        "- duration: 1000.0\n  total_load: 10.0\n", 0.0
+    )
+    for t in [0.0, 500.0, 500.0, 1000.0, 1001.0, 7431.0, 63431.0]:
+        assert model.current_usage(t, 50) == 0.2
+
+
+def test_pod_group_time_going_backwards_raises():
+    model = PodGroupResourceUsageModel.from_str(
+        "- duration: 1000.0\n  total_load: 10.0\n", 0.0
+    )
+    assert model.current_usage(0.0, 50) == 0.2
+    assert model.current_usage(500.0, 50) == 0.2
+    with pytest.raises(RuntimeError):
+        model.current_usage(250.0, 50)
+
+
+COMPLEX_CONFIG = """
+- duration: 1000.0
+  total_load: 10.0
+- duration: 10.0
+  total_load: 400.0
+- duration: 200.0
+  total_load: 20.0
+- duration: 500.0
+  total_load: 1.0
+"""
+
+
+@pytest.mark.parametrize("shift", [0.0, 1.0, 500.0, 1000.0, 1010.0, 1499.0])
+def test_pod_group_complex_curve_with_creation_shift(shift):
+    """Load curve anchored at pod-group creation time; cyclic wrap
+    (reference: src/core/resource_usage/pod_group.rs:140-176)."""
+    model = PodGroupResourceUsageModel.from_str(COMPLEX_CONFIG, shift)
+    assert model.current_usage(0.0 + shift, 10) == 1.0
+    assert model.current_usage(1000.0 + shift, 10) == 1.0
+    assert model.current_usage(1000.0 + shift, 1600) == 0.25
+    assert model.current_usage(1000.1 + shift, 500) == 0.8
+    assert model.current_usage(1010.0 + shift, 40) == 0.5
+    assert model.current_usage(1010.0 + shift, 20) == 1.0
+    assert model.current_usage(8550.0 + shift, 20) == 0.5
+    assert model.current_usage(9560.0 + shift, 80) == 0.25
+    assert model.current_usage(9759.0 + shift, 200) == 0.1
+    assert model.current_usage(54376.0 + shift, 20) == 0.05
+
+
+def test_factory_dispatch():
+    constant = resource_usage_model_from_config(
+        default_resource_usage_config(32.0)
+    )
+    assert constant.current_usage(10.0) == 32.0
+    pod_group = resource_usage_model_from_config(
+        ResourceUsageModelConfig(
+            model_name="pod_group",
+            config="- duration: 100.0\n  total_load: 5.0\n",
+        ),
+        pod_group_creation_time="50.0",
+    )
+    assert pod_group.current_usage(60.0, 10) == 0.5
+    with pytest.raises(ValueError):
+        resource_usage_model_from_config(
+            ResourceUsageModelConfig(model_name="bogus", config="")
+        )

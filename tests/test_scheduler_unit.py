@@ -1,0 +1,159 @@
+"""Scheduler algorithm unit tests with hand-computed scores
+(port of reference src/core/scheduler/scheduler.rs:479-603 and queue.rs tests)."""
+
+import pytest
+
+from kubernetriks_tpu.core.scheduler.interface import ScheduleError, SchedulingFailure
+from kubernetriks_tpu.core.scheduler.kube_scheduler import KubeScheduler
+from kubernetriks_tpu.core.scheduler.model import ConstantTimePerNodeModel
+from kubernetriks_tpu.core.scheduler.queue import (
+    ActiveQueue,
+    QueuedPodInfo,
+    UnschedulablePodKey,
+    UnschedulableQueue,
+)
+from kubernetriks_tpu.core.node_component import NodeComponentPool
+from kubernetriks_tpu.core.scheduler.scheduler import Scheduler
+from kubernetriks_tpu.core.types import Node, Pod
+from kubernetriks_tpu.metrics.collector import MetricsCollector
+from kubernetriks_tpu.sim.kernel import Simulation
+from kubernetriks_tpu.test_util import default_test_simulation_config
+
+
+def create_scheduler() -> Scheduler:
+    fake_sim = Simulation(0)
+    return Scheduler(
+        0,
+        KubeScheduler(),
+        fake_sim.create_context("scheduler"),
+        default_test_simulation_config(),
+        MetricsCollector(),
+    )
+
+
+def test_no_nodes_no_schedule():
+    scheduler = create_scheduler()
+    pod = Pod.new("pod_1", 4000, 16000, 5.0)
+    with pytest.raises(SchedulingFailure) as exc:
+        scheduler.schedule_one(pod)
+    assert exc.value.error == ScheduleError.NO_NODES_IN_CLUSTER
+
+
+def test_pod_has_requested_zero_resources():
+    scheduler = create_scheduler()
+    scheduler.add_node(Node.new("node1", 3000, 8589934592))
+    with pytest.raises(SchedulingFailure) as exc:
+        scheduler.schedule_one(Pod.new("pod_1", 0, 0, 5.0))
+    assert exc.value.error == ScheduleError.REQUESTED_RESOURCES_ARE_ZEROS
+
+
+def test_no_sufficient_nodes_for_scheduling():
+    scheduler = create_scheduler()
+    scheduler.add_node(Node.new("node1", 3000, 8589934592))
+    with pytest.raises(SchedulingFailure) as exc:
+        scheduler.schedule_one(Pod.new("pod_1", 6000, 12884901888, 5.0))
+    assert exc.value.error == ScheduleError.NO_SUFFICIENT_RESOURCES
+
+
+def test_correct_pod_scheduling():
+    """Hand-computed LeastAllocatedResources scores
+    (reference: scheduler.rs:556-575):
+      node1: ((8000-6000)*100/8000 + (14589934592-12884901888)*100/14589934592)/2 = 18.34
+      node2: ((7000-6000)*100/7000 + (20589934592-12884901888)*100/20589934592)/2 = 25.85
+      node3: ((6000-6000)*100/6000 + (100589934592-12884901888)*100/100589934592)/2 = 43.59
+    """
+    scheduler = create_scheduler()
+    scheduler.add_node(Node.new("node1", 8000, 14589934592))
+    scheduler.add_node(Node.new("node2", 7000, 20589934592))
+    scheduler.add_node(Node.new("node3", 6000, 100589934592))
+    pod = Pod.new("pod_1", 6000, 12884901888, 5.0)
+    assert scheduler.schedule_one(pod) == "node3"
+
+
+def test_several_pod_scheduling():
+    """Capacity exhaustion on a single node (reference: scheduler.rs:577-603)."""
+    scheduler = create_scheduler()
+    scheduler.add_node(Node.new("node1", 16000, 100589934592))
+    pods = [
+        Pod.new("pod_1", 4000, 8589934592, 5.0),
+        Pod.new("pod_2", 2000, 4294967296, 5.0),
+        Pod.new("pod_3", 8000, 8589934592, 5.0),
+        Pod.new("pod_4", 10000, 8589934592, 5.0),
+    ]
+    for pod in pods:
+        scheduler.add_pod(pod)
+    for pod in pods[:3]:
+        assert scheduler.schedule_one(pod) == "node1"
+        scheduler.reserve_node_resources(pod.metadata.name, "node1")
+    with pytest.raises(SchedulingFailure) as exc:
+        scheduler.schedule_one(pods[3])
+    assert exc.value.error == ScheduleError.NO_SUFFICIENT_RESOURCES
+
+
+def test_tie_break_prefers_last_sorted_name():
+    """Equal scores: the reference's `>=` argmax keeps the last node in
+    sorted-name order (kube_scheduler.rs:140-150)."""
+    scheduler = create_scheduler()
+    scheduler.add_node(Node.new("node_a", 8000, 8000))
+    scheduler.add_node(Node.new("node_b", 8000, 8000))
+    assert scheduler.schedule_one(Pod.new("p", 1000, 1000, 1.0)) == "node_b"
+
+
+def test_active_queue_order():
+    """Min-by-timestamp with FIFO tie-break (reference: queue.rs:88-114)."""
+    queue = ActiveQueue()
+    for ts in [1.0, 5.0, 4.0, 0.5, 4.0]:
+        queue.push(QueuedPodInfo(ts, 1, ts, "some_pod"))
+    assert [queue.pop().timestamp for _ in range(5)] == [0.5, 1.0, 4.0, 4.0, 5.0]
+    assert queue.pop() is None
+
+
+def test_unschedulable_queue_order():
+    """(insert_timestamp, pod_name) ordering (reference: queue.rs:116-165)."""
+    queue = UnschedulableQueue()
+    entries = [
+        (1.0, "some_pod"),
+        (10.0, "some_pod_2"),
+        (7.0, "some_pod_5"),
+        (5.0, "some_pod_3"),
+        (7.0, "some_pod_4"),
+    ]
+    for ts, name in entries:
+        queue.insert(UnschedulablePodKey(name, ts), QueuedPodInfo(ts, 1, ts, name))
+    ordered = [key.pod_name for key, _ in queue.sorted_items()]
+    assert ordered == ["some_pod", "some_pod_3", "some_pod_4", "some_pod_5", "some_pod_2"]
+
+
+def test_scheduling_time_model():
+    model = ConstantTimePerNodeModel()
+    nodes = {f"n{i}": Node.new(f"n{i}", 1, 1) for i in range(5)}
+    assert model.simulate_time(Pod.new("p", 1, 1, 1.0), nodes) == pytest.approx(5e-6)
+
+
+def test_node_pool_init_allocate_reclaim():
+    """reference: node_component_pool.rs:79-143."""
+    sim = Simulation(123)
+    pool = NodeComponentPool(10, sim)
+    assert len(pool) == 10
+    for idx, component in enumerate(pool.pool):
+        assert component.context_name() == f"pool_node_context_{idx}"
+
+    config = default_test_simulation_config()
+    node = Node.new("node_42", 0, 0)
+    component = pool.allocate_component(node, 0, config)
+    assert len(pool) == 9
+    assert component.runtime.node == node
+
+    pool.reclaim_component(component)
+    assert len(pool) == 10
+    assert pool.pool[-1].runtime is None
+
+
+def test_node_pool_exhaustion_raises():
+    sim = Simulation(123)
+    pool = NodeComponentPool(2, sim)
+    config = default_test_simulation_config()
+    pool.allocate_component(Node.new("a", 0, 0), 0, config)
+    pool.allocate_component(Node.new("b", 0, 0), 0, config)
+    with pytest.raises(RuntimeError):
+        pool.allocate_component(Node.new("c", 0, 0), 0, config)
