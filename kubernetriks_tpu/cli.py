@@ -1,0 +1,99 @@
+"""CLI entry point (reference: src/main.rs).
+
+Usage: python -m kubernetriks_tpu.cli --config-file <yaml> [--gauge-csv <path>]
+
+Loads the config, selects the trace source (alibaba XOR generic, asserted like
+the reference at main.rs:62-65), builds the simulation, runs until all pods
+finish, and prints metrics.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+from kubernetriks_tpu.config import SimulationConfig
+from kubernetriks_tpu.metrics.printer import print_metrics
+from kubernetriks_tpu.sim.callbacks import RunUntilAllPodsAreFinishedCallbacks
+from kubernetriks_tpu.sim.simulator import KubernetriksSimulation
+from kubernetriks_tpu.trace.interface import EmptyTrace
+
+
+def setup_logging(config: SimulationConfig) -> None:
+    """Level from KUBERNETRIKS_LOG (RUST_LOG equivalent), optional file sink
+    (reference: main.rs:33-50)."""
+    level = os.environ.get("KUBERNETRIKS_LOG", "INFO").upper()
+    handlers = [logging.StreamHandler()]
+    if config.logs_filepath:
+        os.makedirs(os.path.dirname(config.logs_filepath) or ".", exist_ok=True)
+        handlers.append(logging.FileHandler(config.logs_filepath))
+    logging.basicConfig(
+        level=getattr(logging, level, logging.INFO),
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+        handlers=handlers,
+        force=True,
+    )
+
+
+def build_traces(config: SimulationConfig):
+    trace_config = config.trace_config
+    if trace_config is None:
+        return EmptyTrace(), EmptyTrace()
+    alibaba = trace_config.alibaba_cluster_trace_v2017
+    generic = trace_config.generic_trace
+    assert (alibaba is None) != (generic is None), (
+        "Exactly one of alibaba_cluster_trace_v2017 or generic_trace must be set"
+    )
+    if generic is not None:
+        from kubernetriks_tpu.trace.generic import (
+            GenericClusterTrace,
+            GenericWorkloadTrace,
+        )
+
+        return (
+            GenericClusterTrace.from_file(generic.cluster_trace_path),
+            GenericWorkloadTrace.from_file(generic.workload_trace_path),
+        )
+    from kubernetriks_tpu.trace.alibaba import (
+        AlibabaClusterTraceV2017,
+        AlibabaWorkloadTraceV2017,
+    )
+
+    cluster = (
+        AlibabaClusterTraceV2017.from_file(alibaba.machine_events_trace_path)
+        if alibaba.machine_events_trace_path
+        else EmptyTrace()
+    )
+    workload = AlibabaWorkloadTraceV2017.from_files(
+        alibaba.batch_instance_trace_path, alibaba.batch_task_trace_path
+    )
+    return cluster, workload
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description="kubernetriks-tpu simulator")
+    parser.add_argument("--config-file", required=True, help="Path to YAML config")
+    parser.add_argument(
+        "--gauge-csv",
+        default=None,
+        help="Path for the 5s gauge-metrics CSV (off by default)",
+    )
+    args = parser.parse_args(argv)
+
+    config = SimulationConfig.from_file(args.config_file)
+    setup_logging(config)
+
+    cluster_trace, workload_trace = build_traces(config)
+    sim = KubernetriksSimulation(config, gauge_csv_path=args.gauge_csv)
+    sim.initialize(cluster_trace, workload_trace)
+    sim.run_with_callbacks(RunUntilAllPodsAreFinishedCallbacks())
+    if config.metrics_printer is None:
+        print_metrics(sim.metrics_collector, None)
+    sim.metrics_collector.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -213,9 +213,18 @@ class KubeApiServer(EventHandler):
             node_component = self.created_nodes.get(data.assigned_node)
             if node_component is None:
                 # The pod's node was removed while this pod-removal was in
-                # flight (its pods were already canceled with it); nothing left
-                # to terminate. (Deviation: the reference unwraps and panics.)
-                self.pending_pod_removal_requests.discard(data.pod_name)
+                # flight; the node can no longer confirm, so confirm on its
+                # behalf: the self-emitted PodRemovedFromNode flows through the
+                # normal handler (metrics, pending cleanup) and on to storage,
+                # which tells the scheduler to drop the pod — without this the
+                # scheduler would reschedule a pod storage already removed.
+                # (Deviation: the reference unwraps and panics here.)
+                self.ctx.emit_now(
+                    PodRemovedFromNode(
+                        removed=True, removal_time=time, pod_name=data.pod_name
+                    ),
+                    self.ctx.id,
+                )
                 return
             self.ctx.emit(
                 RemovePodRequest(pod_name=data.pod_name),

@@ -1,0 +1,127 @@
+"""Synthetic workload/cluster generators.
+
+Extends the reference's WIP generator (reference: src/trace/generator.rs:8-43 —
+pods with cpu/ram sampled from 11 power-of-2 bins, duration U[1,10000]) into a
+usable, seedable pair of generators for benchmarks and load tests. Also
+provides a Poisson-arrival workload for the 100-node benchmark config
+(BASELINE.md configs[1]).
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import List, Optional, Tuple
+
+from kubernetriks_tpu.core.events import CreateNodeRequest, CreatePodRequest
+from kubernetriks_tpu.core.types import Node, Pod
+from kubernetriks_tpu.trace.interface import Trace, TraceEvents
+
+# 11 power-of-2 resource bins, 1..1024 (reference: src/trace/generator.rs:14-16).
+RESOURCE_BINS = [2**i for i in range(11)]
+
+
+class SyntheticWorkloadTrace(Trace):
+    """Pods with bin-sampled cpu (millicores = bin x 100) and ram (bytes =
+    bin GiB / 16), uniform durations, uniform arrivals."""
+
+    def __init__(
+        self,
+        pod_count: int,
+        seed: int = 42,
+        arrival_horizon: float = 10000.0,
+        duration_range: Tuple[float, float] = (1.0, 10000.0),
+    ) -> None:
+        self.pod_count = pod_count
+        self.seed = seed
+        self.arrival_horizon = arrival_horizon
+        self.duration_range = duration_range
+        self._converted = False
+
+    def convert_to_simulator_events(self) -> TraceEvents:
+        rng = random.Random(self.seed)
+        events: TraceEvents = []
+        for i in range(self.pod_count):
+            cpu = rng.choice(RESOURCE_BINS) * 100
+            ram = rng.choice(RESOURCE_BINS) * (1024**3 // 16)
+            duration = rng.uniform(*self.duration_range)
+            ts = rng.uniform(0.0, self.arrival_horizon)
+            events.append(
+                (ts, CreatePodRequest(pod=Pod.new(f"synthetic_pod_{i}", cpu, ram, duration)))
+            )
+        self._converted = True
+        events.sort(key=lambda pair: pair[0])
+        return events
+
+    def event_count(self) -> int:
+        return 0 if self._converted else self.pod_count
+
+
+class PoissonWorkloadTrace(Trace):
+    """Poisson pod arrivals at a given rate — the BASELINE benchmark shape
+    (100-node cluster, synthetic Poisson arrivals)."""
+
+    def __init__(
+        self,
+        rate_per_second: float,
+        horizon: float,
+        seed: int = 42,
+        cpu: int = 1000,
+        ram: int = 1024**3,
+        duration_range: Tuple[float, float] = (10.0, 300.0),
+        max_pods: Optional[int] = None,
+    ) -> None:
+        self.rate = rate_per_second
+        self.horizon = horizon
+        self.seed = seed
+        self.cpu = cpu
+        self.ram = ram
+        self.duration_range = duration_range
+        self.max_pods = max_pods
+        self._count: Optional[int] = None
+
+    def convert_to_simulator_events(self) -> TraceEvents:
+        rng = random.Random(self.seed)
+        events: TraceEvents = []
+        t = 0.0
+        i = 0
+        while True:
+            t += rng.expovariate(self.rate)
+            if t > self.horizon or (self.max_pods is not None and i >= self.max_pods):
+                break
+            duration = rng.uniform(*self.duration_range)
+            events.append(
+                (
+                    t,
+                    CreatePodRequest(
+                        pod=Pod.new(f"poisson_pod_{i}", self.cpu, self.ram, duration)
+                    ),
+                )
+            )
+            i += 1
+        self._count = i
+        return events
+
+    def event_count(self) -> int:
+        return self._count if self._count is not None else int(self.rate * self.horizon)
+
+
+class UniformClusterTrace(Trace):
+    """N identical nodes created at t=0."""
+
+    def __init__(self, node_count: int, cpu: int = 64000, ram: int = 128 * 1024**3) -> None:
+        self.node_count = node_count
+        self.cpu = cpu
+        self.ram = ram
+
+    def convert_to_simulator_events(self) -> TraceEvents:
+        return [
+            (
+                0.0,
+                CreateNodeRequest(node=Node.new(f"gen_node_{i}", self.cpu, self.ram)),
+            )
+            for i in range(self.node_count)
+        ]
+
+    def event_count(self) -> int:
+        return self.node_count

@@ -1,0 +1,149 @@
+"""Alibaba v2017 trace parsing and conversion
+(port of reference src/trace/alibaba_cluster_trace_v2017 tests)."""
+
+import pytest
+
+from kubernetriks_tpu.core.events import CreateNodeRequest, CreatePodRequest, RemoveNodeRequest
+from kubernetriks_tpu.trace.alibaba import (
+    AlibabaClusterTraceV2017,
+    AlibabaWorkloadTraceV2017,
+    CPU_BASE,
+    DENORMALIZATION_BASE,
+    read_batch_instances,
+    read_batch_tasks,
+    read_machine_events,
+)
+
+
+def test_batch_instance_parsing():
+    """reference: workload.rs:220-243."""
+    rows = read_batch_instances(
+        "41562,41618,120,686,299,Terminated,1,1,1.5,0.29,1.0,1.2\n"
+    )
+    inst = rows[0]
+    assert inst.start_timestamp == 41562
+    assert inst.end_timestamp == 41618
+    assert inst.job_id == 120
+    assert inst.task_id == 686
+    assert inst.machine_id == 299
+    assert inst.status == "Terminated"
+
+
+def test_batch_task_parsing():
+    """reference: workload.rs:245-262."""
+    tasks = read_batch_tasks("10718,12897,15,64,2003,Terminated,50,0.01600704061294748\n")
+    task = tasks[64]
+    assert task.task_create_time == 10718
+    assert task.number_of_instances == 2003
+    assert task.cpus_requested_per_instance == 50
+    assert task.normalized_memory_per_instance == pytest.approx(0.01600704061294748)
+
+
+def test_optional_fields_parse_as_none():
+    """reference: workload.rs:264-311."""
+    rows = read_batch_instances("0,,120,686,,Interrupted,1,1,,,,\n")
+    inst = rows[0]
+    assert inst.start_timestamp == 0
+    assert inst.end_timestamp is None
+    assert inst.machine_id is None
+
+    tasks = read_batch_tasks("6036,6046,4,6,452,Waiting,,\n")
+    assert tasks[6].cpus_requested_per_instance is None
+    assert tasks[6].normalized_memory_per_instance is None
+
+
+def test_duplicate_task_id_raises():
+    with pytest.raises(ValueError):
+        read_batch_tasks(
+            "1,2,3,64,1,Terminated,50,0.5\n1,2,3,64,1,Terminated,50,0.5\n"
+        )
+
+
+def test_workload_conversion_filters_and_converts():
+    """Invalid rows (missing/<=0/start>=end timestamps, missing task or
+    resources) are dropped; units convert santicores x10 and normalized mem
+    x128 GiB (reference: workload.rs:56-120)."""
+    instances = read_batch_instances(
+        "\n".join(
+            [
+                "41562,41618,120,686,299,Terminated,1,1",  # valid
+                ",41618,120,686,299,Interrupted,1,1",  # missing start
+                "41562,,120,686,299,Interrupted,1,1",  # missing end
+                "41700,41600,120,686,299,Terminated,1,1",  # start >= end
+                "0,41618,120,686,299,Terminated,1,1",  # start <= 0
+                "41562,41618,120,999,299,Terminated,1,1",  # unknown task
+                "41562,41618,121,700,299,Terminated,1,1",  # task lacks resources
+            ]
+        )
+    )
+    tasks = read_batch_tasks(
+        "10718,12897,15,686,1,Terminated,50,0.25\n10718,12897,15,700,1,Terminated,,\n"
+    )
+    trace = AlibabaWorkloadTraceV2017(instances, tasks)
+    events = trace.convert_to_simulator_events()
+    assert len(events) == 1
+    ts, event = events[0]
+    assert ts == 41562.0
+    assert isinstance(event, CreatePodRequest)
+    pod = event.pod
+    assert pod.metadata.name == "120_686_0"
+    assert pod.spec.resources.requests.cpu == 500  # 50 santicores -> 500 millicores
+    assert pod.spec.resources.requests.ram == int(0.25 * DENORMALIZATION_BASE)
+    assert pod.spec.running_duration == 56.0
+
+
+def test_cluster_conversion_add_and_errors():
+    """`add` creates; soft/hard errors remove once; ghost removals skipped
+    (reference: cluster.rs:128-201)."""
+    events = read_machine_events(
+        "\n".join(
+            [
+                "10,1,add,,64,0.69",
+                "20,2,add,,32,0.5",
+                "30,1,softerror,disk,,",
+                "40,1,harderror,disk,,",  # already removed - dedup
+                "50,99,softerror,agent,,",  # ghost node - skip
+            ]
+        )
+    )
+    trace = AlibabaClusterTraceV2017(events)
+    converted = trace.convert_to_simulator_events()
+    assert len(converted) == 3
+    assert isinstance(converted[0][1], CreateNodeRequest)
+    node = converted[0][1].node
+    assert node.metadata.name == "alibaba_node_1"
+    assert node.status.capacity.cpu == 64 * CPU_BASE
+    assert node.status.capacity.ram == int(0.69 * DENORMALIZATION_BASE)
+    assert isinstance(converted[2][1], RemoveNodeRequest)
+    assert converted[2][1].node_name == "alibaba_node_1"
+
+
+def test_unknown_machine_event_type_raises():
+    trace = AlibabaClusterTraceV2017(read_machine_events("10,1,explode,,64,0.69\n"))
+    with pytest.raises(ValueError):
+        trace.convert_to_simulator_events()
+
+
+def test_generators():
+    from kubernetriks_tpu.trace.generator import (
+        PoissonWorkloadTrace,
+        SyntheticWorkloadTrace,
+        UniformClusterTrace,
+    )
+
+    workload = SyntheticWorkloadTrace(pod_count=100, seed=1)
+    events = workload.convert_to_simulator_events()
+    assert len(events) == 100
+    assert all(events[i][0] <= events[i + 1][0] for i in range(99))
+
+    # Same seed -> identical trace.
+    again = SyntheticWorkloadTrace(pod_count=100, seed=1).convert_to_simulator_events()
+    assert [(ts, e.pod.metadata.name, e.pod.spec.resources.requests.cpu) for ts, e in events] == [
+        (ts, e.pod.metadata.name, e.pod.spec.resources.requests.cpu) for ts, e in again
+    ]
+
+    poisson = PoissonWorkloadTrace(rate_per_second=1.0, horizon=100.0, seed=2)
+    pevents = poisson.convert_to_simulator_events()
+    assert 50 < len(pevents) < 200
+    cluster = UniformClusterTrace(10)
+    assert len(cluster.convert_to_simulator_events()) == 10
