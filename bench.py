@@ -1,0 +1,86 @@
+"""Headline benchmark: pod-scheduling decisions/second on the batched backend.
+
+Prints ONE JSON line:
+  {"metric": "...", "value": N, "unit": "...", "vs_baseline": N}
+
+The reference publishes no benchmark numbers (BASELINE.md); vs_baseline is
+measured against the driver-set north star of 1M decisions/s on a v5e-8,
+i.e. 125k decisions/s per chip (BASELINE.json).
+
+Scenario: 1024 simulated 64-node clusters, Poisson pod arrivals (2 pods/s for
+1000 s, ~2k pods per cluster), default kube-scheduler filter/score, stepped in
+20-window device chunks.
+"""
+
+import json
+import sys
+import time
+
+import jax
+
+BASELINE_DECISIONS_PER_SEC_PER_CHIP = 1_000_000 / 8
+
+
+def main() -> None:
+    from kubernetriks_tpu.batched.engine import build_batched_from_traces
+    from kubernetriks_tpu.config import SimulationConfig
+    from kubernetriks_tpu.trace.generator import (
+        PoissonWorkloadTrace,
+        UniformClusterTrace,
+    )
+
+    config = SimulationConfig.from_yaml(
+        "sim_name: bench\nseed: 1\nscheduling_cycle_interval: 10.0"
+    )
+    cluster = UniformClusterTrace(64, cpu=64000, ram=128 * 1024**3)
+    workload = PoissonWorkloadTrace(
+        rate_per_second=2.0,
+        horizon=1000.0,
+        seed=3,
+        cpu=4000,
+        ram=8 * 1024**3,
+        duration_range=(30.0, 120.0),
+    )
+    n_clusters = 1024
+    sim = build_batched_from_traces(
+        config,
+        cluster.convert_to_simulator_events(),
+        workload.convert_to_simulator_events(),
+        n_clusters=n_clusters,
+        max_pods_per_cycle=64,
+    )
+
+    # Warm-up: 0..190 is 20 windows — the exact chunk shape the timed loop
+    # dispatches, so no compilation happens inside the measured region.
+    sim.step_until_time(190.0)
+    jax.block_until_ready(sim.state.time)
+    decisions_before = sim.metrics_summary()["counters"]["scheduling_decisions"]
+
+    t0 = time.perf_counter()
+    end = 390.0
+    while end <= 1200.0:
+        sim.step_until_time(end)  # 20-window chunks
+        end += 200.0
+    jax.block_until_ready(sim.state.time)
+    elapsed = time.perf_counter() - t0
+
+    summary = sim.metrics_summary()
+    decisions = summary["counters"]["scheduling_decisions"] - decisions_before
+    decisions_per_sec = decisions / elapsed
+
+    print(
+        json.dumps(
+            {
+                "metric": "pod-scheduling decisions/sec (single chip, 1024x64-node clusters)",
+                "value": round(decisions_per_sec),
+                "unit": "decisions/s",
+                "vs_baseline": round(
+                    decisions_per_sec / BASELINE_DECISIONS_PER_SEC_PER_CHIP, 3
+                ),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,280 @@
+"""BatchedSimulation: the user-facing driver for the vectorized path.
+
+Compiles traces to slabs, builds the dense state, steps whole batches of
+clusters through scheduling-cycle windows on-device, and reduces metrics to
+the same summary shape the scalar MetricsCollector prints.
+
+Sharding: all state arrays lead with the cluster axis C; `mesh` shards that
+axis across devices (pure data parallelism over simulated clusters — each
+cluster is independent, so the step needs no cross-device collectives; metric
+reduction at readout is the only communication).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from kubernetriks_tpu.batched.state import (
+    DEFAULT_RAM_UNIT,
+    PHASE_QUEUED,
+    PHASE_RUNNING,
+    PHASE_UNSCHEDULABLE,
+    TraceSlab,
+    init_state,
+    make_step_constants,
+)
+from kubernetriks_tpu.batched.step import run_windows, window_step
+from kubernetriks_tpu.batched.trace_compile import (
+    CompiledClusterTrace,
+    compile_cluster_trace,
+    pad_and_batch,
+)
+from kubernetriks_tpu.config import SimulationConfig
+
+
+class BatchedSimulation:
+    def __init__(
+        self,
+        config: SimulationConfig,
+        compiled_traces: Sequence[CompiledClusterTrace],
+        ram_unit: int = DEFAULT_RAM_UNIT,
+        max_events_per_window: Optional[int] = None,
+        max_pods_per_cycle: Optional[int] = None,
+        mesh: Optional[Mesh] = None,
+        batch_axis: str = "clusters",
+    ) -> None:
+        self.config = config
+        if config.enable_unscheduled_pods_conditional_move:
+            raise NotImplementedError(
+                "enable_unscheduled_pods_conditional_move is not yet supported "
+                "on the batched path (it always applies the reference's "
+                "default flush-all policy); use the scalar path for "
+                "conditional-move configs"
+            )
+        self.consts = make_step_constants(config)
+        self.ram_unit = ram_unit
+        C = len(compiled_traces)
+
+        (
+            ev_time,
+            ev_kind,
+            ev_slot,
+            node_cap_cpu,
+            node_cap_ram,
+            pod_req_cpu,
+            pod_req_ram,
+            pod_duration,
+        ) = pad_and_batch(compiled_traces)
+
+        self.n_clusters = C
+        self.n_nodes = node_cap_cpu.shape[1]
+        self.n_pods = pod_req_cpu.shape[1]
+        self.n_events = ev_time.shape[1]
+
+        # Cap per-window event work: worst-case events falling in one window.
+        if max_events_per_window is None:
+            max_events_per_window = self._max_events_in_any_window(ev_time)
+        self.max_events_per_window = max(1, max_events_per_window)
+        # Cap per-cycle scheduling work (the scalar path drains the queue
+        # unboundedly, reference scheduler.rs:261; the batched path bounds each
+        # cycle and catches up next cycle).
+        self.max_pods_per_cycle = max(1, max_pods_per_cycle or self.n_pods)
+
+        self.state = init_state(
+            C,
+            self.n_nodes,
+            self.n_pods,
+            node_cap_cpu,
+            node_cap_ram,
+            pod_req_cpu,
+            pod_req_ram,
+            pod_duration,
+        )
+        self.slab = TraceSlab(
+            time=jnp.asarray(ev_time),
+            kind=jnp.asarray(ev_kind),
+            slot=jnp.asarray(ev_slot),
+        )
+        self.node_names = [c.node_names for c in compiled_traces]
+        self.pod_names = [c.pod_names for c in compiled_traces]
+        self.next_window = 0.0
+
+        self.mesh = mesh
+        if mesh is not None:
+            sharding = NamedSharding(mesh, PartitionSpec(batch_axis))
+            self.state = jax.device_put(self.state, self._state_shardings(sharding))
+            self.slab = jax.device_put(
+                self.slab, NamedSharding(mesh, PartitionSpec(batch_axis, None))
+            )
+
+    def _state_shardings(self, sharding):
+        """Every leaf leads with the C axis; shard axis 0, replicate the rest."""
+
+        def leaf_sharding(leaf):
+            spec = PartitionSpec(
+                *([sharding.spec[0]] + [None] * (leaf.ndim - 1))
+            )
+            return NamedSharding(sharding.mesh, spec)
+
+        return jax.tree.map(leaf_sharding, self.state)
+
+    def _max_events_in_any_window(self, ev_time: np.ndarray) -> int:
+        """Worst-case events falling into one (cluster, scheduling-window)
+        bucket — the static per-window event budget."""
+        interval = self.config.scheduling_cycle_interval
+        rows, cols = np.nonzero(np.isfinite(ev_time))
+        if rows.size == 0:
+            return 1
+        win = np.floor_divide(ev_time[rows, cols], interval).astype(np.int64)
+        keys = rows * (win.max() + 2) + win
+        _, per_key = np.unique(keys, return_counts=True)
+        return int(per_key.max())
+
+    # --- stepping -----------------------------------------------------------
+
+    def window_times(self, until_time: float) -> np.ndarray:
+        """Scheduling-cycle times in (next_window, until_time], starting at 0
+        like the scalar scheduler.start()."""
+        interval = self.config.scheduling_cycle_interval
+        first = self.next_window
+        count = int(math.floor((until_time - first) / interval)) + 1
+        return first + np.arange(max(count, 0)) * interval
+
+    def step_until_time(self, until_time: float) -> None:
+        windows = self.window_times(until_time)
+        if len(windows) == 0:
+            return
+        self.state = run_windows(
+            self.state,
+            self.slab,
+            jnp.asarray(windows, self.state.time.dtype),
+            self.consts,
+            self.max_events_per_window,
+            self.max_pods_per_cycle,
+        )
+        self.next_window = float(windows[-1]) + self.config.scheduling_cycle_interval
+
+    def step_window(self) -> None:
+        """Advance a single scheduling cycle (useful for tests)."""
+        self.state = window_step(
+            self.state,
+            self.slab,
+            jnp.asarray(self.next_window, self.state.time.dtype),
+            self.consts,
+            self.max_events_per_window,
+            self.max_pods_per_cycle,
+        )
+        self.next_window += self.config.scheduling_cycle_interval
+
+    def run_to_completion(self, max_time: float = 1e7) -> None:
+        """Step until every trace pod has terminated (scalar equivalent:
+        RunUntilAllPodsAreFinishedCallbacks), bounded by max_time."""
+        interval = self.config.scheduling_cycle_interval
+        chunk = max(64, self.max_events_per_window)
+        finite = self.slab.time[jnp.isfinite(self.slab.time)]
+        last_event_time = float(finite.max()) if finite.size else 0.0
+        while True:
+            self.step_until_time(self.next_window + chunk * interval)
+            # Never conclude before the trace is fully applied: EMPTY slots may
+            # still be waiting on future CreatePod events.
+            if self.next_window <= last_event_time:
+                continue
+            phases = np.asarray(self.state.pods.phase)
+            durations = np.asarray(self.state.pods.duration)
+            # Finite-duration pods not yet terminal?
+            live = (
+                ((phases == PHASE_QUEUED) | (phases == PHASE_UNSCHEDULABLE))
+                | ((phases == PHASE_RUNNING) & (durations >= 0))
+            )
+            if not live.any():
+                return
+            if self.next_window > max_time:
+                raise RuntimeError(
+                    f"run_to_completion exceeded max_time={max_time}; "
+                    f"{int(live.sum())} pods still live"
+                )
+
+    # --- readout ------------------------------------------------------------
+
+    def metrics_summary(self) -> Dict:
+        """Cross-cluster reduction into the scalar printer's shape."""
+        m = self.state.metrics
+
+        def est(e):
+            count = np.asarray(e.count, np.int64)
+            total = np.asarray(e.total, np.float64)
+            total_sq = np.asarray(e.total_sq, np.float64)
+            n = count.sum()
+            if n == 0:
+                return {"min": math.inf, "max": -math.inf, "mean": math.nan, "variance": math.nan}
+            mean = total.sum() / n
+            return {
+                "min": float(np.asarray(e.minimum).min()),
+                "max": float(np.asarray(e.maximum).max()),
+                "mean": float(mean),
+                "variance": float(total_sq.sum() / n - mean * mean),
+            }
+
+        return {
+            "counters": {
+                "pods_succeeded": int(np.asarray(m.pods_succeeded).sum()),
+                "pods_removed": int(np.asarray(m.pods_removed).sum()),
+                "terminated_pods": int(np.asarray(m.terminated_pods).sum()),
+                "processed_nodes": int(np.asarray(m.processed_nodes).sum()),
+                "scheduling_decisions": int(np.asarray(m.scheduling_decisions).sum()),
+            },
+            "timings": {
+                "pod_duration": est(m.pod_duration),
+                "pod_schedule_time": est(m.algo_latency),
+                "pod_queue_time": est(m.queue_time),
+            },
+        }
+
+    def cluster_metrics(self, cluster: int) -> Dict:
+        m = self.state.metrics
+        return {
+            "pods_succeeded": int(m.pods_succeeded[cluster]),
+            "pods_removed": int(m.pods_removed[cluster]),
+            "terminated_pods": int(m.terminated_pods[cluster]),
+            "scheduling_decisions": int(m.scheduling_decisions[cluster]),
+        }
+
+    def pod_view(self, cluster: int) -> Dict[str, Dict]:
+        """Name-keyed pod states for equivalence tests against the scalar path."""
+        phases = np.asarray(self.state.pods.phase[cluster])
+        nodes = np.asarray(self.state.pods.node[cluster])
+        starts = np.asarray(self.state.pods.start_time[cluster])
+        names = self.pod_names[cluster]
+        node_names = self.node_names[cluster]
+        out = {}
+        for slot, name in enumerate(names):
+            out[name] = {
+                "phase": int(phases[slot]),
+                "node": node_names[nodes[slot]] if nodes[slot] >= 0 else None,
+                "start_time": float(starts[slot]),
+            }
+        return out
+
+
+def build_batched_from_traces(
+    config: SimulationConfig,
+    cluster_events,
+    workload_events,
+    n_clusters: int = 1,
+    **kwargs,
+) -> BatchedSimulation:
+    """Replicate one (cluster trace, workload trace) pair across n_clusters —
+    the homogeneous-batch benchmark shape."""
+    compiled = compile_cluster_trace(
+        cluster_events,
+        workload_events,
+        config,
+        ram_unit=kwargs.pop("ram_unit", DEFAULT_RAM_UNIT),
+    )
+    return BatchedSimulation(config, [compiled] * n_clusters, **kwargs)

@@ -1,0 +1,229 @@
+"""Dense array state for the batched (vectorized) simulation path.
+
+This is the TPU-native reformulation of the reference's actor state
+(reference: src/core/{api_server,persistent_storage,scheduler,node_component}.rs
+hold overlapping per-object maps; here the consistent merged view lives in
+arrays of shape (clusters, nodes) / (clusters, pods)).
+
+Design rules:
+- Static shapes: N_max node slots and P_max pod slots per cluster, pre-sized
+  from the trace like the reference's node pool (reference: src/simulator.rs:51-65).
+- All payloads (capacities, requests, durations) are pre-staged per slot at
+  trace-compile time; on-device events only flip phases/masks. Strings never
+  reach the device.
+- cpu is int32 millicores; ram is quantized to RAM_UNIT-byte units (ceil for
+  requests, floor for capacity) so int32 never overflows and the batched path
+  never overcommits relative to the byte-exact scalar path.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+# Pod phases.
+PHASE_EMPTY = 0  # slot not yet created
+PHASE_QUEUED = 1  # in the scheduler's active queue
+PHASE_UNSCHEDULABLE = 2  # parked in the unschedulable queue
+PHASE_RUNNING = 3  # bound to a node (incl. binding in flight)
+PHASE_SUCCEEDED = 4
+PHASE_REMOVED = 5
+PHASE_FAILED = 6
+
+# Event kinds in the compiled trace slab.
+EV_NONE = 0
+EV_CREATE_NODE = 1
+EV_REMOVE_NODE = 2
+EV_CREATE_POD = 3
+EV_REMOVE_POD = 4
+
+DEFAULT_RAM_UNIT = 1024 * 1024  # 1 MiB
+
+INF = jnp.inf
+
+
+class NodeArrays(NamedTuple):
+    """(C, N) per-node-slot arrays."""
+
+    alive: jnp.ndarray  # bool
+    cap_cpu: jnp.ndarray  # int32 millicores
+    cap_ram: jnp.ndarray  # int32 ram units
+    alloc_cpu: jnp.ndarray  # int32
+    alloc_ram: jnp.ndarray  # int32
+
+
+class PodArrays(NamedTuple):
+    """(C, P) per-pod-slot arrays."""
+
+    phase: jnp.ndarray  # int32
+    req_cpu: jnp.ndarray  # int32 millicores
+    req_ram: jnp.ndarray  # int32 ram units
+    duration: jnp.ndarray  # float32 seconds; <0 means long-running service
+    queue_ts: jnp.ndarray  # float32: queue-priority / eligibility timestamp
+    queue_seq: jnp.ndarray  # int32: FIFO tie-break within equal timestamps
+    initial_attempt_ts: jnp.ndarray  # float32
+    attempts: jnp.ndarray  # int32
+    node: jnp.ndarray  # int32 node slot, -1 = none
+    start_time: jnp.ndarray  # float32
+    finish_time: jnp.ndarray  # float32, +inf = no pending finish
+
+
+class EstArrays(NamedTuple):
+    """(C,) streaming estimator accumulators -> min/max/mean/variance at readout
+    (mirrors the scalar Estimator, kubernetriks_tpu/metrics/collector.py)."""
+
+    count: jnp.ndarray  # int32
+    total: jnp.ndarray  # float32 sum
+    total_sq: jnp.ndarray  # float32 sum of squares
+    minimum: jnp.ndarray  # float32
+    maximum: jnp.ndarray  # float32
+
+    @staticmethod
+    def zeros(shape) -> "EstArrays":
+        return EstArrays(
+            count=jnp.zeros(shape, jnp.int32),
+            total=jnp.zeros(shape, jnp.float32),
+            total_sq=jnp.zeros(shape, jnp.float32),
+            minimum=jnp.full(shape, INF, jnp.float32),
+            maximum=jnp.full(shape, -INF, jnp.float32),
+        )
+
+    def add(self, value: jnp.ndarray, mask: jnp.ndarray) -> "EstArrays":
+        value = value.astype(jnp.float32)
+        return EstArrays(
+            count=self.count + mask.astype(jnp.int32),
+            total=self.total + jnp.where(mask, value, 0.0),
+            total_sq=self.total_sq + jnp.where(mask, value * value, 0.0),
+            minimum=jnp.where(mask, jnp.minimum(self.minimum, value), self.minimum),
+            maximum=jnp.where(mask, jnp.maximum(self.maximum, value), self.maximum),
+        )
+
+
+class MetricArrays(NamedTuple):
+    """(C,) per-cluster counters (mirrors AccumulatedMetrics)."""
+
+    pods_succeeded: jnp.ndarray  # int32
+    pods_removed: jnp.ndarray  # int32
+    terminated_pods: jnp.ndarray  # int32
+    processed_nodes: jnp.ndarray  # int32
+    scheduling_decisions: jnp.ndarray  # int32: successful assignments (bench metric)
+    queue_time: EstArrays
+    algo_latency: EstArrays
+    pod_duration: EstArrays
+
+
+class ClusterBatchState(NamedTuple):
+    """Complete batched simulation state; a pytree of arrays with leading
+    cluster axis C, shardable across a device mesh on that axis."""
+
+    time: jnp.ndarray  # (C,) float32 current simulation time
+    queue_seq_counter: jnp.ndarray  # (C,) int32 next queue sequence number
+    event_cursor: jnp.ndarray  # (C,) int32 next unapplied trace event
+    last_flush_time: jnp.ndarray  # (C,) float32 last unschedulable-leftover flush
+    requeue_signal: jnp.ndarray  # (C,) bool: node-add/pod-finish since last cycle
+    nodes: NodeArrays
+    pods: PodArrays
+    metrics: MetricArrays
+
+
+class TraceSlab(NamedTuple):
+    """(C, E) compiled trace events, time-sorted per cluster, padded with
+    EV_NONE/time=+inf."""
+
+    time: jnp.ndarray  # float32
+    kind: jnp.ndarray  # int32
+    slot: jnp.ndarray  # int32 (node slot for node events, pod slot for pod events)
+
+
+class StepConstants(NamedTuple):
+    """Static per-run scalars derived from SimulationConfig; the control-plane
+    hop delays of the scalar path composed into effective offsets
+    (reference chains: SURVEY.md §3.2/3.4)."""
+
+    scheduling_interval: float
+    time_per_node: float  # scheduler latency model (reference: model.rs 1us)
+    delta_pod_enqueue: float  # create -> pod in scheduler queue
+    delta_bind_start: float  # assignment (incl. cycle duration) -> pod starts
+    delta_reschedule: float  # node removal -> its pods re-enqueued
+    flush_interval: float  # 30 s (reference: queue.rs:11)
+    max_unschedulable_stay: float  # 300 s (reference: queue.rs:8)
+    conditional_move: bool
+
+
+def make_step_constants(config) -> StepConstants:
+    """Compose effective delays from the six config delays, mirroring the event
+    chains of the scalar path (SURVEY.md §3.2: eleven hops pod lifecycle)."""
+    return StepConstants(
+        scheduling_interval=config.scheduling_cycle_interval,
+        time_per_node=1e-6,
+        delta_pod_enqueue=config.as_to_ps_network_delay
+        + config.ps_to_sched_network_delay,
+        delta_bind_start=config.sched_to_as_network_delay
+        + 2.0 * config.as_to_ps_network_delay
+        + config.as_to_node_network_delay,
+        # Relative to the (already-shifted) node-removal effect time: the
+        # NodeRemovedFromCluster -> api server -> storage -> scheduler chain.
+        delta_reschedule=config.as_to_node_network_delay
+        + config.as_to_ps_network_delay
+        + config.ps_to_sched_network_delay,
+        flush_interval=30.0,
+        max_unschedulable_stay=300.0,
+        conditional_move=config.enable_unscheduled_pods_conditional_move,
+    )
+
+
+def init_state(
+    n_clusters: int,
+    n_nodes: int,
+    n_pods: int,
+    node_cap_cpu: np.ndarray,
+    node_cap_ram: np.ndarray,
+    pod_req_cpu: np.ndarray,
+    pod_req_ram: np.ndarray,
+    pod_duration: np.ndarray,
+) -> ClusterBatchState:
+    """Build the initial state with pre-staged payloads (all slots start
+    EMPTY/dead; trace events bring them to life)."""
+    C, N, P = n_clusters, n_nodes, n_pods
+    nodes = NodeArrays(
+        alive=jnp.zeros((C, N), bool),
+        cap_cpu=jnp.asarray(node_cap_cpu, jnp.int32),
+        cap_ram=jnp.asarray(node_cap_ram, jnp.int32),
+        alloc_cpu=jnp.asarray(node_cap_cpu, jnp.int32),
+        alloc_ram=jnp.asarray(node_cap_ram, jnp.int32),
+    )
+    pods = PodArrays(
+        phase=jnp.zeros((C, P), jnp.int32),
+        req_cpu=jnp.asarray(pod_req_cpu, jnp.int32),
+        req_ram=jnp.asarray(pod_req_ram, jnp.int32),
+        duration=jnp.asarray(pod_duration, jnp.float32),
+        queue_ts=jnp.zeros((C, P), jnp.float32),
+        queue_seq=jnp.zeros((C, P), jnp.int32),
+        initial_attempt_ts=jnp.zeros((C, P), jnp.float32),
+        attempts=jnp.zeros((C, P), jnp.int32),
+        node=jnp.full((C, P), -1, jnp.int32),
+        start_time=jnp.zeros((C, P), jnp.float32),
+        finish_time=jnp.full((C, P), INF, jnp.float32),
+    )
+    metrics = MetricArrays(
+        pods_succeeded=jnp.zeros((C,), jnp.int32),
+        pods_removed=jnp.zeros((C,), jnp.int32),
+        terminated_pods=jnp.zeros((C,), jnp.int32),
+        processed_nodes=jnp.zeros((C,), jnp.int32),
+        scheduling_decisions=jnp.zeros((C,), jnp.int32),
+        queue_time=EstArrays.zeros((C,)),
+        algo_latency=EstArrays.zeros((C,)),
+        pod_duration=EstArrays.zeros((C,)),
+    )
+    return ClusterBatchState(
+        time=jnp.zeros((C,), jnp.float32),
+        queue_seq_counter=jnp.zeros((C,), jnp.int32),
+        event_cursor=jnp.zeros((C,), jnp.int32),
+        last_flush_time=jnp.zeros((C,), jnp.float32),
+        requeue_signal=jnp.zeros((C,), bool),
+        nodes=nodes,
+        pods=pods,
+        metrics=metrics,
+    )

@@ -1,0 +1,452 @@
+"""The vectorized window step: trace-event application + pod finishes + one
+scheduling cycle, over a whole batch of clusters at once.
+
+This replaces the scalar event loop (reference: src/simulator.rs:355-372 pops
+one event at a time) with array programs:
+
+- Each control-plane hop of the reference becomes a time-shifted effect
+  (SURVEY.md §5.8); the compiler pre-shifts event times to their effect times.
+- Pod completions are precomputed finish times invalidated by masks (replacing
+  DSLab cancel_event, reference: src/core/node_component.rs:102-104).
+- Event application is BULK: the window's slab segment is gathered once per
+  cluster, node/pod removal times become scatter-min arrays, and the
+  finish-vs-removal interleaving is resolved elementwise per pod by comparing
+  finish_time against min(window_end, node_removal_time, pod_removal_time) —
+  ordering fidelity without a per-event loop.
+- The kube-scheduler cycle is a COMPACTED sequential scan: the queue is sorted
+  by (queue_ts, queue_seq) — identical to the scalar ActiveQueue's
+  (timestamp, insertion seq) min-heap — the top-K candidates are gathered to
+  (C, K) arrays, the scan updates only (C, N) allocatables per step (Fit mask +
+  LeastAllocatedResources score + last-wins argmax, reference semantics:
+  src/core/scheduler/kube_scheduler.rs:63-152, plugin.rs:33-63), and results
+  scatter back to (C, P) once.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from kubernetriks_tpu.batched.state import (
+    ClusterBatchState,
+    EstArrays,
+    EV_CREATE_NODE,
+    EV_CREATE_POD,
+    EV_REMOVE_NODE,
+    EV_REMOVE_POD,
+    PHASE_QUEUED,
+    PHASE_REMOVED,
+    PHASE_RUNNING,
+    PHASE_SUCCEEDED,
+    PHASE_UNSCHEDULABLE,
+    StepConstants,
+    TraceSlab,
+)
+
+INF = jnp.inf
+
+
+def _est_add_reduced(est: EstArrays, values: jnp.ndarray, mask: jnp.ndarray) -> EstArrays:
+    """Fold a (C, P) masked batch of samples into (C,) estimator accumulators."""
+    values = values.astype(jnp.float32)
+    maskf = mask.astype(jnp.float32)
+    return EstArrays(
+        count=est.count + mask.sum(axis=1).astype(jnp.int32),
+        total=est.total + (values * maskf).sum(axis=1),
+        total_sq=est.total_sq + (values * values * maskf).sum(axis=1),
+        minimum=jnp.minimum(est.minimum, jnp.where(mask, values, INF).min(axis=1)),
+        maximum=jnp.maximum(est.maximum, jnp.where(mask, values, -INF).max(axis=1)),
+    )
+
+
+def _apply_window_events(
+    state: ClusterBatchState,
+    slab: TraceSlab,
+    window_end: jnp.ndarray,
+    consts: StepConstants,
+    max_events_per_window: int,
+) -> ClusterBatchState:
+    """Apply every trace event with effect time STRICTLY before window_end, and
+    resolve all pod finishes due in the window.
+
+    Strictness: an effect landing exactly at cycle time T is processed after
+    the cycle in the scalar kernel (older-event-id-first FIFO), so it belongs
+    to the next window.
+    """
+    pods, nodes, metrics = state.pods, state.nodes, state.metrics
+    C, P = pods.phase.shape
+    N = nodes.alive.shape[1]
+    E_total = slab.time.shape[1]
+    E = max_events_per_window
+    rows1 = jnp.arange(C)
+    rows = rows1[:, None]
+
+    # Gather this window's slab segment: (C, E) starting at each cursor.
+    offs = state.event_cursor[:, None] + jnp.arange(E)[None, :]
+    offs_c = jnp.clip(offs, 0, E_total - 1)
+    ev_t = slab.time[rows, offs_c]
+    ev_k = slab.kind[rows, offs_c]
+    ev_s = slab.slot[rows, offs_c]
+    valid = (offs < E_total) & (ev_t < window_end[:, None])
+
+    is_cn = valid & (ev_k == EV_CREATE_NODE)
+    is_rn = valid & (ev_k == EV_REMOVE_NODE)
+    is_cp = valid & (ev_k == EV_CREATE_POD)
+    is_rp = valid & (ev_k == EV_REMOVE_POD)
+
+    # Scatter helpers: out-of-range slot drops the write.
+    def drop_slot(mask, width):
+        return jnp.where(mask, ev_s, width)
+
+    # --- node creations -----------------------------------------------------
+    created = (
+        jnp.zeros((C, N), bool).at[rows, drop_slot(is_cn, N)].set(True, mode="drop")
+    )
+    # --- node removal times (scatter-min; +inf = not removed this window) ---
+    node_removal = (
+        jnp.full((C, N), INF)
+        .at[rows, drop_slot(is_rn, N)]
+        .min(jnp.where(is_rn, ev_t, INF), mode="drop")
+    )
+    # --- pod creations ------------------------------------------------------
+    pod_create_ts = (
+        jnp.full((C, P), INF)
+        .at[rows, drop_slot(is_cp, P)]
+        .min(jnp.where(is_cp, ev_t, INF), mode="drop")
+    )
+    # Queue sequence numbers follow slab (== emission) order.
+    create_rank = jnp.cumsum(is_cp, axis=1) - 1
+    pod_create_seq = (
+        jnp.zeros((C, P), jnp.int32)
+        .at[rows, drop_slot(is_cp, P)]
+        .max(
+            jnp.where(is_cp, state.queue_seq_counter[:, None] + create_rank, 0),
+            mode="drop",
+        )
+    )
+    n_creates = is_cp.sum(axis=1).astype(jnp.int32)
+    # --- pod removal times --------------------------------------------------
+    pod_removal = (
+        jnp.full((C, P), INF)
+        .at[rows, drop_slot(is_rp, P)]
+        .min(jnp.where(is_rp, ev_t, INF), mode="drop")
+    )
+
+    # --- apply creations ----------------------------------------------------
+    alive = nodes.alive | created
+    alloc_cpu = jnp.where(created, nodes.cap_cpu, nodes.alloc_cpu)
+    alloc_ram = jnp.where(created, nodes.cap_ram, nodes.alloc_ram)
+
+    was_empty_created = (pods.phase == 0) & (pod_create_ts < INF)
+    enqueue_ts = pod_create_ts + consts.delta_pod_enqueue
+    phase = jnp.where(was_empty_created, PHASE_QUEUED, pods.phase)
+    queue_ts = jnp.where(was_empty_created, enqueue_ts, pods.queue_ts)
+    queue_seq = jnp.where(was_empty_created, pod_create_seq, pods.queue_seq)
+    initial_attempt_ts = jnp.where(
+        was_empty_created, enqueue_ts, pods.initial_attempt_ts
+    )
+    attempts = jnp.where(was_empty_created, 1, pods.attempts)
+
+    # --- resolve running pods: finish vs node removal vs pod removal --------
+    running = phase == PHASE_RUNNING
+    node_idx = jnp.clip(pods.node, 0, None)
+    pod_node_removal = jnp.where(
+        pods.node >= 0, node_removal[rows, node_idx], INF
+    )
+    cutoff = jnp.minimum(
+        jnp.minimum(window_end[:, None], pod_node_removal), pod_removal
+    )
+    finishes = running & (pods.finish_time <= cutoff)
+    interrupted = running & ~finishes
+    rescheds = interrupted & (pod_node_removal < pod_removal)
+    removed_running = interrupted & (pod_removal <= pod_node_removal) & (pod_removal < INF)
+
+    # Free resources of finished and removed-while-running pods (a dead node's
+    # allocatable is irrelevant; slots are never reused).
+    freed = finishes | removed_running
+    alloc_cpu = alloc_cpu.at[rows, node_idx].add(jnp.where(freed, pods.req_cpu, 0))
+    alloc_ram = alloc_ram.at[rows, node_idx].add(jnp.where(freed, pods.req_ram, 0))
+
+    # Finished pods.
+    n_done = finishes.sum(axis=1).astype(jnp.int32)
+    metrics = metrics._replace(
+        pods_succeeded=metrics.pods_succeeded + n_done,
+        terminated_pods=metrics.terminated_pods + n_done,
+        pod_duration=_est_add_reduced(metrics.pod_duration, pods.duration, finishes),
+        processed_nodes=metrics.processed_nodes + is_cn.sum(axis=1).astype(jnp.int32),
+    )
+    phase = jnp.where(finishes, PHASE_SUCCEEDED, phase)
+    finish_time = jnp.where(finishes, INF, pods.finish_time)
+
+    # Reschedule pods of removed nodes (reference: scheduler.rs:336-364; slot
+    # order stands in for the scalar sorted-name order).
+    resched_rank = jnp.cumsum(rescheds, axis=1) - 1
+    resched_ts = pod_node_removal + consts.delta_reschedule
+    phase = jnp.where(rescheds, PHASE_QUEUED, phase)
+    queue_ts = jnp.where(rescheds, resched_ts, queue_ts)
+    queue_seq = jnp.where(
+        rescheds, state.queue_seq_counter[:, None] + n_creates[:, None] + resched_rank,
+        queue_seq,
+    )
+    initial_attempt_ts = jnp.where(rescheds, resched_ts, initial_attempt_ts)
+    attempts = jnp.where(rescheds, 1, attempts)
+    finish_time = jnp.where(rescheds, INF, finish_time)
+    pod_node = jnp.where(rescheds, -1, pods.node)
+    n_rescheds = rescheds.sum(axis=1).astype(jnp.int32)
+
+    # Removed-while-running pods terminate as removed
+    # (reference: api_server.rs PodRemovedFromNode removed=true accounting).
+    n_removed_running = removed_running.sum(axis=1).astype(jnp.int32)
+    metrics = metrics._replace(
+        pods_removed=metrics.pods_removed + n_removed_running,
+        terminated_pods=metrics.terminated_pods + n_removed_running,
+    )
+    phase = jnp.where(removed_running, PHASE_REMOVED, phase)
+    finish_time = jnp.where(removed_running, INF, finish_time)
+
+    # Removal of queued/unschedulable (or just-created) pods: dropped from the
+    # queues with NO removed/terminated metrics (scalar parity: only
+    # PodRemovedFromNode(removed=true) counts, reference: api_server.rs:345-368).
+    removed_queued = (
+        ((phase == PHASE_QUEUED) | (phase == PHASE_UNSCHEDULABLE))
+        & (pod_removal < INF)
+        & ~removed_running
+    )
+    phase = jnp.where(removed_queued, PHASE_REMOVED, phase)
+
+    # Kill removed nodes AFTER pod resolution (resolution reads pre-window
+    # alive only via pods.node indices, which is removal-independent).
+    alive = alive & ~(node_removal < INF)
+
+    applied = valid.sum(axis=1).astype(jnp.int32)
+    any_created_node = is_cn.any(axis=1)
+
+    return state._replace(
+        nodes=nodes._replace(alive=alive, alloc_cpu=alloc_cpu, alloc_ram=alloc_ram),
+        pods=pods._replace(
+            phase=phase,
+            queue_ts=queue_ts,
+            queue_seq=queue_seq,
+            initial_attempt_ts=initial_attempt_ts,
+            attempts=attempts,
+            node=pod_node,
+            finish_time=finish_time,
+        ),
+        metrics=metrics,
+        event_cursor=state.event_cursor + applied,
+        queue_seq_counter=state.queue_seq_counter + n_creates + n_rescheds,
+        # Events of interest wake the unschedulable queue (flush-all policy,
+        # reference: scheduler.rs:391-410,435-440,445-473).
+        requeue_signal=state.requeue_signal
+        | any_created_node
+        | (n_done > 0)
+        | (n_removed_running > 0),
+        time=jnp.maximum(state.time, window_end),
+    )
+
+
+def _run_scheduling_cycle(
+    state: ClusterBatchState,
+    T: jnp.ndarray,
+    consts: StepConstants,
+    max_pods_per_cycle: int,
+) -> ClusterBatchState:
+    """One vectorized kube-scheduler cycle at time T for every cluster
+    (scalar equivalent: reference scheduler.rs:246-333)."""
+    C, P = state.pods.phase.shape
+    N = state.nodes.alive.shape[1]
+    K = max_pods_per_cycle
+    rows1 = jnp.arange(C)
+    rows = rows1[:, None]
+
+    pods = state.pods
+
+    # Unschedulable-leftover flush at the 30 s cadence
+    # (reference: scheduler.rs:188-203).
+    flush_now = (T - state.last_flush_time) >= consts.flush_interval
+    stale = (
+        (pods.phase == PHASE_UNSCHEDULABLE)
+        & (T[:, None] - pods.queue_ts > consts.max_unschedulable_stay)
+        & flush_now[:, None]
+    )
+    wake = state.requeue_signal[:, None] & (pods.phase == PHASE_UNSCHEDULABLE)
+    to_move = stale | wake
+    pods = pods._replace(
+        phase=jnp.where(to_move, PHASE_QUEUED, pods.phase),
+        attempts=pods.attempts + to_move.astype(jnp.int32),
+    )
+    last_flush_time = jnp.where(flush_now, T, state.last_flush_time)
+
+    # Queue order: (queue_ts, queue_seq); eligible = queued strictly before T.
+    eligible = (pods.phase == PHASE_QUEUED) & (pods.queue_ts < T[:, None])
+    sort_ts = jnp.where(eligible, pods.queue_ts, INF)
+    sort_seq = jnp.where(eligible, pods.queue_seq, jnp.iinfo(jnp.int32).max)
+    order = jnp.lexsort((sort_seq, sort_ts), axis=1)  # (C, P)
+
+    # Compact the top-K candidates into (C, K).
+    cand = order[:, :K]
+    cand_valid = eligible[rows, cand]
+    cand_req_cpu = pods.req_cpu[rows, cand]
+    cand_req_ram = pods.req_ram[rows, cand]
+    cand_duration = pods.duration[rows, cand]
+    cand_initial_ts = pods.initial_attempt_ts[rows, cand]
+
+    alive = state.nodes.alive
+    alive_count = alive.sum(axis=1).astype(jnp.float32)
+    time_dtype = pods.queue_ts.dtype
+
+    def body(carry, xs):
+        alloc_cpu, alloc_ram, cycle_dur, metrics = carry
+        valid, req_cpu, req_ram, duration, initial_ts = xs
+
+        # Queue time uses the cycle duration accumulated BEFORE this pod; the
+        # assignment effect time uses it AFTER (reference: scheduler.rs:270-320).
+        pod_queue_time = T - initial_ts + cycle_dur
+        pod_sched_time = consts.time_per_node * alive_count
+        cycle_dur_post = cycle_dur + jnp.where(valid, pod_sched_time, 0.0)
+
+        # Fit filter + LeastAllocatedResources score (reference: plugin.rs:33-63).
+        fit = (
+            alive
+            & (req_cpu[:, None] <= alloc_cpu)
+            & (req_ram[:, None] <= alloc_ram)
+        )
+        cpu_score = jnp.where(
+            alloc_cpu > 0, (alloc_cpu - req_cpu[:, None]) * 100.0 / alloc_cpu, -INF
+        )
+        ram_score = jnp.where(
+            alloc_ram > 0, (alloc_ram - req_ram[:, None]) * 100.0 / alloc_ram, -INF
+        )
+        score = jnp.where(fit, (cpu_score + ram_score) * 0.5, -INF)
+        # Last-max-wins argmax, matching the reference's `>=` sweep over
+        # name-sorted nodes (kube_scheduler.rs:140-150).
+        best = (N - 1) - jnp.argmax(score[:, ::-1], axis=1)
+        any_fit = fit.any(axis=1)
+        assign = valid & any_fit
+        park = valid & ~any_fit
+
+        best_c = jnp.clip(best, 0, None)
+        alloc_cpu = alloc_cpu.at[rows1, best_c].add(jnp.where(assign, -req_cpu, 0))
+        alloc_ram = alloc_ram.at[rows1, best_c].add(jnp.where(assign, -req_ram, 0))
+
+        start = (T + cycle_dur_post + consts.delta_bind_start).astype(time_dtype)
+        finish = jnp.where(duration >= 0, start + duration, INF).astype(time_dtype)
+        park_ts = (T + cycle_dur_post).astype(time_dtype)
+
+        metrics = metrics._replace(
+            scheduling_decisions=metrics.scheduling_decisions + assign.astype(jnp.int32),
+            queue_time=metrics.queue_time.add(pod_queue_time, assign),
+            algo_latency=metrics.algo_latency.add(pod_sched_time, assign),
+        )
+        outs = (assign, park, best, start, finish, park_ts)
+        return (alloc_cpu, alloc_ram, cycle_dur_post, metrics), outs
+
+    xs = (
+        cand_valid.T,
+        cand_req_cpu.T,
+        cand_req_ram.T,
+        cand_duration.T,
+        cand_initial_ts.T,
+    )
+    (alloc_cpu, alloc_ram, _, metrics), outs = jax.lax.scan(
+        body,
+        (state.nodes.alloc_cpu, state.nodes.alloc_ram, jnp.zeros((C,), time_dtype),
+         state.metrics),
+        xs,
+    )
+    assign_k, park_k, best_k, start_k, finish_k, park_ts_k = (o.T for o in outs)
+
+    # Scatter the K decisions back to (C, P) in one pass per field.
+    new_phase = jnp.where(
+        assign_k, PHASE_RUNNING, jnp.where(park_k, PHASE_UNSCHEDULABLE, -1)
+    )
+    touched = assign_k | park_k
+    drop_cand = jnp.where(touched, cand, P)
+    phase = pods.phase.at[rows, drop_cand].set(
+        jnp.where(touched, new_phase, 0), mode="drop"
+    )
+    node = pods.node.at[rows, jnp.where(assign_k, cand, P)].set(
+        jnp.where(assign_k, best_k, 0), mode="drop"
+    )
+    start_time = pods.start_time.at[rows, jnp.where(assign_k, cand, P)].set(
+        jnp.where(assign_k, start_k, 0.0), mode="drop"
+    )
+    finish_time = pods.finish_time.at[rows, jnp.where(assign_k, cand, P)].set(
+        jnp.where(assign_k, finish_k, 0.0), mode="drop"
+    )
+    queue_ts = pods.queue_ts.at[rows, jnp.where(park_k, cand, P)].set(
+        jnp.where(park_k, park_ts_k, 0.0), mode="drop"
+    )
+
+    return state._replace(
+        nodes=state.nodes._replace(alloc_cpu=alloc_cpu, alloc_ram=alloc_ram),
+        pods=pods._replace(
+            phase=phase,
+            queue_ts=queue_ts,
+            node=node,
+            start_time=start_time,
+            finish_time=finish_time,
+        ),
+        metrics=metrics,
+        requeue_signal=jnp.zeros_like(state.requeue_signal),
+        last_flush_time=last_flush_time,
+        time=jnp.maximum(state.time, T),
+    )
+
+
+def _window_body(
+    state: ClusterBatchState,
+    slab: TraceSlab,
+    window_end: jnp.ndarray,
+    consts: StepConstants,
+    max_events_per_window: int,
+    max_pods_per_cycle: int,
+) -> ClusterBatchState:
+    window_end = jnp.broadcast_to(window_end, state.time.shape)
+    state = _apply_window_events(
+        state, slab, window_end, consts, max_events_per_window
+    )
+    state = _run_scheduling_cycle(state, window_end, consts, max_pods_per_cycle)
+    return state
+
+
+@partial(jax.jit, static_argnames=("max_events_per_window", "max_pods_per_cycle"))
+def window_step(
+    state: ClusterBatchState,
+    slab: TraceSlab,
+    window_end: jnp.ndarray,
+    consts: StepConstants,
+    max_events_per_window: int,
+    max_pods_per_cycle: int,
+) -> ClusterBatchState:
+    """Advance every cluster to `window_end` (the next scheduling-cycle time)."""
+    return _window_body(
+        state, slab, window_end, consts, max_events_per_window, max_pods_per_cycle
+    )
+
+
+@partial(jax.jit, static_argnames=("max_events_per_window", "max_pods_per_cycle"))
+def run_windows(
+    state: ClusterBatchState,
+    slab: TraceSlab,
+    window_ends: jnp.ndarray,
+    consts: StepConstants,
+    max_events_per_window: int,
+    max_pods_per_cycle: int,
+) -> ClusterBatchState:
+    """Scan a whole sequence of scheduling-cycle windows on-device (the hot
+    benchmark loop: no host round-trips between cycles)."""
+
+    def body(carry, w):
+        return (
+            _window_body(
+                carry, slab, w, consts, max_events_per_window, max_pods_per_cycle
+            ),
+            None,
+        )
+
+    state, _ = jax.lax.scan(body, state, window_ends)
+    return state

@@ -8,9 +8,15 @@ __graft_entry__.dryrun_multichip.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The axon sitecustomize registers the TPU backend at interpreter start and
+# pins jax_platforms before conftest runs; override through the config API.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

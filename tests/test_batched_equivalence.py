@@ -1,0 +1,223 @@
+"""Batched-vs-scalar equivalence: the vectorized JAX path at batch=1 must
+reproduce the scalar oracle's scheduling decisions, terminal counts and timing
+stats on the same traces (SURVEY.md §7 'Scalar reference path').
+
+Integer facts (assignments, phase counts, terminal counters) must match
+exactly; float timing stats match to float32 tolerance (the scalar path runs
+in Python f64, the batched state in f32).
+"""
+
+import numpy as np
+import pytest
+
+from kubernetriks_tpu.batched.engine import build_batched_from_traces
+from kubernetriks_tpu.batched.state import (
+    PHASE_RUNNING,
+    PHASE_SUCCEEDED,
+    PHASE_UNSCHEDULABLE,
+)
+from kubernetriks_tpu.core.types import PodConditionType
+from kubernetriks_tpu.sim.simulator import KubernetriksSimulation
+from kubernetriks_tpu.test_util import default_test_simulation_config
+from kubernetriks_tpu.trace.generic import GenericClusterTrace, GenericWorkloadTrace
+
+# Node/pod names sort in creation order so the scalar path's sorted-name
+# iteration equals the batched path's slot order (tie-breaks align).
+CLUSTER_YAML = """
+events:
+- timestamp: 5
+  event_type:
+    !CreateNode
+      node:
+        metadata: {name: node_00}
+        status: {capacity: {cpu: 8000, ram: 17179869184}}
+- timestamp: 5
+  event_type:
+    !CreateNode
+      node:
+        metadata: {name: node_01}
+        status: {capacity: {cpu: 4000, ram: 8589934592}}
+- timestamp: 200
+  event_type:
+    !CreateNode
+      node:
+        metadata: {name: node_02}
+        status: {capacity: {cpu: 16000, ram: 34359738368}}
+"""
+
+
+def pod_yaml(name, cpu, ram, duration, ts):
+    duration_line = (
+        f"running_duration: {duration}" if duration is not None else ""
+    )
+    return f"""
+- timestamp: {ts}
+  event_type:
+    !CreatePod
+      pod:
+        metadata: {{name: {name}}}
+        spec:
+          resources:
+            requests: {{cpu: {cpu}, ram: {ram}}}
+            limits: {{cpu: {cpu}, ram: {ram}}}
+          {duration_line}
+"""
+
+
+GiB = 1024**3
+
+
+def make_workload():
+    events = ""
+    # A mix that exercises: parallel fit, serialization, unschedulable-then-
+    # freed, late big node. All ram values MiB-aligned so quantization is exact.
+    specs = [
+        ("pod_00", 2000, 4 * GiB, 50.0, 10),
+        ("pod_01", 2000, 4 * GiB, 80.0, 11),
+        ("pod_02", 4000, 8 * GiB, 40.0, 12),
+        ("pod_03", 4000, 8 * GiB, 30.0, 13),
+        ("pod_04", 12000, 24 * GiB, 60.0, 20),  # waits for node_02 at t=200
+        ("pod_05", 1000, 2 * GiB, 25.0, 95),
+        ("pod_06", 8000, 16 * GiB, 45.0, 210),
+    ]
+    for spec in specs:
+        events += pod_yaml(*spec)
+    return "events:" + events, [s[0] for s in specs]
+
+
+def run_scalar(config, cluster_yaml, workload_yaml, until):
+    sim = KubernetriksSimulation(config)
+    sim.initialize(
+        GenericClusterTrace.from_yaml(cluster_yaml),
+        GenericWorkloadTrace.from_yaml(workload_yaml),
+    )
+    sim.step_until_time(until)
+    return sim
+
+
+def run_batched(config, cluster_yaml, workload_yaml, until, n_clusters=1):
+    batched = build_batched_from_traces(
+        config,
+        GenericClusterTrace.from_yaml(cluster_yaml).convert_to_simulator_events(),
+        GenericWorkloadTrace.from_yaml(workload_yaml).convert_to_simulator_events(),
+        n_clusters=n_clusters,
+    )
+    batched.step_until_time(until)
+    return batched
+
+
+@pytest.mark.parametrize("delays", ["zero", "reference"])
+def test_batch_of_one_matches_scalar(delays):
+    suffix = ""
+    if delays == "zero":
+        suffix = "\n".join(
+            f"{k}: 0.0"
+            for k in (
+                "as_to_ps_network_delay",
+                "ps_to_sched_network_delay",
+                "sched_to_as_network_delay",
+                "as_to_node_network_delay",
+            )
+        )
+    config = default_test_simulation_config(suffix)
+    workload_yaml, pod_names = make_workload()
+
+    scalar = run_scalar(config, CLUSTER_YAML, workload_yaml, 2000.0)
+    batched = run_batched(config, CLUSTER_YAML, workload_yaml, 2000.0)
+
+    # Every pod: same terminal state, same assigned node, close start time.
+    view = batched.pod_view(0)
+    for name in pod_names:
+        scalar_pod = scalar.persistent_storage.succeeded_pods.get(name)
+        assert scalar_pod is not None, f"{name} did not succeed in scalar run"
+        b = view[name]
+        assert b["phase"] == PHASE_SUCCEEDED, f"{name}: batched phase {b['phase']}"
+        assert b["node"] == scalar_pod.status.assigned_node, name
+        scalar_start = scalar_pod.get_condition(
+            PodConditionType.POD_RUNNING
+        ).last_transition_time
+        assert b["start_time"] == pytest.approx(scalar_start, abs=1e-2), name
+
+    # Metrics: counts exact, timing stats to f32 tolerance.
+    sm = scalar.metrics_collector.accumulated_metrics
+    bm = batched.metrics_summary()
+    assert bm["counters"]["pods_succeeded"] == sm.pods_succeeded
+    assert bm["counters"]["terminated_pods"] == sm.internal.terminated_pods
+    for key, scalar_est in [
+        ("pod_duration", sm.pod_duration_stats),
+        ("pod_queue_time", sm.pod_queue_time_stats),
+        ("pod_schedule_time", sm.pod_scheduling_algorithm_latency_stats),
+    ]:
+        best = bm["timings"][key]
+        assert best["min"] == pytest.approx(scalar_est.min(), rel=1e-4, abs=1e-3), key
+        assert best["max"] == pytest.approx(scalar_est.max(), rel=1e-4, abs=1e-3), key
+        assert best["mean"] == pytest.approx(scalar_est.mean(), rel=1e-4, abs=1e-3), key
+
+
+def test_node_removal_reschedules_like_scalar():
+    config = default_test_simulation_config()
+    cluster = (
+        CLUSTER_YAML
+        + """
+- timestamp: 60
+  event_type:
+    !RemoveNode
+      node_name: node_00
+"""
+    )
+    workload = "events:" + pod_yaml("pod_00", 6000, 12 * GiB, 100.0, 10)
+    scalar = run_scalar(config, cluster, workload, 3000.0)
+    batched = run_batched(config, cluster, workload, 3000.0)
+
+    scalar_pod = scalar.persistent_storage.succeeded_pods["pod_00"]
+    b = batched.pod_view(0)["pod_00"]
+    assert b["phase"] == PHASE_SUCCEEDED
+    # Rescheduled onto node_02 (arrives t=200) in both paths.
+    assert b["node"] == scalar_pod.status.assigned_node == "node_02"
+    scalar_start = scalar_pod.get_condition(
+        PodConditionType.POD_RUNNING
+    ).last_transition_time
+    assert b["start_time"] == pytest.approx(scalar_start, abs=1e-2)
+
+
+def test_unschedulable_pod_stays_parked_in_both():
+    config = default_test_simulation_config()
+    workload = "events:" + pod_yaml("pod_00", 99000, 99 * GiB, 10.0, 10)
+    scalar = run_scalar(config, CLUSTER_YAML, workload, 500.0)
+    batched = run_batched(config, CLUSTER_YAML, workload, 500.0)
+
+    assert "pod_00" in scalar.persistent_storage.unscheduled_pods_cache
+    assert batched.pod_view(0)["pod_00"]["phase"] == PHASE_UNSCHEDULABLE
+    assert batched.metrics_summary()["counters"]["pods_succeeded"] == 0
+
+
+def test_pod_removal_while_running_matches():
+    config = default_test_simulation_config()
+    workload = (
+        "events:"
+        + pod_yaml("pod_00", 2000, 4 * GiB, 500.0, 10)
+        + """
+- timestamp: 100
+  event_type:
+    !RemovePod
+      pod_name: pod_00
+"""
+    )
+    scalar = run_scalar(config, CLUSTER_YAML, workload, 1000.0)
+    batched = run_batched(config, CLUSTER_YAML, workload, 1000.0)
+
+    assert scalar.metrics_collector.accumulated_metrics.pods_removed == 1
+    bm = batched.metrics_summary()
+    assert bm["counters"]["pods_removed"] == 1
+    assert bm["counters"]["pods_succeeded"] == 0
+
+
+def test_larger_batch_replicates_cluster_zero():
+    """Every cluster in a homogeneous batch produces identical results."""
+    config = default_test_simulation_config()
+    workload_yaml, pod_names = make_workload()
+    batched = run_batched(config, CLUSTER_YAML, workload_yaml, 2000.0, n_clusters=8)
+    base = batched.cluster_metrics(0)
+    for c in range(1, 8):
+        assert batched.cluster_metrics(c) == base
+    assert base["pods_succeeded"] == len(pod_names)

@@ -1,0 +1,56 @@
+"""Batched path sharded over a device mesh: results must be identical to the
+unsharded run, with the cluster axis split across all 8 virtual CPU devices."""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from kubernetriks_tpu.batched.engine import BatchedSimulation, build_batched_from_traces
+from kubernetriks_tpu.batched.trace_compile import compile_cluster_trace
+from kubernetriks_tpu.test_util import default_test_simulation_config
+from kubernetriks_tpu.trace.generic import GenericClusterTrace, GenericWorkloadTrace
+from tests.test_batched_equivalence import CLUSTER_YAML, make_workload
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    devices = jax.devices()
+    assert len(devices) == 8, f"expected 8 virtual devices, got {len(devices)}"
+    return Mesh(np.array(devices), ("clusters",))
+
+
+def test_sharded_run_matches_unsharded(mesh):
+    config = default_test_simulation_config()
+    workload_yaml, pod_names = make_workload()
+    cluster_events = GenericClusterTrace.from_yaml(CLUSTER_YAML).convert_to_simulator_events()
+    workload_events = GenericWorkloadTrace.from_yaml(workload_yaml).convert_to_simulator_events()
+
+    compiled = compile_cluster_trace(cluster_events, workload_events, config)
+    unsharded = BatchedSimulation(config, [compiled] * 16)
+    sharded = BatchedSimulation(config, [compiled] * 16, mesh=mesh)
+
+    # State actually lives distributed across the mesh.
+    sharding = sharded.state.pods.phase.sharding
+    assert isinstance(sharding, NamedSharding)
+    assert sharding.spec[0] == "clusters"
+    assert len(sharded.state.pods.phase.devices()) == 8
+
+    unsharded.step_until_time(2000.0)
+    sharded.step_until_time(2000.0)
+
+    for field in ["pods_succeeded", "terminated_pods", "scheduling_decisions"]:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(unsharded.state.metrics, field)),
+            np.asarray(getattr(sharded.state.metrics, field)),
+            err_msg=field,
+        )
+    np.testing.assert_array_equal(
+        np.asarray(unsharded.state.pods.phase), np.asarray(sharded.state.pods.phase)
+    )
+    np.testing.assert_allclose(
+        np.asarray(unsharded.state.pods.start_time),
+        np.asarray(sharded.state.pods.start_time),
+        rtol=1e-6,
+    )
+    assert sharded.metrics_summary()["counters"]["pods_succeeded"] == 16 * len(pod_names)
