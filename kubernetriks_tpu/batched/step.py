@@ -25,7 +25,7 @@ one event at a time) with array programs:
 from __future__ import annotations
 
 from functools import partial
-from typing import Tuple
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -248,20 +248,72 @@ def _apply_window_events(
     )
 
 
-def _run_scheduling_cycle(
-    state: ClusterBatchState,
-    T: jnp.ndarray,
-    consts: StepConstants,
-    max_pods_per_cycle: int,
-) -> ClusterBatchState:
-    """One vectorized kube-scheduler cycle at time T for every cluster
-    (scalar equivalent: reference scheduler.rs:246-333)."""
-    C, P = state.pods.phase.shape
-    N = state.nodes.alive.shape[1]
-    K = max_pods_per_cycle
-    rows1 = jnp.arange(C)
-    rows = rows1[:, None]
+class CycleCandidates(NamedTuple):
+    """Compacted per-cycle scheduling candidates (top-K of the sorted queue);
+    a pytree, so it composes with jit/scan like the rest of the state."""
 
+    pods: "object"  # PodArrays with wake/flush moves applied
+    last_flush_time: jnp.ndarray
+    cand: jnp.ndarray  # (C, K) pod slots in queue order
+    valid: jnp.ndarray  # (C, K)
+    req_cpu: jnp.ndarray
+    req_ram: jnp.ndarray
+    duration: jnp.ndarray
+    initial_ts: jnp.ndarray
+
+
+def apply_decision(
+    alloc_cpu,
+    alloc_ram,
+    metrics,
+    valid,
+    any_fit,
+    action,
+    req_cpu,
+    req_ram,
+    duration,
+    T,
+    cycle_dur,
+    pod_queue_time,
+    pod_sched_time,
+    consts: StepConstants,
+):
+    """Decision-independent cycle mechanics shared by the kube and RL paths:
+    commit one chosen node per cluster (resource reservation, start/finish
+    computation, park timestamps, metric accounting). `action` is the chosen
+    node slot; `any_fit` gates assignment vs unschedulable park."""
+    C = valid.shape[0]
+    rows1 = jnp.arange(C)
+    time_dtype = T.dtype
+    cycle_dur_post = cycle_dur + jnp.where(valid, pod_sched_time, 0.0)
+
+    assign = valid & any_fit
+    park = valid & ~any_fit
+
+    action_c = jnp.clip(action, 0, None)
+    alloc_cpu = alloc_cpu.at[rows1, action_c].add(jnp.where(assign, -req_cpu, 0))
+    alloc_ram = alloc_ram.at[rows1, action_c].add(jnp.where(assign, -req_ram, 0))
+
+    start = (T + cycle_dur_post + consts.delta_bind_start).astype(time_dtype)
+    finish = jnp.where(duration >= 0, start + duration, INF).astype(time_dtype)
+    # Unschedulable park: new insert timestamp = T + cycle duration
+    # (reference: scheduler.rs:282-306).
+    park_ts = (T + cycle_dur_post).astype(time_dtype)
+
+    metrics = metrics._replace(
+        scheduling_decisions=metrics.scheduling_decisions + assign.astype(jnp.int32),
+        queue_time=metrics.queue_time.add(pod_queue_time, assign),
+        algo_latency=metrics.algo_latency.add(pod_sched_time, assign),
+    )
+    return alloc_cpu, alloc_ram, metrics, assign, park, start, finish, park_ts, cycle_dur_post
+
+
+def prepare_cycle(
+    state: ClusterBatchState, T: jnp.ndarray, consts: StepConstants, K: int
+) -> CycleCandidates:
+    """Cycle preamble shared by the kube-scheduler and RL-policy cycles:
+    unschedulable wake/flush moves, queue sort, top-K compaction."""
+    rows = jnp.arange(state.pods.phase.shape[0])[:, None]
     pods = state.pods
 
     # Unschedulable-leftover flush at the 30 s cadence
@@ -286,86 +338,44 @@ def _run_scheduling_cycle(
     sort_seq = jnp.where(eligible, pods.queue_seq, jnp.iinfo(jnp.int32).max)
     order = jnp.lexsort((sort_seq, sort_ts), axis=1)  # (C, P)
 
-    # Compact the top-K candidates into (C, K).
     cand = order[:, :K]
-    cand_valid = eligible[rows, cand]
-    cand_req_cpu = pods.req_cpu[rows, cand]
-    cand_req_ram = pods.req_ram[rows, cand]
-    cand_duration = pods.duration[rows, cand]
-    cand_initial_ts = pods.initial_attempt_ts[rows, cand]
-
-    alive = state.nodes.alive
-    alive_count = alive.sum(axis=1).astype(jnp.float32)
-    time_dtype = pods.queue_ts.dtype
-
-    def body(carry, xs):
-        alloc_cpu, alloc_ram, cycle_dur, metrics = carry
-        valid, req_cpu, req_ram, duration, initial_ts = xs
-
-        # Queue time uses the cycle duration accumulated BEFORE this pod; the
-        # assignment effect time uses it AFTER (reference: scheduler.rs:270-320).
-        pod_queue_time = T - initial_ts + cycle_dur
-        pod_sched_time = consts.time_per_node * alive_count
-        cycle_dur_post = cycle_dur + jnp.where(valid, pod_sched_time, 0.0)
-
-        # Fit filter + LeastAllocatedResources score (reference: plugin.rs:33-63).
-        fit = (
-            alive
-            & (req_cpu[:, None] <= alloc_cpu)
-            & (req_ram[:, None] <= alloc_ram)
-        )
-        cpu_score = jnp.where(
-            alloc_cpu > 0, (alloc_cpu - req_cpu[:, None]) * 100.0 / alloc_cpu, -INF
-        )
-        ram_score = jnp.where(
-            alloc_ram > 0, (alloc_ram - req_ram[:, None]) * 100.0 / alloc_ram, -INF
-        )
-        score = jnp.where(fit, (cpu_score + ram_score) * 0.5, -INF)
-        # Last-max-wins argmax, matching the reference's `>=` sweep over
-        # name-sorted nodes (kube_scheduler.rs:140-150).
-        best = (N - 1) - jnp.argmax(score[:, ::-1], axis=1)
-        any_fit = fit.any(axis=1)
-        assign = valid & any_fit
-        park = valid & ~any_fit
-
-        best_c = jnp.clip(best, 0, None)
-        alloc_cpu = alloc_cpu.at[rows1, best_c].add(jnp.where(assign, -req_cpu, 0))
-        alloc_ram = alloc_ram.at[rows1, best_c].add(jnp.where(assign, -req_ram, 0))
-
-        start = (T + cycle_dur_post + consts.delta_bind_start).astype(time_dtype)
-        finish = jnp.where(duration >= 0, start + duration, INF).astype(time_dtype)
-        park_ts = (T + cycle_dur_post).astype(time_dtype)
-
-        metrics = metrics._replace(
-            scheduling_decisions=metrics.scheduling_decisions + assign.astype(jnp.int32),
-            queue_time=metrics.queue_time.add(pod_queue_time, assign),
-            algo_latency=metrics.algo_latency.add(pod_sched_time, assign),
-        )
-        outs = (assign, park, best, start, finish, park_ts)
-        return (alloc_cpu, alloc_ram, cycle_dur_post, metrics), outs
-
-    xs = (
-        cand_valid.T,
-        cand_req_cpu.T,
-        cand_req_ram.T,
-        cand_duration.T,
-        cand_initial_ts.T,
+    return CycleCandidates(
+        pods=pods,
+        last_flush_time=last_flush_time,
+        cand=cand,
+        valid=eligible[rows, cand],
+        req_cpu=pods.req_cpu[rows, cand],
+        req_ram=pods.req_ram[rows, cand],
+        duration=pods.duration[rows, cand],
+        initial_ts=pods.initial_attempt_ts[rows, cand],
     )
-    (alloc_cpu, alloc_ram, _, metrics), outs = jax.lax.scan(
-        body,
-        (state.nodes.alloc_cpu, state.nodes.alloc_ram, jnp.zeros((C,), time_dtype),
-         state.metrics),
-        xs,
-    )
-    assign_k, park_k, best_k, start_k, finish_k, park_ts_k = (o.T for o in outs)
 
-    # Scatter the K decisions back to (C, P) in one pass per field.
+
+def commit_cycle(
+    state: ClusterBatchState,
+    cc: CycleCandidates,
+    T: jnp.ndarray,
+    alloc_cpu,
+    alloc_ram,
+    metrics,
+    assign_k,
+    park_k,
+    best_k,
+    start_k,
+    finish_k,
+    park_ts_k,
+) -> ClusterBatchState:
+    """Scatter the K per-cluster decisions back into (C, P) state."""
+    C, P = cc.pods.phase.shape
+    rows = jnp.arange(C)[:, None]
+    pods = cc.pods
+    cand = cc.cand
+
     new_phase = jnp.where(
         assign_k, PHASE_RUNNING, jnp.where(park_k, PHASE_UNSCHEDULABLE, -1)
     )
     touched = assign_k | park_k
-    drop_cand = jnp.where(touched, cand, P)
-    phase = pods.phase.at[rows, drop_cand].set(
+    phase = pods.phase.at[rows, jnp.where(touched, cand, P)].set(
         jnp.where(touched, new_phase, 0), mode="drop"
     )
     node = pods.node.at[rows, jnp.where(assign_k, cand, P)].set(
@@ -392,8 +402,84 @@ def _run_scheduling_cycle(
         ),
         metrics=metrics,
         requeue_signal=jnp.zeros_like(state.requeue_signal),
-        last_flush_time=last_flush_time,
+        last_flush_time=cc.last_flush_time,
         time=jnp.maximum(state.time, T),
+    )
+
+
+def _run_scheduling_cycle(
+    state: ClusterBatchState,
+    T: jnp.ndarray,
+    consts: StepConstants,
+    max_pods_per_cycle: int,
+) -> ClusterBatchState:
+    """One vectorized kube-scheduler cycle at time T for every cluster
+    (scalar equivalent: reference scheduler.rs:246-333)."""
+    C, P = state.pods.phase.shape
+    N = state.nodes.alive.shape[1]
+    rows1 = jnp.arange(C)
+
+    cc = prepare_cycle(state, T, consts, max_pods_per_cycle)
+    cand_valid, cand_req_cpu, cand_req_ram = cc.valid, cc.req_cpu, cc.req_ram
+    cand_duration, cand_initial_ts = cc.duration, cc.initial_ts
+
+    alive = state.nodes.alive
+    alive_count = alive.sum(axis=1).astype(jnp.float32)
+    time_dtype = cc.pods.queue_ts.dtype
+
+    def body(carry, xs):
+        alloc_cpu, alloc_ram, cycle_dur, metrics = carry
+        valid, req_cpu, req_ram, duration, initial_ts = xs
+
+        # Queue time uses the cycle duration accumulated BEFORE this pod; the
+        # assignment effect time uses it AFTER (reference: scheduler.rs:270-320).
+        pod_queue_time = T - initial_ts + cycle_dur
+        pod_sched_time = consts.time_per_node * alive_count
+
+        # Fit filter + LeastAllocatedResources score (reference: plugin.rs:33-63).
+        fit = (
+            alive
+            & (req_cpu[:, None] <= alloc_cpu)
+            & (req_ram[:, None] <= alloc_ram)
+        )
+        cpu_score = jnp.where(
+            alloc_cpu > 0, (alloc_cpu - req_cpu[:, None]) * 100.0 / alloc_cpu, -INF
+        )
+        ram_score = jnp.where(
+            alloc_ram > 0, (alloc_ram - req_ram[:, None]) * 100.0 / alloc_ram, -INF
+        )
+        score = jnp.where(fit, (cpu_score + ram_score) * 0.5, -INF)
+        # Last-max-wins argmax, matching the reference's `>=` sweep over
+        # name-sorted nodes (kube_scheduler.rs:140-150).
+        best = (N - 1) - jnp.argmax(score[:, ::-1], axis=1)
+        any_fit = fit.any(axis=1)
+
+        (alloc_cpu, alloc_ram, metrics, assign, park, start, finish, park_ts,
+         cycle_dur_post) = apply_decision(
+            alloc_cpu, alloc_ram, metrics, valid, any_fit, best,
+            req_cpu, req_ram, duration, T, cycle_dur,
+            pod_queue_time, pod_sched_time, consts,
+        )
+        outs = (assign, park, best, start, finish, park_ts)
+        return (alloc_cpu, alloc_ram, cycle_dur_post, metrics), outs
+
+    xs = (
+        cand_valid.T,
+        cand_req_cpu.T,
+        cand_req_ram.T,
+        cand_duration.T,
+        cand_initial_ts.T,
+    )
+    (alloc_cpu, alloc_ram, _, metrics), outs = jax.lax.scan(
+        body,
+        (state.nodes.alloc_cpu, state.nodes.alloc_ram, jnp.zeros((C,), time_dtype),
+         state.metrics),
+        xs,
+    )
+    assign_k, park_k, best_k, start_k, finish_k, park_ts_k = (o.T for o in outs)
+    return commit_cycle(
+        state, cc, T, alloc_cpu, alloc_ram, metrics,
+        assign_k, park_k, best_k, start_k, finish_k, park_ts_k,
     )
 
 

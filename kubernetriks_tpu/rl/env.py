@@ -1,0 +1,206 @@
+"""RL environment: the batched simulator driven by a learned scheduler policy.
+
+The policy replaces the KubeScheduler filter/score pass at the same seam the
+scalar path exposes via PodSchedulingAlgorithm (reference:
+src/core/scheduler/interface.rs:14-23): per pending pod, node logits over the
+cluster's nodes, action-masked to Fit-feasible nodes. Everything else — trace
+events, queues, finishes, delays, metrics — is the unmodified batched step, so
+the policy trains against exactly the simulated control-plane dynamics.
+
+A rollout scans scheduling windows on-device, recording per-decision
+transitions (features, action, log-prob, value, reward) for PPO.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from kubernetriks_tpu.batched.state import ClusterBatchState, StepConstants, TraceSlab
+from kubernetriks_tpu.batched.step import (
+    _apply_window_events,
+    apply_decision,
+    commit_cycle,
+    prepare_cycle,
+)
+
+INF = jnp.inf
+
+
+class Transition(NamedTuple):
+    """One scheduling decision per (cluster,) slice; stacked over (W, K)."""
+
+    obs: jnp.ndarray  # (..., C, N, F) node features
+    action: jnp.ndarray  # (..., C) chosen node (or argmax'd park)
+    log_prob: jnp.ndarray  # (..., C)
+    value: jnp.ndarray  # (..., C)
+    reward: jnp.ndarray  # (..., C)
+    valid: jnp.ndarray  # (..., C) decision actually happened
+
+
+def featurize(
+    alive, alloc_cpu, alloc_ram, cap_cpu, cap_ram, req_cpu, req_ram
+) -> jnp.ndarray:
+    """Per-node features for one pending pod: (C, N, F)."""
+    cap_cpu_f = jnp.maximum(cap_cpu.astype(jnp.float32), 1.0)
+    cap_ram_f = jnp.maximum(cap_ram.astype(jnp.float32), 1.0)
+    fits = (
+        alive & (req_cpu[:, None] <= alloc_cpu) & (req_ram[:, None] <= alloc_ram)
+    )
+    return jnp.stack(
+        [
+            alive.astype(jnp.float32),
+            fits.astype(jnp.float32),
+            alloc_cpu.astype(jnp.float32) / cap_cpu_f,
+            alloc_ram.astype(jnp.float32) / cap_ram_f,
+            req_cpu.astype(jnp.float32)[:, None] / cap_cpu_f,
+            req_ram.astype(jnp.float32)[:, None] / cap_ram_f,
+        ],
+        axis=-1,
+    )
+
+
+def policy_cycle(
+    state: ClusterBatchState,
+    T: jnp.ndarray,
+    consts: StepConstants,
+    K: int,
+    policy_apply,
+    params,
+    rng: jnp.ndarray,
+    greedy: bool = False,
+) -> Tuple[ClusterBatchState, Transition]:
+    """One scheduling cycle where the policy picks nodes; returns the K
+    per-cluster transitions. Action space = nodes, masked to Fit-feasible ones;
+    no feasible node -> the pod parks unschedulable (like the Fit filter)."""
+    C, P = state.pods.phase.shape
+    N = state.nodes.alive.shape[1]
+    rows1 = jnp.arange(C)
+
+    cc = prepare_cycle(state, T, consts, K)
+    alive = state.nodes.alive
+
+    alive_count = alive.sum(axis=1).astype(jnp.float32)
+
+    def body(carry, xs):
+        alloc_cpu, alloc_ram, cycle_dur, metrics, rng = carry
+        valid, req_cpu, req_ram, duration, initial_ts = xs
+
+        pod_queue_time = T - initial_ts + cycle_dur
+        pod_sched_time = consts.time_per_node * alive_count
+
+        obs = featurize(
+            alive, alloc_cpu, alloc_ram, state.nodes.cap_cpu, state.nodes.cap_ram,
+            req_cpu, req_ram,
+        )
+        fit = obs[..., 1] > 0  # (C, N)
+        any_fit = fit.any(axis=1)
+
+        logits, value = policy_apply(params, obs)  # (C, N), (C,)
+        # Finite mask value (not -inf): keeps softmax/log_softmax gradients
+        # NaN-free while making masked nodes unselectable.
+        masked_logits = jnp.where(fit, logits, -1e9)
+        # Guard fully-infeasible rows (uniform over nodes; decision is a park).
+        safe_logits = jnp.where(
+            any_fit[:, None], masked_logits, jnp.zeros_like(masked_logits)
+        )
+        rng, sub = jax.random.split(rng)
+        sampled = jax.random.categorical(sub, safe_logits, axis=-1)
+        best = jnp.argmax(safe_logits, axis=-1)
+        action = jnp.where(greedy, best, sampled)
+        log_probs = jax.nn.log_softmax(safe_logits, axis=-1)
+        log_prob = log_probs[rows1, action]
+
+        # Shared decision mechanics (resource reservation, start/finish/park,
+        # metrics) — single-sourced with the kube cycle in batched/step.py.
+        (alloc_cpu, alloc_ram, metrics, assign, park, start, finish, park_ts,
+         cycle_dur_post) = apply_decision(
+            alloc_cpu, alloc_ram, metrics, valid, any_fit, action,
+            req_cpu, req_ram, duration, T, cycle_dur,
+            pod_queue_time, pod_sched_time, consts,
+        )
+
+        # Reward: +1 per placement, -1 per unschedulable park, minus a queue
+        # time penalty so the policy learns not to strand future pods.
+        reward = jnp.where(
+            assign,
+            1.0 - 0.01 * jnp.minimum(pod_queue_time.astype(jnp.float32), 100.0),
+            jnp.where(park, -1.0, 0.0),
+        )
+        transition = Transition(
+            obs=obs,
+            action=action,
+            log_prob=log_prob,
+            value=value,
+            reward=reward,
+            valid=valid,
+        )
+        outs = (assign, park, action, start, finish, park_ts, transition)
+        return (alloc_cpu, alloc_ram, cycle_dur_post, metrics, rng), outs
+
+    xs = (cc.valid.T, cc.req_cpu.T, cc.req_ram.T, cc.duration.T, cc.initial_ts.T)
+    (alloc_cpu, alloc_ram, _, metrics, _), outs = jax.lax.scan(
+        body,
+        (state.nodes.alloc_cpu, state.nodes.alloc_ram,
+         jnp.zeros((C,), cc.pods.queue_ts.dtype), state.metrics, rng),
+        xs,
+    )
+    assign_k, park_k, action_k, start_k, finish_k, park_ts_k, transitions = outs
+    state = commit_cycle(
+        state, cc, T, alloc_cpu, alloc_ram, metrics,
+        assign_k.T, park_k.T, action_k.T, start_k.T, finish_k.T, park_ts_k.T,
+    )
+    return state, transitions  # transitions stacked over K on axis 0
+
+
+@partial(
+    jax.jit,
+    static_argnames=("policy_apply", "max_events_per_window", "max_pods_per_cycle", "greedy"),
+)
+def rollout(
+    state: ClusterBatchState,
+    slab: TraceSlab,
+    window_ends: jnp.ndarray,
+    consts: StepConstants,
+    params,
+    rng: jnp.ndarray,
+    policy_apply,
+    max_events_per_window: int,
+    max_pods_per_cycle: int,
+    greedy: bool = False,
+) -> Tuple[ClusterBatchState, Transition]:
+    """Scan W scheduling windows under the policy; transitions stacked (W, K, C, ...)."""
+
+    def body(carry, w):
+        st, rng = carry
+        rng, sub = jax.random.split(rng)
+        w_arr = jnp.broadcast_to(w, st.time.shape)
+        st = _apply_window_events(st, slab, w_arr, consts, max_events_per_window)
+        st, transition = policy_cycle(
+            st, w_arr, consts, max_pods_per_cycle, policy_apply, params, sub,
+            greedy=greedy,
+        )
+        return (st, rng), transition
+
+    (state, _), transitions = jax.lax.scan(body, (state, rng), window_ends)
+    return state, transitions
+
+
+def final_state_value(state: ClusterBatchState, policy_apply, params) -> jnp.ndarray:
+    """Critic value of the post-rollout state (zero-request 'no pending pod'
+    features), used to bootstrap truncated-rollout GAE."""
+    zeros = jnp.zeros(state.nodes.alive.shape[0], jnp.int32)
+    obs = featurize(
+        state.nodes.alive,
+        state.nodes.alloc_cpu,
+        state.nodes.alloc_ram,
+        state.nodes.cap_cpu,
+        state.nodes.cap_ram,
+        zeros,
+        zeros,
+    )
+    _, value = policy_apply(params, obs)
+    return value

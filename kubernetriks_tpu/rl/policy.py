@@ -1,0 +1,48 @@
+"""Scheduler policy network: per-pod node logits from masked node features.
+
+The RL head replaces the KubeScheduler score pass (the north-star RL
+configuration, BASELINE.json configs[4]): for each pending pod it scores every
+node of its cluster. Architecture is permutation-equivariant over nodes — a
+shared MLP maps each node's feature vector to a logit, plus a pooled value
+head — so one set of weights serves any cluster size, and the whole batch of
+(clusters x nodes) evaluations is a single bfloat16-friendly batched matmul
+stack on the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# Per-node feature vector layout (see featurize() in rl/env.py):
+# [alive, fits, alloc_cpu_frac, alloc_ram_frac, req_cpu_over_cap, req_ram_over_cap]
+NODE_FEATURES = 6
+
+
+class SchedulerPolicy(nn.Module):
+    """Maps (..., N, F) node features -> ((..., N) logits, (...,) value)."""
+
+    hidden: int = 64
+    layers: int = 2
+
+    @nn.compact
+    def __call__(self, node_features: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        x = node_features
+        for _ in range(self.layers):
+            x = nn.Dense(self.hidden)(x)
+            x = nn.relu(x)
+        logits = nn.Dense(1)(x)[..., 0]  # (..., N)
+
+        # Value head over mean-pooled node embeddings.
+        pooled = x.mean(axis=-2)  # (..., hidden)
+        v = nn.relu(nn.Dense(self.hidden)(pooled))
+        value = nn.Dense(1)(v)[..., 0]  # (...,)
+        return logits, value
+
+
+def init_policy(rng, n_nodes: int, hidden: int = 64, layers: int = 2):
+    policy = SchedulerPolicy(hidden=hidden, layers=layers)
+    params = policy.init(rng, jnp.zeros((1, n_nodes, NODE_FEATURES)))
+    return policy, params
