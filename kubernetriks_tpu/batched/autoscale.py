@@ -1,0 +1,504 @@
+"""Vectorized autoscaler passes for the batched backend.
+
+The scalar HPA / cluster-autoscaler control loops (reference:
+src/autoscalers/horizontal_pod_autoscaler/*.rs, cluster_autoscaler/*.rs)
+become masked array passes over the dense cluster-batch state, run at their
+scan cadence inside the window step:
+
+- HPA: per-(cluster, pod-group) closed-form utilization from the compiled
+  load curves, the k8s desired-replicas formula with tolerance band
+  (reference: kube_horizontal_pod_autoscaler.rs:54-155), and head/tail
+  activation windows over the group's reserved pod slots.
+- CA: bounded-K first-fit bin-packing scale-up over the unscheduled-pod cache
+  and a nested-scan scale-down with simulated re-placement over shared virtual
+  allocatables (reference: kube_cluster_autoscaler.rs:55-307).
+
+Documented deviations from the scalar path (replica/node COUNTS match; exact
+identity of scaled-down members may differ):
+- HPA scale-down removes pods in FIFO creation order; the scalar path pops the
+  lexicographically-smallest name, which deviates once indices reach 10+
+  (kube_horizontal_pod_autoscaler.rs:197-205 pops a BTreeSet). Utilization is
+  count-based, so trajectories are unaffected.
+- CA decisions read state at the window boundary instead of at the simulated
+  storage-snapshot time (a sub-window skew), and re-arm on a fixed cadence
+  (the scalar path re-arms with delay 0 after an overrun cycle,
+  cluster_autoscaler.rs:256-262).
+- Scale-up considers at most K_up cache pods and scale-down at most K_sd pods
+  per candidate node per cycle; overflow is deferred to the next cycle
+  (scale-up) or conservatively skipped (scale-down).
+- Scaled-up slots are never reused: each group reserves
+  slots ~ multiplier x max_count, mirroring the reference's pre-sized
+  component pool (src/simulator.rs:212-230) without reclaim.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from kubernetriks_tpu.batched.state import (
+    ClusterBatchState,
+    PHASE_EMPTY,
+    PHASE_QUEUED,
+    PHASE_RUNNING,
+    PHASE_UNSCHEDULABLE,
+)
+
+INF = jnp.inf
+_BIG_I32 = jnp.iinfo(jnp.int32).max
+
+
+class AutoscaleStatics(NamedTuple):
+    """Compile-time autoscaler tables (pytree of arrays; C-leading)."""
+
+    # --- HPA pod groups: (C, Gp) ---
+    pg_slot_start: jnp.ndarray  # int32 first reserved pod slot
+    pg_slot_count: jnp.ndarray  # int32 reserved slots (cumulative creations cap)
+    pg_initial: jnp.ndarray  # int32 initial replicas (created by the trace)
+    pg_max_pods: jnp.ndarray  # int32 max simultaneous replicas
+    pg_target_cpu: jnp.ndarray  # float32; <=0 means metric unset
+    pg_target_ram: jnp.ndarray  # float32; <=0 means metric unset
+    pg_creation: jnp.ndarray  # float32 trace creation time; +inf = padding
+    # Piecewise-cyclic load curves, (C, Gp, U); duration 0 = padding unit.
+    pg_cpu_dur: jnp.ndarray
+    pg_cpu_load: jnp.ndarray
+    pg_cpu_total: jnp.ndarray  # (C, Gp) cycle length; 0 = no model (util 0)
+    pg_cpu_const: jnp.ndarray  # bool: constant model (load IS the utilization)
+    pg_ram_dur: jnp.ndarray
+    pg_ram_load: jnp.ndarray
+    pg_ram_total: jnp.ndarray
+    pg_ram_const: jnp.ndarray
+    pod_group_id: jnp.ndarray  # (C, P) int32 group of pod slot; -1 = none
+    # --- CA node groups: (C, Gn) ---
+    ng_ca_start: jnp.ndarray  # int32 first CA-slot (in the compact CA axis)
+    ng_slot_count: jnp.ndarray  # int32 reserved CA slots
+    ng_max_count: jnp.ndarray  # int32; <0 = unbounded
+    ng_tmpl_cpu: jnp.ndarray  # int32 template capacity
+    ng_tmpl_ram: jnp.ndarray  # int32 (ram units)
+    ca_max_nodes: jnp.ndarray  # (C,) int32 global CA node quota
+    ca_slots: jnp.ndarray  # (C, S) int32 global node slot of CA slot; -1 pad
+    ca_slot_group: jnp.ndarray  # (C, S) int32 owning group; -1 pad
+    # --- scalars ---
+    hpa_interval: jnp.ndarray
+    ca_interval: jnp.ndarray
+    hpa_tolerance: jnp.ndarray
+    ca_threshold: jnp.ndarray
+    d_hpa_register: jnp.ndarray  # group creation -> registered at HPA
+    d_hpa_up: jnp.ndarray  # HPA tick -> scaled-up pod enters scheduler queue
+    d_hpa_down: jnp.ndarray  # HPA tick -> pod removal effect at storage
+    d_ca_up: jnp.ndarray  # CA tick -> scaled-up node schedulable
+    d_ca_down: jnp.ndarray  # CA tick -> node removal effect at node
+
+
+class AutoscaleState(NamedTuple):
+    """Dynamic autoscaler state (lives inside ClusterBatchState.auto)."""
+
+    hpa_head: jnp.ndarray  # (C, Gp) int32 first live created offset
+    hpa_tail: jnp.ndarray  # (C, Gp) int32 next creation offset (== total_created)
+    ca_count: jnp.ndarray  # (C, Gn) int32 current CA nodes per group
+    ca_cursor: jnp.ndarray  # (C, Gn) int32 next reserved slot offset
+    hpa_next: jnp.ndarray  # (C,) float32 next HPA tick
+    ca_next: jnp.ndarray  # (C,) float32 next CA tick
+
+
+def init_autoscale_state(statics: AutoscaleStatics) -> AutoscaleState:
+    C, Gp = statics.pg_slot_start.shape
+    Gn = statics.ng_ca_start.shape[1]
+    return AutoscaleState(
+        hpa_head=jnp.zeros((C, Gp), jnp.int32),
+        # The trace's initial pods count as created (the api-server expansion
+        # seeds created_pods/total_created, reference: api_server.rs:405-455).
+        hpa_tail=statics.pg_initial.astype(jnp.int32),
+        ca_count=jnp.zeros((C, Gn), jnp.int32),
+        ca_cursor=jnp.zeros((C, Gn), jnp.int32),
+        hpa_next=jnp.zeros((C,), jnp.float32),
+        ca_next=jnp.zeros((C,), jnp.float32),
+    )
+
+
+def _curve_load(dur, load, total, elapsed):
+    """Piecewise-constant cyclic curve lookup (reference semantics:
+    src/core/resource_usage/pod_group.rs:71-99). dur/load: (C, G, U);
+    total/elapsed: (C, G)."""
+    safe_total = jnp.maximum(total, 1e-9)
+    pos = jnp.where(total > 0, jnp.mod(elapsed, safe_total), 0.0)
+    ecs = jnp.cumsum(dur, axis=-1) - dur  # exclusive start of each unit
+    in_unit = (ecs <= pos[..., None]) & (pos[..., None] < ecs + dur)
+    return jnp.where(in_unit, load, 0.0).sum(axis=-1)
+
+
+def hpa_pass(
+    state: ClusterBatchState,
+    auto: AutoscaleState,
+    st: AutoscaleStatics,
+    T: jnp.ndarray,
+) -> Tuple[ClusterBatchState, AutoscaleState]:
+    """One masked HPA cycle at time T for every due cluster
+    (scalar equivalent: horizontal_pod_autoscaler.py run cycle +
+    kube_horizontal_pod_autoscaler.py formula)."""
+    pods, metrics = state.pods, state.metrics
+    C, P = pods.phase.shape
+    Gp = st.pg_slot_start.shape[1]
+    rows = jnp.arange(C)[:, None]
+
+    due = T >= auto.hpa_next
+    active = due[:, None] & (T[:, None] >= st.pg_creation + st.d_hpa_register)
+
+    # Group membership and running counts (running = bound AND started by T,
+    # mirroring node_component.running_pods at collection time).
+    gid = st.pod_group_id
+    gid_c = jnp.where(gid >= 0, gid, Gp)
+    running = (pods.phase == PHASE_RUNNING) & (pods.start_time <= T[:, None])
+    run_per_group = (
+        jnp.zeros((C, Gp + 1), jnp.int32)
+        .at[rows, gid_c]
+        .add(running.astype(jnp.int32))[:, :Gp]
+    )
+    present = run_per_group > 0  # group absent from metrics when nothing runs
+    runf = jnp.maximum(run_per_group, 1).astype(jnp.float32)
+
+    elapsed = T[:, None] - st.pg_creation
+    cpu_load = _curve_load(st.pg_cpu_dur, st.pg_cpu_load, st.pg_cpu_total, elapsed)
+    ram_load = _curve_load(st.pg_ram_dur, st.pg_ram_load, st.pg_ram_total, elapsed)
+    util_cpu = jnp.where(
+        st.pg_cpu_total > 0,
+        jnp.where(st.pg_cpu_const, cpu_load, jnp.minimum(1.0, cpu_load / runf)),
+        0.0,
+    )
+    util_ram = jnp.where(
+        st.pg_ram_total > 0,
+        jnp.where(st.pg_ram_const, ram_load, jnp.minimum(1.0, ram_load / runf)),
+        0.0,
+    )
+
+    current = auto.hpa_tail - auto.hpa_head
+
+    def desired_by(util, target):
+        ratio = util / jnp.maximum(target, 1e-9)
+        in_band = jnp.abs(ratio - 1.0) <= st.hpa_tolerance
+        # -1e-4 guards float32 products landing epsilon above an integer
+        # (the scalar path computes the formula in f64).
+        d = jnp.ceil(current.astype(jnp.float32) * ratio - 1e-4).astype(jnp.int32)
+        return jnp.where(in_band, current, d)
+
+    has_cpu = st.pg_target_cpu > 0
+    has_ram = st.pg_target_ram > 0
+    d_cpu = desired_by(util_cpu, st.pg_target_cpu)
+    d_ram = desired_by(util_ram, st.pg_target_ram)
+    desired = jnp.where(
+        has_cpu & has_ram,
+        jnp.maximum(d_cpu, d_ram),
+        jnp.where(has_cpu, d_cpu, jnp.where(has_ram, d_ram, current)),
+    )
+    desired = jnp.minimum(desired, st.pg_max_pods)
+
+    act = active & present
+    delta = jnp.where(act, desired - current, 0)
+    up = jnp.minimum(jnp.maximum(delta, 0), st.pg_slot_count - auto.hpa_tail)
+    down = jnp.minimum(jnp.maximum(-delta, 0), current)
+
+    # --- scale up: activate offsets [tail, tail+up) of each group ----------
+    slot_start_p = st.pg_slot_start[rows, gid_c]  # (C, P); garbage where gid<0
+    off = jnp.arange(P)[None, :] - slot_start_p
+    in_group = gid >= 0
+    tail_p = auto.hpa_tail[rows, gid_c]
+    up_p = up[rows, gid_c]
+    head_p = auto.hpa_head[rows, gid_c]
+    down_p = down[rows, gid_c]
+
+    activate = in_group & (off >= tail_p) & (off < tail_p + up_p)
+    activate = activate & (pods.phase == PHASE_EMPTY)
+    rank = jnp.cumsum(activate, axis=1) - 1
+    n_up = activate.sum(axis=1).astype(jnp.int32)
+    enqueue_ts = (T[:, None] + st.d_hpa_up).astype(pods.queue_ts.dtype)
+    phase = jnp.where(activate, PHASE_QUEUED, pods.phase)
+    queue_ts = jnp.where(activate, enqueue_ts, pods.queue_ts)
+    queue_seq = jnp.where(
+        activate, state.queue_seq_counter[:, None] + rank, pods.queue_seq
+    )
+    initial_attempt_ts = jnp.where(activate, enqueue_ts, pods.initial_attempt_ts)
+    attempts = jnp.where(activate, 1, pods.attempts)
+
+    # --- scale down: mark offsets [head, head+down) for removal ------------
+    deactivate = in_group & (off >= head_p) & (off < head_p + down_p)
+    removal_time = jnp.where(
+        deactivate,
+        jnp.minimum(pods.removal_time, T[:, None] + st.d_hpa_down),
+        pods.removal_time,
+    )
+
+    metrics = metrics._replace(
+        scaled_up_pods=metrics.scaled_up_pods + up.sum(axis=1),
+        scaled_down_pods=metrics.scaled_down_pods + down.sum(axis=1),
+    )
+    auto = auto._replace(
+        hpa_head=auto.hpa_head + down,
+        hpa_tail=auto.hpa_tail + up,
+        hpa_next=jnp.where(due, auto.hpa_next + st.hpa_interval, auto.hpa_next),
+    )
+    state = state._replace(
+        pods=pods._replace(
+            phase=phase,
+            queue_ts=queue_ts,
+            queue_seq=queue_seq,
+            initial_attempt_ts=initial_attempt_ts,
+            attempts=attempts,
+            removal_time=removal_time,
+        ),
+        metrics=metrics,
+        queue_seq_counter=state.queue_seq_counter + n_up,
+    )
+    return state, auto
+
+
+def _ca_scale_up(
+    state: ClusterBatchState,
+    auto: AutoscaleState,
+    st: AutoscaleStatics,
+    T: jnp.ndarray,
+    branch: jnp.ndarray,
+    K_up: int,
+):
+    """Bin-packing scale-up over the unscheduled-pod cache
+    (reference: kube_cluster_autoscaler.rs:190-240). Returns
+    (planned (C,S) bool, planned_per_group (C,Gn))."""
+    pods = state.pods
+    C, P = pods.phase.shape
+    S = st.ca_slots.shape[1]
+    Gn = st.ng_ca_start.shape[1]
+    rows = jnp.arange(C)[:, None]
+    rows1 = jnp.arange(C)
+
+    # The storage unscheduled-pods cache: parked pods plus woken-but-unscheduled
+    # pods (attempts>=2 after a wake, reference: persistent_storage.rs cache
+    # removal only on assignment).
+    in_cache = (pods.phase == PHASE_UNSCHEDULABLE) | (
+        (pods.phase == PHASE_QUEUED) & (pods.attempts >= 2)
+    )
+    key_ts = jnp.where(in_cache, pods.queue_ts, INF)
+    key_seq = jnp.where(in_cache, pods.queue_seq, _BIG_I32)
+    order = jnp.lexsort((key_seq, key_ts), axis=1)[:, :K_up]
+    cvalid = in_cache[rows, order] & branch[:, None]
+    creq_cpu = pods.req_cpu[rows, order]
+    creq_ram = pods.req_ram[rows, order]
+
+    planned0 = jnp.zeros((C, S), bool)
+    plan_seq0 = jnp.full((C, S), _BIG_I32, jnp.int32)
+    palloc_cpu0 = jnp.zeros((C, S), jnp.int32)
+    palloc_ram0 = jnp.zeros((C, S), jnp.int32)
+    g_planned0 = jnp.zeros((C, Gn), jnp.int32)
+    total0 = auto.ca_count.sum(axis=1)  # CA counts only (reference quirk:
+    # max_node_count bounds CA-owned nodes, kube_cluster_autoscaler.rs:62-80)
+    counter0 = jnp.zeros((C,), jnp.int32)
+
+    def body(carry, xs):
+        planned, plan_seq, palloc_cpu, palloc_ram, g_planned, total, counter = carry
+        valid, rcpu, rram = xs
+
+        # First-fit into already-planned nodes, in plan order; fitting pods
+        # deduct from the virtual allocatable (reference :81-87).
+        fit = planned & (rcpu[:, None] <= palloc_cpu) & (rram[:, None] <= palloc_ram)
+        any_fit = fit.any(axis=1)
+        first = jnp.argmin(jnp.where(fit, plan_seq, _BIG_I32), axis=1)
+        use = valid & any_fit
+        palloc_cpu = palloc_cpu.at[rows1, jnp.where(use, first, S)].add(
+            -rcpu, mode="drop"
+        )
+        palloc_ram = palloc_ram.at[rows1, jnp.where(use, first, S)].add(
+            -rram, mode="drop"
+        )
+
+        # Else open a node from the first fitting group (name-sorted at build).
+        can_open = valid & ~any_fit & (total < st.ca_max_nodes)
+        gcount = auto.ca_count + g_planned
+        g_ok = (
+            ((st.ng_max_count < 0) | (gcount < st.ng_max_count))
+            & (auto.ca_cursor + g_planned < st.ng_slot_count)
+            & (rcpu[:, None] <= st.ng_tmpl_cpu)
+            & (rram[:, None] <= st.ng_tmpl_ram)
+        )
+        g_found = g_ok.any(axis=1)
+        g = jnp.argmax(g_ok, axis=1)
+        open_ = can_open & g_found
+        s_new = (
+            st.ng_ca_start[rows1, g]
+            + auto.ca_cursor[rows1, g]
+            + g_planned[rows1, g]
+        )
+        s_tgt = jnp.where(open_, s_new, S)
+        planned = planned.at[rows1, s_tgt].set(True, mode="drop")
+        plan_seq = plan_seq.at[rows1, s_tgt].set(counter, mode="drop")
+        # The new node joins at FULL template allocatable: the triggering pod
+        # is NOT packed into it (reference quirk, kube_cluster_autoscaler.rs:210-218).
+        palloc_cpu = palloc_cpu.at[rows1, s_tgt].set(
+            st.ng_tmpl_cpu[rows1, g], mode="drop"
+        )
+        palloc_ram = palloc_ram.at[rows1, s_tgt].set(
+            st.ng_tmpl_ram[rows1, g], mode="drop"
+        )
+        g_planned = g_planned.at[rows1, jnp.where(open_, g, Gn)].add(1, mode="drop")
+        total = total + open_.astype(jnp.int32)
+        counter = counter + open_.astype(jnp.int32)
+        return (planned, plan_seq, palloc_cpu, palloc_ram, g_planned, total, counter), None
+
+    carry0 = (planned0, plan_seq0, palloc_cpu0, palloc_ram0, g_planned0, total0, counter0)
+    (planned, _, _, _, g_planned, _, _), _ = jax.lax.scan(
+        body, carry0, (cvalid.T, creq_cpu.T, creq_ram.T)
+    )
+    return planned, g_planned
+
+
+def _ca_scale_down(
+    state: ClusterBatchState,
+    auto: AutoscaleState,
+    st: AutoscaleStatics,
+    T: jnp.ndarray,
+    branch: jnp.ndarray,
+    K_sd: int,
+):
+    """Threshold + simulated-re-placement scale-down
+    (reference: kube_cluster_autoscaler.rs:242-290). Returns
+    (removed (C,S) bool, removed_per_group (C,Gn))."""
+    pods, nodes = state.pods, state.nodes
+    C, P = pods.phase.shape
+    N = nodes.alive.shape[1]
+    S = st.ca_slots.shape[1]
+    Gn = st.ng_ca_start.shape[1]
+    rows = jnp.arange(C)[:, None]
+    rows1 = jnp.arange(C)
+    col_n = jnp.arange(N)[None, :]
+
+    def outer(carry, xs):
+        valloc_cpu, valloc_ram = carry
+        slot, group = xs  # (C,) global node slot / owning group of this CA slot
+        slot_ok = (slot >= 0) & branch
+        slotc = jnp.clip(slot, 0, N - 1)
+        alive_here = nodes.alive[rows1, slotc] & slot_ok
+
+        cap_cpu = jnp.maximum(nodes.cap_cpu[rows1, slotc], 1).astype(jnp.float32)
+        cap_ram = jnp.maximum(nodes.cap_ram[rows1, slotc], 1).astype(jnp.float32)
+        used_cpu = (nodes.cap_cpu[rows1, slotc] - valloc_cpu[rows1, slotc]).astype(
+            jnp.float32
+        )
+        used_ram = (nodes.cap_ram[rows1, slotc] - valloc_ram[rows1, slotc]).astype(
+            jnp.float32
+        )
+        util = jnp.maximum(used_cpu / cap_cpu, used_ram / cap_ram)
+        # A node already pending removal (effect time beyond this window) must
+        # not be re-selected: it would double-decrement ca_count.
+        not_pending = nodes.remove_time[rows1, slotc] == INF
+        eligible = alive_here & not_pending & (util < st.ca_threshold)
+
+        # Pods assigned to this node (storage assignments include in-flight
+        # bindings, matching PHASE_RUNNING).
+        on = (pods.phase == PHASE_RUNNING) & (pods.node == slot[:, None])
+        on = on & slot_ok[:, None]
+        cnt = on.sum(axis=1)
+        attempt = eligible & (cnt <= K_sd)  # overflow: conservatively skip
+
+        pod_order = jnp.argsort(
+            jnp.where(on, jnp.arange(P)[None, :], _BIG_I32), axis=1
+        )[:, :K_sd]
+        pvalid = on[rows, pod_order] & attempt[:, None]
+        prcpu = pods.req_cpu[rows, pod_order]
+        prram = pods.req_ram[rows, pod_order]
+
+        save_cpu, save_ram = valloc_cpu, valloc_ram
+
+        def inner(icarry, ixs):
+            vcpu, vram, ok = icarry
+            pv, rcpu, rram = ixs
+            fit = (
+                nodes.alive
+                & (col_n != slot[:, None])
+                & (rcpu[:, None] <= vcpu)
+                & (rram[:, None] <= vram)
+            )
+            any_fit = fit.any(axis=1)
+            tgt = jnp.argmax(fit, axis=1)  # first-fit in slot order
+            place = pv & any_fit
+            vcpu = vcpu.at[rows1, jnp.where(place, tgt, N)].add(-rcpu, mode="drop")
+            vram = vram.at[rows1, jnp.where(place, tgt, N)].add(-rram, mode="drop")
+            ok = ok & (~pv | any_fit)
+            return (vcpu, vram, ok), None
+
+        (vcpu, vram, all_ok), _ = jax.lax.scan(
+            inner,
+            (valloc_cpu, valloc_ram, jnp.ones((C,), bool)),
+            (pvalid.T, prcpu.T, prram.T),
+        )
+        success = attempt & all_ok
+        # Commit the re-placement on success, roll back otherwise
+        # (reference :141-156); commits persist across later candidates.
+        valloc_cpu = jnp.where(success[:, None], vcpu, save_cpu)
+        valloc_ram = jnp.where(success[:, None], vram, save_ram)
+        return (valloc_cpu, valloc_ram), success
+
+    (_, _), removed_t = jax.lax.scan(
+        outer,
+        (nodes.alloc_cpu, nodes.alloc_ram),
+        (st.ca_slots.T, st.ca_slot_group.T),
+    )
+    removed = removed_t.T  # (C, S)
+    group_c = jnp.where(removed, st.ca_slot_group, Gn)
+    removed_per_group = (
+        jnp.zeros((C, Gn + 1), jnp.int32)
+        .at[rows, group_c]
+        .add(removed.astype(jnp.int32))[:, :Gn]
+    )
+    return removed, removed_per_group
+
+
+def ca_pass(
+    state: ClusterBatchState,
+    auto: AutoscaleState,
+    st: AutoscaleStatics,
+    T: jnp.ndarray,
+    K_up: int,
+    K_sd: int,
+) -> Tuple[ClusterBatchState, AutoscaleState]:
+    """One masked cluster-autoscaler cycle at time T (scalar equivalent:
+    cluster_autoscaler.py cycle; AUTO info policy: scale up iff the
+    unscheduled cache is non-empty, reference: persistent_storage.rs:381-412)."""
+    pods, nodes, metrics = state.pods, state.nodes, state.metrics
+
+    due = T >= auto.ca_next
+    in_cache = (pods.phase == PHASE_UNSCHEDULABLE) | (
+        (pods.phase == PHASE_QUEUED) & (pods.attempts >= 2)
+    )
+    any_unsched = in_cache.any(axis=1)
+    up_branch = due & any_unsched
+    down_branch = due & ~any_unsched
+
+    planned, planned_per_group = _ca_scale_up(state, auto, st, T, up_branch, K_up)
+    removed, removed_per_group = _ca_scale_down(state, auto, st, T, down_branch, K_sd)
+
+    # Planned slots come alive at their effect time; removals likewise.
+    C, S = planned.shape
+    N = nodes.alive.shape[1]
+    rows = jnp.arange(C)[:, None]
+    tgt_create = jnp.where(planned, st.ca_slots, N)
+    create_time = nodes.create_time.at[rows, tgt_create].min(
+        jnp.broadcast_to((T + st.d_ca_up)[:, None], (C, S)), mode="drop"
+    )
+    tgt_remove = jnp.where(removed, st.ca_slots, N)
+    remove_time = nodes.remove_time.at[rows, tgt_remove].min(
+        jnp.broadcast_to((T + st.d_ca_down)[:, None], (C, S)), mode="drop"
+    )
+
+    metrics = metrics._replace(
+        scaled_up_nodes=metrics.scaled_up_nodes + planned.sum(axis=1),
+        scaled_down_nodes=metrics.scaled_down_nodes + removed.sum(axis=1),
+    )
+    auto = auto._replace(
+        ca_count=auto.ca_count + planned_per_group - removed_per_group,
+        ca_cursor=auto.ca_cursor + planned_per_group,
+        ca_next=jnp.where(due, auto.ca_next + st.ca_interval, auto.ca_next),
+    )
+    state = state._replace(
+        nodes=nodes._replace(create_time=create_time, remove_time=remove_time),
+        metrics=metrics,
+    )
+    return state, auto

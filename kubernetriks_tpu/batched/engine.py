@@ -20,6 +20,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from kubernetriks_tpu.batched.autoscale import (
+    AutoscaleStatics,
+    init_autoscale_state,
+)
 from kubernetriks_tpu.batched.state import (
     DEFAULT_RAM_UNIT,
     PHASE_QUEUED,
@@ -35,7 +39,179 @@ from kubernetriks_tpu.batched.trace_compile import (
     compile_cluster_trace,
     pad_and_batch,
 )
-from kubernetriks_tpu.config import SimulationConfig
+from kubernetriks_tpu.config import (
+    KubeClusterAutoscalerConfig,
+    KubeHorizontalPodAutoscalerConfig,
+    SimulationConfig,
+)
+
+
+def build_autoscale_statics(
+    config: SimulationConfig,
+    compiled_traces,
+    n_pods: int,
+    n_trace_nodes: int,
+    ram_unit: int,
+    ca_slot_multiplier: int = 2,
+):
+    """Host-side compilation of pod-group (HPA) and node-group (CA) tables.
+
+    Returns (statics, extra_node_cap_cpu (S,), extra_node_cap_ram (S,),
+    extra_node_names); the extra node slots are the CA's reserved slots,
+    appended after the trace's node slots (the batched analog of pre-sizing the
+    component pool with the autoscaler max, reference: src/simulator.rs:212-230;
+    slots are never reused, hence the churn multiplier)."""
+    C = len(compiled_traces)
+    hpa_on = config.horizontal_pod_autoscaler.enabled
+    ca_on = config.cluster_autoscaler.enabled
+
+    # --- HPA pod groups -----------------------------------------------------
+    Gp = max((len(c.pod_groups) for c in compiled_traces), default=0) or 1
+    U = 1
+    for c in compiled_traces:
+        for g in c.pod_groups:
+            U = max(U, len(g.cpu_units), len(g.ram_units))
+
+    pg_slot_start = np.zeros((C, Gp), np.int32)
+    pg_slot_count = np.zeros((C, Gp), np.int32)
+    pg_initial = np.zeros((C, Gp), np.int32)
+    pg_max_pods = np.zeros((C, Gp), np.int32)
+    pg_target_cpu = np.zeros((C, Gp), np.float32)
+    pg_target_ram = np.zeros((C, Gp), np.float32)
+    pg_creation = np.full((C, Gp), np.inf, np.float32)
+    pg_cpu_dur = np.zeros((C, Gp, U), np.float32)
+    pg_cpu_load = np.zeros((C, Gp, U), np.float32)
+    pg_cpu_const = np.zeros((C, Gp), bool)
+    pg_ram_dur = np.zeros((C, Gp, U), np.float32)
+    pg_ram_load = np.zeros((C, Gp, U), np.float32)
+    pg_ram_const = np.zeros((C, Gp), bool)
+    pod_group_id = np.full((C, n_pods), -1, np.int32)
+
+    for ci, c in enumerate(compiled_traces):
+        for gi, g in enumerate(c.pod_groups):
+            pg_slot_start[ci, gi] = g.slot_start
+            pg_slot_count[ci, gi] = g.slot_count
+            pg_initial[ci, gi] = g.initial
+            pg_max_pods[ci, gi] = g.max_pods
+            pg_target_cpu[ci, gi] = g.target_cpu
+            pg_target_ram[ci, gi] = g.target_ram
+            # With HPA disabled the group's initial pods still run (the
+            # api-server expansion is unconditional) but no cycle ever acts.
+            pg_creation[ci, gi] = g.creation_time if hpa_on else np.inf
+            for ui, (dur, load) in enumerate(g.cpu_units):
+                pg_cpu_dur[ci, gi, ui] = dur
+                pg_cpu_load[ci, gi, ui] = load
+            pg_cpu_const[ci, gi] = g.cpu_const
+            for ui, (dur, load) in enumerate(g.ram_units):
+                pg_ram_dur[ci, gi, ui] = dur
+                pg_ram_load[ci, gi, ui] = load
+            pg_ram_const[ci, gi] = g.ram_const
+            pod_group_id[ci, g.slot_start : g.slot_start + g.slot_count] = gi
+
+    # --- CA node groups -----------------------------------------------------
+    ca_config = config.cluster_autoscaler
+    groups = (
+        sorted(
+            ca_config.node_groups, key=lambda g: g.node_template.metadata.name
+        )
+        if ca_on
+        else []
+    )
+    Gn = len(groups) or 1
+    reserves = []
+    for g in groups:
+        per_group_cap = g.max_count if g.max_count is not None else ca_config.max_node_count
+        reserves.append(min(per_group_cap, ca_config.max_node_count) * ca_slot_multiplier)
+    S = sum(reserves) or 1
+
+    ng_ca_start = np.zeros((C, Gn), np.int32)
+    ng_slot_count = np.zeros((C, Gn), np.int32)
+    ng_max_count = np.full((C, Gn), -1, np.int32)
+    ng_tmpl_cpu = np.zeros((C, Gn), np.int32)
+    ng_tmpl_ram = np.zeros((C, Gn), np.int32)
+    ca_slots = np.full((C, S), -1, np.int32)
+    ca_slot_group = np.full((C, S), -1, np.int32)
+    extra_cap_cpu = np.zeros((S,), np.int32)
+    extra_cap_ram = np.zeros((S,), np.int32)
+    extra_node_names = []
+
+    cursor = 0
+    for gi, (g, reserve) in enumerate(zip(groups, reserves)):
+        name = g.node_template.metadata.name
+        assert name, "CA node templates must be named"
+        cap = g.node_template.status.capacity
+        ng_ca_start[:, gi] = cursor
+        ng_slot_count[:, gi] = reserve
+        ng_max_count[:, gi] = -1 if g.max_count is None else g.max_count
+        ng_tmpl_cpu[:, gi] = int(cap.cpu)
+        ng_tmpl_ram[:, gi] = int(cap.ram) // ram_unit
+        for k in range(reserve):
+            ca_slots[:, cursor + k] = n_trace_nodes + cursor + k
+            ca_slot_group[:, cursor + k] = gi
+            extra_cap_cpu[cursor + k] = int(cap.cpu)
+            extra_cap_ram[cursor + k] = int(cap.ram) // ram_unit
+            extra_node_names.append(f"{name}_{k + 1}")
+        cursor += reserve
+
+    delays = config
+    d_pod_enqueue = delays.as_to_ps_network_delay + delays.ps_to_sched_network_delay
+    hpa_tol = (
+        config.horizontal_pod_autoscaler.kube_horizontal_pod_autoscaler_config
+        or KubeHorizontalPodAutoscalerConfig()
+    ).target_threshold_tolerance
+    ca_thresh = (
+        ca_config.kube_cluster_autoscaler or KubeClusterAutoscalerConfig()
+    ).scale_down_utilization_threshold
+
+    f32 = lambda x: jnp.asarray(x, jnp.float32)  # noqa: E731
+    statics = AutoscaleStatics(
+        pg_slot_start=jnp.asarray(pg_slot_start),
+        pg_slot_count=jnp.asarray(pg_slot_count),
+        pg_initial=jnp.asarray(pg_initial),
+        pg_max_pods=jnp.asarray(pg_max_pods),
+        pg_target_cpu=jnp.asarray(pg_target_cpu),
+        pg_target_ram=jnp.asarray(pg_target_ram),
+        pg_creation=jnp.asarray(pg_creation),
+        pg_cpu_dur=jnp.asarray(pg_cpu_dur),
+        pg_cpu_load=jnp.asarray(pg_cpu_load),
+        pg_cpu_total=jnp.asarray(pg_cpu_dur.sum(axis=-1)),
+        pg_cpu_const=jnp.asarray(pg_cpu_const),
+        pg_ram_dur=jnp.asarray(pg_ram_dur),
+        pg_ram_load=jnp.asarray(pg_ram_load),
+        pg_ram_total=jnp.asarray(pg_ram_dur.sum(axis=-1)),
+        pg_ram_const=jnp.asarray(pg_ram_const),
+        pod_group_id=jnp.asarray(pod_group_id),
+        ng_ca_start=jnp.asarray(ng_ca_start),
+        ng_slot_count=jnp.asarray(ng_slot_count),
+        ng_max_count=jnp.asarray(ng_max_count),
+        ng_tmpl_cpu=jnp.asarray(ng_tmpl_cpu),
+        ng_tmpl_ram=jnp.asarray(ng_tmpl_ram),
+        ca_max_nodes=jnp.full(
+            (C,), ca_config.max_node_count if ca_on else 0, jnp.int32
+        ),
+        ca_slots=jnp.asarray(ca_slots),
+        ca_slot_group=jnp.asarray(ca_slot_group),
+        hpa_interval=f32(config.horizontal_pod_autoscaler.scan_interval),
+        ca_interval=f32(ca_config.scan_interval),
+        hpa_tolerance=f32(hpa_tol),
+        ca_threshold=f32(ca_thresh),
+        d_hpa_register=f32(delays.as_to_hpa_network_delay),
+        d_hpa_up=f32(delays.as_to_ca_network_delay + d_pod_enqueue),
+        d_hpa_down=f32(
+            delays.as_to_ca_network_delay + delays.as_to_ps_network_delay
+        ),
+        d_ca_up=f32(
+            3.0 * delays.as_to_ca_network_delay
+            + 5.0 * delays.as_to_ps_network_delay
+            + delays.ps_to_sched_network_delay
+        ),
+        d_ca_down=f32(
+            3.0 * delays.as_to_ca_network_delay
+            + 4.0 * delays.as_to_ps_network_delay
+            + delays.as_to_node_network_delay
+        ),
+    )
+    return statics, extra_cap_cpu, extra_cap_ram, extra_node_names
 
 
 class BatchedSimulation:
@@ -48,6 +224,9 @@ class BatchedSimulation:
         max_pods_per_cycle: Optional[int] = None,
         mesh: Optional[Mesh] = None,
         batch_axis: str = "clusters",
+        ca_slot_multiplier: int = 2,
+        max_ca_pods_per_cycle: int = 64,
+        max_pods_per_scale_down: int = 8,
     ) -> None:
         self.config = config
         if config.enable_unscheduled_pods_conditional_move:
@@ -71,6 +250,35 @@ class BatchedSimulation:
             pod_req_ram,
             pod_duration,
         ) = pad_and_batch(compiled_traces)
+
+        # Autoscaler tables (HPA pod groups from the trace, CA node groups from
+        # the config); the CA's reserved node slots are appended after the
+        # trace's slots.
+        hpa_on = config.horizontal_pod_autoscaler.enabled
+        ca_on = config.cluster_autoscaler.enabled
+        self.autoscale_statics = None
+        self.max_ca_pods_per_cycle = max_ca_pods_per_cycle
+        self.max_pods_per_scale_down = max_pods_per_scale_down
+        self.pod_group_names = [[g.name for g in c.pod_groups] for c in compiled_traces]
+        if hpa_on or ca_on:
+            statics, extra_cpu, extra_ram, extra_names = build_autoscale_statics(
+                config,
+                compiled_traces,
+                n_pods=pod_req_cpu.shape[1],
+                n_trace_nodes=node_cap_cpu.shape[1],
+                ram_unit=ram_unit,
+                ca_slot_multiplier=ca_slot_multiplier,
+            )
+            self.autoscale_statics = statics
+            if ca_on and extra_names:
+                node_cap_cpu = np.concatenate(
+                    [node_cap_cpu, np.tile(extra_cpu, (C, 1))], axis=1
+                )
+                node_cap_ram = np.concatenate(
+                    [node_cap_ram, np.tile(extra_ram, (C, 1))], axis=1
+                )
+        else:
+            extra_names = []
 
         self.n_clusters = C
         self.n_nodes = node_cap_cpu.shape[1]
@@ -96,33 +304,45 @@ class BatchedSimulation:
             pod_req_ram,
             pod_duration,
         )
+        if self.autoscale_statics is not None:
+            self.state = self.state._replace(
+                auto=init_autoscale_state(self.autoscale_statics)
+            )
         self.slab = TraceSlab(
             time=jnp.asarray(ev_time),
             kind=jnp.asarray(ev_kind),
             slot=jnp.asarray(ev_slot),
         )
-        self.node_names = [c.node_names for c in compiled_traces]
+        self.node_names = [c.node_names + extra_names for c in compiled_traces]
         self.pod_names = [c.pod_names for c in compiled_traces]
         self.next_window = 0.0
 
         self.mesh = mesh
         if mesh is not None:
             sharding = NamedSharding(mesh, PartitionSpec(batch_axis))
-            self.state = jax.device_put(self.state, self._state_shardings(sharding))
+            self.state = jax.device_put(self.state, self._state_shardings(sharding, self.state))
             self.slab = jax.device_put(
                 self.slab, NamedSharding(mesh, PartitionSpec(batch_axis, None))
             )
+            if self.autoscale_statics is not None:
+                self.autoscale_statics = jax.device_put(
+                    self.autoscale_statics,
+                    self._state_shardings(sharding, self.autoscale_statics),
+                )
 
-    def _state_shardings(self, sharding):
-        """Every leaf leads with the C axis; shard axis 0, replicate the rest."""
+    def _state_shardings(self, sharding, tree):
+        """Every non-scalar leaf leads with the C axis; shard axis 0,
+        replicate the rest (scalars are replicated)."""
 
         def leaf_sharding(leaf):
+            if leaf.ndim == 0:
+                return NamedSharding(sharding.mesh, PartitionSpec())
             spec = PartitionSpec(
                 *([sharding.spec[0]] + [None] * (leaf.ndim - 1))
             )
             return NamedSharding(sharding.mesh, spec)
 
-        return jax.tree.map(leaf_sharding, self.state)
+        return jax.tree.map(leaf_sharding, tree)
 
     def _max_events_in_any_window(self, ev_time: np.ndarray) -> int:
         """Worst-case events falling into one (cluster, scheduling-window)
@@ -157,6 +377,9 @@ class BatchedSimulation:
             self.consts,
             self.max_events_per_window,
             self.max_pods_per_cycle,
+            self.autoscale_statics,
+            self.max_ca_pods_per_cycle,
+            self.max_pods_per_scale_down,
         )
         self.next_window = float(windows[-1]) + self.config.scheduling_cycle_interval
 
@@ -169,6 +392,9 @@ class BatchedSimulation:
             self.consts,
             self.max_events_per_window,
             self.max_pods_per_cycle,
+            self.autoscale_statics,
+            self.max_ca_pods_per_cycle,
+            self.max_pods_per_scale_down,
         )
         self.next_window += self.config.scheduling_cycle_interval
 
@@ -228,6 +454,10 @@ class BatchedSimulation:
                 "terminated_pods": int(np.asarray(m.terminated_pods).sum()),
                 "processed_nodes": int(np.asarray(m.processed_nodes).sum()),
                 "scheduling_decisions": int(np.asarray(m.scheduling_decisions).sum()),
+                "total_scaled_up_pods": int(np.asarray(m.scaled_up_pods).sum()),
+                "total_scaled_down_pods": int(np.asarray(m.scaled_down_pods).sum()),
+                "total_scaled_up_nodes": int(np.asarray(m.scaled_up_nodes).sum()),
+                "total_scaled_down_nodes": int(np.asarray(m.scaled_down_nodes).sum()),
             },
             "timings": {
                 "pod_duration": est(m.pod_duration),
@@ -244,6 +474,22 @@ class BatchedSimulation:
             "terminated_pods": int(m.terminated_pods[cluster]),
             "scheduling_decisions": int(m.scheduling_decisions[cluster]),
         }
+
+    def hpa_replicas(self, cluster: int) -> Dict[str, int]:
+        """Per-pod-group created replica counts (scalar equivalent:
+        len(PodGroupInfo.created_pods))."""
+        auto = self.state.auto
+        assert auto is not None, "autoscaling is not enabled"
+        head = np.asarray(auto.hpa_head[cluster])
+        tail = np.asarray(auto.hpa_tail[cluster])
+        names = self.pod_group_names[cluster]
+        return {name: int(tail[i] - head[i]) for i, name in enumerate(names)}
+
+    def ca_node_counts(self, cluster: int) -> np.ndarray:
+        """Current cluster-autoscaler node count per node group."""
+        auto = self.state.auto
+        assert auto is not None, "autoscaling is not enabled"
+        return np.asarray(auto.ca_count[cluster])
 
     def pod_view(self, cluster: int) -> Dict[str, Dict]:
         """Name-keyed pod states for equivalence tests against the scalar path."""

@@ -18,7 +18,7 @@ Design rules:
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax.numpy as jnp
 import numpy as np
@@ -52,6 +52,9 @@ class NodeArrays(NamedTuple):
     cap_ram: jnp.ndarray  # int32 ram units
     alloc_cpu: jnp.ndarray  # int32
     alloc_ram: jnp.ndarray  # int32
+    # Pending on-device effects (cluster-autoscaler actions); +inf = none.
+    create_time: jnp.ndarray  # float32
+    remove_time: jnp.ndarray  # float32
 
 
 class PodArrays(NamedTuple):
@@ -68,6 +71,7 @@ class PodArrays(NamedTuple):
     node: jnp.ndarray  # int32 node slot, -1 = none
     start_time: jnp.ndarray  # float32
     finish_time: jnp.ndarray  # float32, +inf = no pending finish
+    removal_time: jnp.ndarray  # float32 pending HPA scale-down effect; +inf = none
 
 
 class EstArrays(NamedTuple):
@@ -109,6 +113,10 @@ class MetricArrays(NamedTuple):
     terminated_pods: jnp.ndarray  # int32
     processed_nodes: jnp.ndarray  # int32
     scheduling_decisions: jnp.ndarray  # int32: successful assignments (bench metric)
+    scaled_up_pods: jnp.ndarray  # int32 (HPA)
+    scaled_down_pods: jnp.ndarray  # int32 (HPA)
+    scaled_up_nodes: jnp.ndarray  # int32 (CA)
+    scaled_down_nodes: jnp.ndarray  # int32 (CA)
     queue_time: EstArrays
     algo_latency: EstArrays
     pod_duration: EstArrays
@@ -126,6 +134,8 @@ class ClusterBatchState(NamedTuple):
     nodes: NodeArrays
     pods: PodArrays
     metrics: MetricArrays
+    # Dynamic autoscaler state (AutoscaleState) or None when autoscaling is off.
+    auto: Optional[NamedTuple] = None
 
 
 class TraceSlab(NamedTuple):
@@ -193,6 +203,8 @@ def init_state(
         cap_ram=jnp.asarray(node_cap_ram, jnp.int32),
         alloc_cpu=jnp.asarray(node_cap_cpu, jnp.int32),
         alloc_ram=jnp.asarray(node_cap_ram, jnp.int32),
+        create_time=jnp.full((C, N), INF, jnp.float32),
+        remove_time=jnp.full((C, N), INF, jnp.float32),
     )
     pods = PodArrays(
         phase=jnp.zeros((C, P), jnp.int32),
@@ -206,6 +218,7 @@ def init_state(
         node=jnp.full((C, P), -1, jnp.int32),
         start_time=jnp.zeros((C, P), jnp.float32),
         finish_time=jnp.full((C, P), INF, jnp.float32),
+        removal_time=jnp.full((C, P), INF, jnp.float32),
     )
     metrics = MetricArrays(
         pods_succeeded=jnp.zeros((C,), jnp.int32),
@@ -213,6 +226,10 @@ def init_state(
         terminated_pods=jnp.zeros((C,), jnp.int32),
         processed_nodes=jnp.zeros((C,), jnp.int32),
         scheduling_decisions=jnp.zeros((C,), jnp.int32),
+        scaled_up_pods=jnp.zeros((C,), jnp.int32),
+        scaled_down_pods=jnp.zeros((C,), jnp.int32),
+        scaled_up_nodes=jnp.zeros((C,), jnp.int32),
+        scaled_down_nodes=jnp.zeros((C,), jnp.int32),
         queue_time=EstArrays.zeros((C,)),
         algo_latency=EstArrays.zeros((C,)),
         pod_duration=EstArrays.zeros((C,)),

@@ -105,12 +105,22 @@ def _apply_window_events(
     created = (
         jnp.zeros((C, N), bool).at[rows, drop_slot(is_cn, N)].set(True, mode="drop")
     )
+    # Pending autoscaler creations due this window (CA scale-up effects).
+    pend_create = (nodes.create_time < window_end[:, None]) & ~nodes.alive
+    created = created | pend_create
+    node_create_time = jnp.where(pend_create, INF, nodes.create_time)
     # --- node removal times (scatter-min; +inf = not removed this window) ---
     node_removal = (
         jnp.full((C, N), INF)
         .at[rows, drop_slot(is_rn, N)]
         .min(jnp.where(is_rn, ev_t, INF), mode="drop")
     )
+    # Pending autoscaler removals due this window (CA scale-down effects).
+    pend_remove = jnp.where(
+        nodes.remove_time < window_end[:, None], nodes.remove_time, INF
+    )
+    node_removal = jnp.minimum(node_removal, pend_remove)
+    node_remove_time = jnp.where(pend_remove < INF, INF, nodes.remove_time)
     # --- pod creations ------------------------------------------------------
     pod_create_ts = (
         jnp.full((C, P), INF)
@@ -134,6 +144,12 @@ def _apply_window_events(
         .at[rows, drop_slot(is_rp, P)]
         .min(jnp.where(is_rp, ev_t, INF), mode="drop")
     )
+    # Pending HPA scale-down removals due this window.
+    pend_pod_removal = jnp.where(
+        pods.removal_time < window_end[:, None], pods.removal_time, INF
+    )
+    pod_removal = jnp.minimum(pod_removal, pend_pod_removal)
+    pod_removal_time = jnp.where(pend_pod_removal < INF, INF, pods.removal_time)
 
     # --- apply creations ----------------------------------------------------
     alive = nodes.alive | created
@@ -176,7 +192,7 @@ def _apply_window_events(
         pods_succeeded=metrics.pods_succeeded + n_done,
         terminated_pods=metrics.terminated_pods + n_done,
         pod_duration=_est_add_reduced(metrics.pod_duration, pods.duration, finishes),
-        processed_nodes=metrics.processed_nodes + is_cn.sum(axis=1).astype(jnp.int32),
+        processed_nodes=metrics.processed_nodes + created.sum(axis=1).astype(jnp.int32),
     )
     phase = jnp.where(finishes, PHASE_SUCCEEDED, phase)
     finish_time = jnp.where(finishes, INF, pods.finish_time)
@@ -222,10 +238,16 @@ def _apply_window_events(
     alive = alive & ~(node_removal < INF)
 
     applied = valid.sum(axis=1).astype(jnp.int32)
-    any_created_node = is_cn.any(axis=1)
+    any_created_node = created.any(axis=1)
 
     return state._replace(
-        nodes=nodes._replace(alive=alive, alloc_cpu=alloc_cpu, alloc_ram=alloc_ram),
+        nodes=nodes._replace(
+            alive=alive,
+            alloc_cpu=alloc_cpu,
+            alloc_ram=alloc_ram,
+            create_time=node_create_time,
+            remove_time=node_remove_time,
+        ),
         pods=pods._replace(
             phase=phase,
             queue_ts=queue_ts,
@@ -234,6 +256,7 @@ def _apply_window_events(
             attempts=attempts,
             node=pod_node,
             finish_time=finish_time,
+            removal_time=pod_removal_time,
         ),
         metrics=metrics,
         event_cursor=state.event_cursor + applied,
@@ -490,16 +513,44 @@ def _window_body(
     consts: StepConstants,
     max_events_per_window: int,
     max_pods_per_cycle: int,
+    autoscale_statics=None,
+    max_ca_pods_per_cycle: int = 64,
+    max_pods_per_scale_down: int = 8,
 ) -> ClusterBatchState:
     window_end = jnp.broadcast_to(window_end, state.time.shape)
     state = _apply_window_events(
         state, slab, window_end, consts, max_events_per_window
     )
     state = _run_scheduling_cycle(state, window_end, consts, max_pods_per_cycle)
+    if autoscale_statics is not None:
+        # Autoscaler ticks due by this window run after the scheduling cycle
+        # (the scalar snapshot lands between cycles; SURVEY.md §3.5); their
+        # effects land at composed future times via the pending-effect arrays.
+        from kubernetriks_tpu.batched.autoscale import ca_pass, hpa_pass
+
+        auto = state.auto
+        state, auto = hpa_pass(state, auto, autoscale_statics, window_end)
+        state, auto = ca_pass(
+            state,
+            auto,
+            autoscale_statics,
+            window_end,
+            max_ca_pods_per_cycle,
+            max_pods_per_scale_down,
+        )
+        state = state._replace(auto=auto)
     return state
 
 
-@partial(jax.jit, static_argnames=("max_events_per_window", "max_pods_per_cycle"))
+_STEP_STATICS = (
+    "max_events_per_window",
+    "max_pods_per_cycle",
+    "max_ca_pods_per_cycle",
+    "max_pods_per_scale_down",
+)
+
+
+@partial(jax.jit, static_argnames=_STEP_STATICS)
 def window_step(
     state: ClusterBatchState,
     slab: TraceSlab,
@@ -507,14 +558,25 @@ def window_step(
     consts: StepConstants,
     max_events_per_window: int,
     max_pods_per_cycle: int,
+    autoscale_statics=None,
+    max_ca_pods_per_cycle: int = 64,
+    max_pods_per_scale_down: int = 8,
 ) -> ClusterBatchState:
     """Advance every cluster to `window_end` (the next scheduling-cycle time)."""
     return _window_body(
-        state, slab, window_end, consts, max_events_per_window, max_pods_per_cycle
+        state,
+        slab,
+        window_end,
+        consts,
+        max_events_per_window,
+        max_pods_per_cycle,
+        autoscale_statics,
+        max_ca_pods_per_cycle,
+        max_pods_per_scale_down,
     )
 
 
-@partial(jax.jit, static_argnames=("max_events_per_window", "max_pods_per_cycle"))
+@partial(jax.jit, static_argnames=_STEP_STATICS)
 def run_windows(
     state: ClusterBatchState,
     slab: TraceSlab,
@@ -522,6 +584,9 @@ def run_windows(
     consts: StepConstants,
     max_events_per_window: int,
     max_pods_per_cycle: int,
+    autoscale_statics=None,
+    max_ca_pods_per_cycle: int = 64,
+    max_pods_per_scale_down: int = 8,
 ) -> ClusterBatchState:
     """Scan a whole sequence of scheduling-cycle windows on-device (the hot
     benchmark loop: no host round-trips between cycles)."""
@@ -529,7 +594,15 @@ def run_windows(
     def body(carry, w):
         return (
             _window_body(
-                carry, slab, w, consts, max_events_per_window, max_pods_per_cycle
+                carry,
+                slab,
+                w,
+                consts,
+                max_events_per_window,
+                max_pods_per_cycle,
+                autoscale_statics,
+                max_ca_pods_per_cycle,
+                max_pods_per_scale_down,
             ),
             None,
         )

@@ -25,11 +25,50 @@ from kubernetriks_tpu.batched.state import (
 )
 from kubernetriks_tpu.core.events import (
     CreateNodeRequest,
+    CreatePodGroupRequest,
     CreatePodRequest,
     RemoveNodeRequest,
     RemovePodRequest,
 )
 from kubernetriks_tpu.trace.interface import TraceEvents
+
+
+@dataclass
+class CompiledPodGroup:
+    """Host-side pod-group table for the batched HPA: reserved slot range,
+    targets, and the load curve compiled out of the nested YAML usage-model
+    config (reference: src/core/resource_usage/interface.rs:13-18)."""
+
+    name: str
+    slot_start: int
+    slot_count: int  # reserved slots = multiplier x max_pod_count
+    max_pods: int
+    initial: int
+    creation_time: float
+    target_cpu: float  # <=0 means unset
+    target_ram: float
+    cpu_units: List[Tuple[float, float]]  # (duration, load); [] = no model
+    cpu_const: bool
+    ram_units: List[Tuple[float, float]]
+    ram_const: bool
+
+
+def _compile_usage_model(model_config) -> Tuple[List[Tuple[float, float]], bool]:
+    """ResourceUsageModelConfig -> (units, is_constant). A constant model's
+    load IS the utilization; a pod_group model's load is divided by the live
+    pod count (reference: src/core/resource_usage/{constant,pod_group}.rs)."""
+    import yaml
+
+    if model_config is None:
+        return [], False
+    parsed = yaml.safe_load(model_config.config)
+    if model_config.model_name == "constant":
+        return [(1.0, float(parsed["usage"]))], True
+    if model_config.model_name == "pod_group":
+        return [
+            (float(u["duration"]), float(u["total_load"])) for u in parsed
+        ], False
+    raise ValueError(f"unknown usage model {model_config.model_name!r}")
 
 
 @dataclass
@@ -46,6 +85,7 @@ class CompiledClusterTrace:
     pod_duration: np.ndarray  # (P,) float32 (-1 for long-running)
     node_names: List[str] = field(default_factory=list)
     pod_names: List[str] = field(default_factory=list)
+    pod_groups: List[CompiledPodGroup] = field(default_factory=list)
 
     @property
     def n_events(self) -> int:
@@ -65,6 +105,7 @@ def compile_cluster_trace(
     workload_events: TraceEvents,
     config=None,
     ram_unit: int = DEFAULT_RAM_UNIT,
+    pod_group_slot_multiplier: int = 2,
 ) -> CompiledClusterTrace:
     """Merge + time-sort both traces (stable: cluster events first at equal
     times, matching the scalar initialize() emission order, reference:
@@ -116,6 +157,7 @@ def compile_cluster_trace(
     pod_duration: List[float] = []
     pod_names: List[str] = []
     pod_slot: Dict[str, int] = {}
+    pod_groups: List[CompiledPodGroup] = []
 
     for ts, _, event in merged:
         if isinstance(event, CreateNodeRequest):
@@ -150,6 +192,56 @@ def compile_cluster_trace(
             ev_time.append(ts)
             ev_kind.append(EV_REMOVE_POD)
             ev_slot.append(pod_slot[event.pod_name])
+        elif isinstance(event, CreatePodGroupRequest):
+            group = event.pod_group
+            template = group.pod_template
+            assert template.spec.running_duration is None, (
+                "Pod groups with specified duration are not supported. "
+                "Only long running services."
+            )
+            umc = group.resources_usage_model_config
+            cpu_units, cpu_const = _compile_usage_model(
+                umc.cpu_config if umc else None
+            )
+            ram_units, ram_const = _compile_usage_model(
+                umc.ram_config if umc else None
+            )
+            slot_start = len(pod_req_cpu)
+            slot_count = max(
+                group.initial_pod_count,
+                pod_group_slot_multiplier * group.max_pod_count,
+            )
+            requests = template.spec.resources.requests
+            for i in range(slot_count):
+                pod_req_cpu.append(int(requests.cpu))
+                pod_req_ram.append(-(-int(requests.ram) // ram_unit))
+                pod_duration.append(-1.0)
+                name = f"{group.name}_{i}"
+                pod_slot[name] = len(pod_names)
+                pod_names.append(name)
+            # Initial pods hit the api server at the group's trace time
+            # (reference expansion: src/core/api_server.rs:405-455).
+            for i in range(group.initial_pod_count):
+                ev_time.append(ts)
+                ev_kind.append(EV_CREATE_POD)
+                ev_slot.append(slot_start + i)
+            targets = group.target_resources_usage
+            pod_groups.append(
+                CompiledPodGroup(
+                    name=group.name,
+                    slot_start=slot_start,
+                    slot_count=slot_count,
+                    max_pods=group.max_pod_count,
+                    initial=group.initial_pod_count,
+                    creation_time=float(ts),
+                    target_cpu=float(targets.cpu_utilization or 0.0),
+                    target_ram=float(targets.ram_utilization or 0.0),
+                    cpu_units=cpu_units,
+                    cpu_const=cpu_const,
+                    ram_units=ram_units,
+                    ram_const=ram_const,
+                )
+            )
         else:
             raise ValueError(
                 f"batched path does not support trace event {type(event).__name__}"
@@ -166,6 +258,7 @@ def compile_cluster_trace(
         pod_duration=np.asarray(pod_duration, np.float32).reshape(-1),
         node_names=node_names,
         pod_names=pod_names,
+        pod_groups=pod_groups,
     )
 
 

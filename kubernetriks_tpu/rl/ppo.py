@@ -19,7 +19,7 @@ import optax
 
 from kubernetriks_tpu.batched.engine import BatchedSimulation
 from kubernetriks_tpu.rl.env import Transition, rollout
-from kubernetriks_tpu.rl.policy import NODE_FEATURES, SchedulerPolicy
+from kubernetriks_tpu.rl.policy import init_policy
 
 
 class PPOConfig(NamedTuple):
@@ -151,17 +151,18 @@ class PPOTrainer:
         hidden: int = 64,
         seed: int = 0,
     ) -> None:
+        assert sim.autoscale_statics is None, (
+            "PPOTrainer rollouts do not yet run the HPA/CA passes; train "
+            "against a simulation with autoscaling disabled"
+        )
         self.sim = sim
         self.config = config
         self.windows = np.arange(windows_per_rollout) * sim.config.scheduling_cycle_interval
-        self.policy = SchedulerPolicy(hidden=hidden)
-        self.policy_apply = self.policy.apply
         rng = jax.random.PRNGKey(seed)
         self.rng, init_rng = jax.random.split(rng)
         n_nodes = sim.state.nodes.alive.shape[1]
-        self.params = self.policy.init(
-            init_rng, jnp.zeros((1, n_nodes, NODE_FEATURES))
-        )
+        self.policy, self.params = init_policy(init_rng, n_nodes, hidden=hidden)
+        self.policy_apply = self.policy.apply
         self.optimizer = optax.adam(config.learning_rate)
         self.opt_state = self.optimizer.init(self.params)
         self.initial_state = sim.state

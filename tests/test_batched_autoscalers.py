@@ -1,0 +1,160 @@
+"""Batched HPA / cluster-autoscaler passes reproduce the scalar golden
+scenarios (tests/test_hpa.py, tests/test_cluster_autoscaler.py) on a whole
+cluster batch at once."""
+
+import numpy as np
+
+from kubernetriks_tpu.batched.engine import build_batched_from_traces
+from kubernetriks_tpu.test_util import default_test_simulation_config
+from kubernetriks_tpu.trace.generic import GenericClusterTrace, GenericWorkloadTrace
+
+from tests.test_hpa import CLUSTER_TRACE, WORKLOAD_TRACE
+
+N_CLUSTERS = 3
+
+
+def _build(config, cluster_yaml, workload_yaml, **kwargs):
+    return build_batched_from_traces(
+        config,
+        GenericClusterTrace.from_yaml(cluster_yaml).convert_to_simulator_events(),
+        GenericWorkloadTrace.from_yaml(workload_yaml).convert_to_simulator_events(),
+        n_clusters=N_CLUSTERS,
+        **kwargs,
+    )
+
+
+def test_batched_hpa_golden_trajectory():
+    """Replica counts 5->9->14->(hold)->4->(hold)->7->12->14 at the 60 s
+    cycle boundaries, identically in every cluster of the batch (scalar
+    golden: tests/test_hpa.py; reference: tests/test_hpa.rs:90-135)."""
+    config = default_test_simulation_config()
+    config.horizontal_pod_autoscaler.enabled = True
+
+    sim = _build(config, CLUSTER_TRACE, WORKLOAD_TRACE)
+    expected = [
+        (61.0, 5),
+        (121.0, 9),
+        (181.0, 14),
+        (450.0, 14),
+        (600.5, 4),
+        (759.5, 4),
+        (781.0, 7),
+        (841.0, 12),
+        (901.0, 14),
+        (1200.0, 14),
+    ]
+    for until, replicas in expected:
+        sim.step_until_time(until)
+        for c in range(N_CLUSTERS):
+            assert sim.hpa_replicas(c) == {"pod_group_1": replicas}, (
+                f"at t={until}"
+            )
+
+    counters = sim.metrics_summary()["counters"]
+    assert counters["total_scaled_up_pods"] == (4 + 5 + 3 + 5 + 2) * N_CLUSTERS
+    assert counters["total_scaled_down_pods"] == 10 * N_CLUSTERS
+
+
+def test_batched_hpa_scaled_down_pods_terminate():
+    """Scale-down marks the oldest pods for removal; they terminate as removed
+    and free node resources."""
+    config = default_test_simulation_config()
+    config.horizontal_pod_autoscaler.enabled = True
+
+    sim = _build(config, CLUSTER_TRACE, WORKLOAD_TRACE)
+    sim.step_until_time(700.0)  # after the 14 -> 4 scale-down
+    counters = sim.metrics_summary()["counters"]
+    assert counters["pods_removed"] == 10 * N_CLUSTERS
+    # The 4 survivors are still running.
+    view = sim.pod_view(0)
+    from kubernetriks_tpu.batched.state import PHASE_REMOVED, PHASE_RUNNING
+
+    phases = [v["phase"] for v in view.values()]
+    assert phases.count(PHASE_RUNNING) == 4
+    assert phases.count(PHASE_REMOVED) == 10
+
+
+CA_CONFIG_SUFFIX = """
+cluster_autoscaler:
+  enabled: true
+  scan_interval: 10.0
+  max_node_count: 10
+  node_groups:
+  - node_template:
+      metadata:
+        name: autoscaler_node
+      status:
+        capacity:
+          cpu: 16000
+          ram: 34359738368
+"""
+
+
+def _ca_workload(n_pods=4, cpu=4000, ram=8589934592, duration=50.0):
+    return "events:" + "".join(
+        f"""
+- timestamp: {5 + i}
+  event_type:
+    !CreatePod
+      pod:
+        metadata:
+          name: pod_{i}
+        spec:
+          resources:
+            requests:
+              cpu: {cpu}
+              ram: {ram}
+            limits:
+              cpu: {cpu}
+              ram: {ram}
+          running_duration: {duration}
+"""
+        for i in range(n_pods)
+    )
+
+
+def test_batched_ca_scale_up_then_down():
+    """Pods arrive with no cluster; CA bin-packs them onto one scaled-up node;
+    after they finish, the idle node is scaled back down (scalar golden:
+    tests/test_cluster_autoscaler.py::test_end_to_end_scale_up_then_down)."""
+    config = default_test_simulation_config(CA_CONFIG_SUFFIX)
+    sim = _build(config, "", _ca_workload())
+
+    sim.step_until_time(300.0)
+    counters = sim.metrics_summary()["counters"]
+    assert counters["pods_succeeded"] == 4 * N_CLUSTERS
+    # All four pods fit one 16000-millicore template node.
+    assert counters["total_scaled_up_nodes"] == 1 * N_CLUSTERS
+    assert counters["total_scaled_down_nodes"] == 1 * N_CLUSTERS
+    for c in range(N_CLUSTERS):
+        assert sim.ca_node_counts(c).sum() == 0
+    # The scaled-up node slot is dead again.
+    assert not np.asarray(sim.state.nodes.alive).any()
+
+
+def test_batched_ca_respects_global_max():
+    """max_node_count=1 caps scale-up regardless of demand."""
+    suffix = CA_CONFIG_SUFFIX.replace("max_node_count: 10", "max_node_count: 1")
+    config = default_test_simulation_config(suffix)
+    # 8 pods x 4000 mcpu need 2 nodes; quota allows 1.
+    sim = _build(config, "", _ca_workload(n_pods=8))
+
+    sim.step_until_time(100.0)
+    counters = sim.metrics_summary()["counters"]
+    assert counters["total_scaled_up_nodes"] == 1 * N_CLUSTERS
+
+
+def test_batched_ca_scale_down_waits_for_movable_pods():
+    """A CA node keeps long-running pods that fit nowhere else: never scaled
+    down while they run."""
+    config = default_test_simulation_config(CA_CONFIG_SUFFIX)
+    sim = _build(config, "", _ca_workload(n_pods=1, cpu=2000, duration=-1.0)
+        .replace("running_duration: -1.0", "running_duration: null"))
+
+    sim.step_until_time(200.0)
+    counters = sim.metrics_summary()["counters"]
+    assert counters["total_scaled_up_nodes"] == 1 * N_CLUSTERS
+    # 2000/16000 cpu = 12.5% < 50% threshold, but the pod has nowhere to go.
+    assert counters["total_scaled_down_nodes"] == 0
+    for c in range(N_CLUSTERS):
+        assert sim.ca_node_counts(c).sum() == 1
