@@ -522,5 +522,6 @@ def build_batched_from_traces(
         workload_events,
         config,
         ram_unit=kwargs.pop("ram_unit", DEFAULT_RAM_UNIT),
+        pod_group_slot_multiplier=kwargs.pop("pod_group_slot_multiplier", 2),
     )
     return BatchedSimulation(config, [compiled] * n_clusters, **kwargs)

@@ -207,9 +207,13 @@ def compile_cluster_trace(
                 umc.ram_config if umc else None
             )
             slot_start = len(pod_req_cpu)
-            slot_count = max(
-                group.initial_pod_count,
-                pod_group_slot_multiplier * group.max_pod_count,
+            # Reserve headroom ON TOP of the initial pods: HPA scale-up
+            # always allocates fresh slots (hpa_tail never rewinds), so a
+            # group whose initial count already meets the multiplier cap
+            # must still be able to churn through scale-down/scale-up
+            # cycles without exhausting its slot range.
+            slot_count = group.initial_pod_count + (
+                pod_group_slot_multiplier * group.max_pod_count
             )
             requests = template.spec.resources.requests
             for i in range(slot_count):

@@ -158,3 +158,61 @@ def test_batched_ca_scale_down_waits_for_movable_pods():
     assert counters["total_scaled_down_nodes"] == 0
     for c in range(N_CLUSTERS):
         assert sim.ca_node_counts(c).sum() == 1
+
+
+HIGH_INITIAL_WORKLOAD_TRACE = """
+events:
+- timestamp: 59.5
+  event_type:
+    !CreatePodGroup
+      pod_group:
+        name: pod_group_1
+        initial_pod_count: 6
+        max_pod_count: 3
+        pod_template:
+          metadata:
+            name: pod_group_1
+          spec:
+            resources:
+              requests:
+                cpu: 100
+                ram: 104857600
+              limits:
+                cpu: 100
+                ram: 104857600
+        target_resources_usage:
+          cpu_utilization: 0.6
+        resources_usage_model_config:
+          cpu_config:
+            model_name: pod_group
+            config: |
+              - duration: 300.0
+                total_load: 0.6
+              - duration: 300.0
+                total_load: 6
+"""
+
+
+def test_batched_hpa_scale_up_after_deep_scale_down():
+    """A group whose initial_pod_count exceeds the slot multiplier x
+    max_pod_count must still be able to scale back up after a scale-down
+    (regression: slot reserve used to be max(initial, mult*max), leaving zero
+    creation headroom and permanently pinning the group at its low point)."""
+    config = default_test_simulation_config()
+    config.horizontal_pod_autoscaler.enabled = True
+
+    sim = _build(config, CLUSTER_TRACE, HIGH_INITIAL_WORKLOAD_TRACE)
+    expected = [
+        (61.0, 6),   # initial expansion, first cycle sees no running pods yet
+        (121.0, 1),  # util 0.6/6 = 0.1, desired ceil(6*0.1/0.6) = 1
+        (181.0, 1),  # util 0.6/1 = 0.6, ratio 1.0: hold
+        (361.0, 2),  # load switched to 6 at t=359.5: util 1.0, ceil(1/0.6)=2
+        (421.0, 3),  # ceil(2/0.6) = 4, clamped to max_pod_count 3
+        (481.0, 3),  # hold at the clamp
+    ]
+    for until, replicas in expected:
+        sim.step_until_time(until)
+        for c in range(N_CLUSTERS):
+            assert sim.hpa_replicas(c) == {"pod_group_1": replicas}, (
+                f"at t={until}: {sim.hpa_replicas(c)}"
+            )
