@@ -41,8 +41,11 @@ import jax.numpy as jnp
 from kubernetriks_tpu.batched.state import (
     ClusterBatchState,
     PHASE_EMPTY,
+    PHASE_FAILED,
     PHASE_QUEUED,
+    PHASE_REMOVED,
     PHASE_RUNNING,
+    PHASE_SUCCEEDED,
     PHASE_UNSCHEDULABLE,
 )
 
@@ -196,20 +199,46 @@ def hpa_pass(
 
     act = active & present
     delta = jnp.where(act, desired - current, 0)
-    up = jnp.minimum(jnp.maximum(delta, 0), st.pg_slot_count - auto.hpa_tail)
+    # Slots are a ring over the group's reserve: head/tail are monotonic
+    # counters and the live window [head, tail) maps onto ring offsets
+    # modulo slot_count, so churn (scale-down then scale-up, repeated by the
+    # cyclic load curves) reuses freed slots instead of exhausting the
+    # reserve. A slot is only reusable once its previous occupant reached a
+    # terminal phase; `up` is clamped to the longest reusable prefix of the
+    # candidate window (counters accumulate incrementally, so resetting a
+    # terminal slot never corrupts metrics).
+    count_g = jnp.maximum(st.pg_slot_count, 1)
+    up0 = jnp.minimum(jnp.maximum(delta, 0), count_g - current)
     down = jnp.minimum(jnp.maximum(-delta, 0), current)
 
-    # --- scale up: activate offsets [tail, tail+up) of each group ----------
     slot_start_p = st.pg_slot_start[rows, gid_c]  # (C, P); garbage where gid<0
     off = jnp.arange(P)[None, :] - slot_start_p
     in_group = gid >= 0
-    tail_p = auto.hpa_tail[rows, gid_c]
+    count_p = count_g[rows, gid_c]
+    tail_ring = jnp.mod(auto.hpa_tail, count_g)[rows, gid_c]
+    head_ring = jnp.mod(auto.hpa_head, count_g)[rows, gid_c]
+    rel_tail = jnp.mod(off - tail_ring, count_p)  # candidate rank if < up
+    rel_head = jnp.mod(off - head_ring, count_p)
+
+    reusable = (
+        (pods.phase == PHASE_EMPTY)
+        | (pods.phase == PHASE_SUCCEEDED)
+        | (pods.phase == PHASE_REMOVED)
+        | (pods.phase == PHASE_FAILED)
+    )
+    up0_p = up0[rows, gid_c]
+    blocked = in_group & (rel_tail < up0_p) & ~reusable
+    big = jnp.int32(1 << 30)
+    min_blocked = (
+        jnp.full((C, Gp + 1), big, jnp.int32)
+        .at[rows, gid_c]
+        .min(jnp.where(blocked, rel_tail, big))[:, :Gp]
+    )
+    up = jnp.minimum(up0, min_blocked)
     up_p = up[rows, gid_c]
-    head_p = auto.hpa_head[rows, gid_c]
     down_p = down[rows, gid_c]
 
-    activate = in_group & (off >= tail_p) & (off < tail_p + up_p)
-    activate = activate & (pods.phase == PHASE_EMPTY)
+    activate = in_group & (rel_tail < up_p) & reusable
     rank = jnp.cumsum(activate, axis=1) - 1
     n_up = activate.sum(axis=1).astype(jnp.int32)
     enqueue_ts = (T[:, None] + st.d_hpa_up).astype(pods.queue_ts.dtype)
@@ -220,13 +249,18 @@ def hpa_pass(
     )
     initial_attempt_ts = jnp.where(activate, enqueue_ts, pods.initial_attempt_ts)
     attempts = jnp.where(activate, 1, pods.attempts)
+    # Reset state left over from a previous occupant of a reused slot.
+    node = jnp.where(activate, -1, pods.node)
+    start_time = jnp.where(activate, 0.0, pods.start_time)
+    finish_time = jnp.where(activate, jnp.inf, pods.finish_time)
 
-    # --- scale down: mark offsets [head, head+down) for removal ------------
-    deactivate = in_group & (off >= head_p) & (off < head_p + down_p)
+    # --- scale down: mark ring offsets [head, head+down) for removal -------
+    deactivate = in_group & (rel_head < down_p) & ~activate
+    removal_time = jnp.where(activate, jnp.inf, pods.removal_time)
     removal_time = jnp.where(
         deactivate,
-        jnp.minimum(pods.removal_time, T[:, None] + st.d_hpa_down),
-        pods.removal_time,
+        jnp.minimum(removal_time, T[:, None] + st.d_hpa_down),
+        removal_time,
     )
 
     metrics = metrics._replace(
@@ -246,6 +280,9 @@ def hpa_pass(
             initial_attempt_ts=initial_attempt_ts,
             attempts=attempts,
             removal_time=removal_time,
+            node=node,
+            start_time=start_time,
+            finish_time=finish_time,
         ),
         metrics=metrics,
         queue_seq_counter=state.queue_seq_counter + n_up,

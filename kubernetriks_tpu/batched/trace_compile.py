@@ -41,7 +41,7 @@ class CompiledPodGroup:
 
     name: str
     slot_start: int
-    slot_count: int  # reserved slots = multiplier x max_pod_count
+    slot_count: int  # reserved slots = initial + multiplier x max_pod_count (ring-reused)
     max_pods: int
     initial: int
     creation_time: float
@@ -100,6 +100,18 @@ class CompiledClusterTrace:
         return len(self.pod_req_cpu)
 
 
+def _event_time_shifts(config) -> Tuple[float, float, float]:
+    """Per-kind event-time shifts composing the scalar path's control-plane
+    hop chains (SURVEY.md §3.2/3.4): (create_node, remove_node, remove_pod)."""
+    if config is None:
+        return 0.0, 0.0, 0.0
+    return (
+        3.0 * config.as_to_ps_network_delay + config.ps_to_sched_network_delay,
+        2.0 * config.as_to_ps_network_delay + config.as_to_node_network_delay,
+        config.as_to_ps_network_delay,
+    )
+
+
 def compile_cluster_trace(
     cluster_events: TraceEvents,
     workload_events: TraceEvents,
@@ -121,28 +133,31 @@ def compile_cluster_trace(
     - CreatePod stays at t; its queue-entry time is shifted on-device by
       delta_pod_enqueue.
     """
-    if config is not None:
-        shift_create_node = (
-            3.0 * config.as_to_ps_network_delay + config.ps_to_sched_network_delay
-        )
-        shift_remove_node = (
-            2.0 * config.as_to_ps_network_delay + config.as_to_node_network_delay
-        )
-        shift_remove_pod = config.as_to_ps_network_delay
-    else:
-        shift_create_node = shift_remove_node = shift_remove_pod = 0.0
+    shift_create_node, shift_remove_node, shift_remove_pod = _event_time_shifts(config)
 
+    # A node's remove effect can never precede its create effect: when the
+    # per-kind shifts are asymmetric (shift_create > shift_remove) a same-tick
+    # create+remove pair would otherwise reorder after shifting. Clamp the
+    # remove to the create's effect time; the stable (time, order) sort then
+    # keeps create first (trace file order at equal times).
+    node_create_effect: Dict[str, float] = {}
     merged: List[Tuple[float, int, object]] = []
     for order, events in ((0, cluster_events), (1, workload_events)):
         for ts, event in events:
-            shift = 0.0
+            shifted = float(ts)
             if isinstance(event, CreateNodeRequest):
-                shift = shift_create_node
+                shifted += shift_create_node
+                # Latest create wins: re-creations of a name clamp their own
+                # subsequent remove (cluster events arrive in trace order).
+                node_create_effect[event.node.metadata.name] = shifted
             elif isinstance(event, RemoveNodeRequest):
-                shift = shift_remove_node
+                shifted = max(
+                    shifted + shift_remove_node,
+                    node_create_effect.get(event.node_name, -np.inf),
+                )
             elif isinstance(event, RemovePodRequest):
-                shift = shift_remove_pod
-            merged.append((float(ts) + shift, order, event))
+                shifted += shift_remove_pod
+            merged.append((shifted, order, event))
     merged.sort(key=lambda item: (item[0], item[1]))
 
     ev_time: List[float] = []
@@ -207,11 +222,11 @@ def compile_cluster_trace(
                 umc.ram_config if umc else None
             )
             slot_start = len(pod_req_cpu)
-            # Reserve headroom ON TOP of the initial pods: HPA scale-up
-            # always allocates fresh slots (hpa_tail never rewinds), so a
-            # group whose initial count already meets the multiplier cap
-            # must still be able to churn through scale-down/scale-up
-            # cycles without exhausting its slot range.
+            # The group's slots form a ring (autoscale.py hpa_pass): head/tail
+            # wrap modulo slot_count, so churn reuses freed slots. The reserve
+            # needs initial + multiplier*max so that (a) all initial pods fit
+            # alongside a full scale-up window and (b) a slot is never
+            # rewrapped while its previous occupant is still terminating.
             slot_count = group.initial_pod_count + (
                 pod_group_slot_multiplier * group.max_pod_count
             )
@@ -309,4 +324,95 @@ def pad_and_batch(
         pod_req_cpu,
         pod_req_ram,
         pod_duration,
+    )
+
+
+def compile_from_arrays(
+    cluster_arrays,
+    workload_arrays,
+    config=None,
+    ram_unit: int = DEFAULT_RAM_UNIT,
+) -> CompiledClusterTrace:
+    """Dense-array fast path: native-feeder output -> CompiledClusterTrace
+    without materializing per-event Python objects.
+
+    Semantically identical to compile_cluster_trace() over
+    {cluster,workload}_events_from_arrays(...) — the equality is asserted in
+    tests/test_native_feeder.py. Node events (small) run through a Python
+    loop; pod events (the multi-million-row axis on Alibaba traces) are
+    vectorized numpy end to end.
+
+    cluster_arrays: kubernetriks_tpu.trace.feeder.ClusterArrays or None.
+    workload_arrays: kubernetriks_tpu.trace.feeder.WorkloadArrays.
+    """
+    shift_create_node, shift_remove_node, _ = _event_time_shifts(config)
+
+    # --- node events (loop; N is small) ------------------------------------
+    node_cap_cpu: List[int] = []
+    node_cap_ram: List[int] = []
+    node_names: List[str] = []
+    live_node_slot: Dict[int, int] = {}
+    c_time: List[float] = []
+    c_kind: List[int] = []
+    c_slot: List[int] = []
+    node_create_effect: Dict[int, float] = {}
+    if cluster_arrays is not None:
+        for i in range(len(cluster_arrays.ts)):
+            mid = int(cluster_arrays.machine_id[i])
+            if int(cluster_arrays.kind[i]) == 0:
+                slot = len(node_cap_cpu)
+                node_cap_cpu.append(int(cluster_arrays.cpu_millicores[i]))
+                node_cap_ram.append(int(cluster_arrays.ram_bytes[i]) // ram_unit)
+                node_names.append(cluster_arrays.node_name(i))
+                live_node_slot[mid] = slot
+                shifted = float(cluster_arrays.ts[i]) + shift_create_node
+                node_create_effect[mid] = shifted
+                c_time.append(shifted)
+                c_kind.append(EV_CREATE_NODE)
+                c_slot.append(slot)
+            else:
+                # Clamp like compile_cluster_trace: a remove's effect never
+                # precedes its node's create effect under asymmetric shifts.
+                c_time.append(
+                    max(
+                        float(cluster_arrays.ts[i]) + shift_remove_node,
+                        node_create_effect.get(mid, -np.inf),
+                    )
+                )
+                c_kind.append(EV_REMOVE_NODE)
+                c_slot.append(live_node_slot.pop(mid))
+
+    # --- pod events (vectorized) -------------------------------------------
+    P = len(workload_arrays.start_ts)
+    w_time = workload_arrays.start_ts.astype(np.float64)
+    pod_req_cpu = workload_arrays.cpu_millicores.astype(np.int32)
+    pod_req_ram = (-(-workload_arrays.ram_bytes // ram_unit)).astype(np.int32)
+    pod_duration = workload_arrays.duration.astype(np.float32)
+    pod_names = [workload_arrays.pod_name(i) for i in range(P)]
+
+    # --- stable merge: primary time, cluster events before workload at ties
+    times = np.concatenate([np.asarray(c_time, np.float64), w_time])
+    kinds = np.concatenate(
+        [np.asarray(c_kind, np.int32), np.full(P, EV_CREATE_POD, np.int32)]
+    )
+    slots = np.concatenate(
+        [np.asarray(c_slot, np.int32), np.arange(P, dtype=np.int32)]
+    )
+    source = np.concatenate(
+        [np.zeros(len(c_time), np.int8), np.ones(P, np.int8)]
+    )
+    order = np.lexsort((source, times))  # stable within each source stream
+
+    return CompiledClusterTrace(
+        ev_time=times[order].astype(np.float32),
+        ev_kind=kinds[order],
+        ev_slot=slots[order],
+        node_cap_cpu=np.asarray(node_cap_cpu, np.int32).reshape(-1),
+        node_cap_ram=np.asarray(node_cap_ram, np.int32).reshape(-1),
+        pod_req_cpu=pod_req_cpu.reshape(-1),
+        pod_req_ram=pod_req_ram.reshape(-1),
+        pod_duration=pod_duration.reshape(-1),
+        node_names=node_names,
+        pod_names=pod_names,
+        pod_groups=[],
     )

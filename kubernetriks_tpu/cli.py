@@ -56,17 +56,30 @@ def build_traces(config: SimulationConfig):
             GenericClusterTrace.from_file(generic.cluster_trace_path),
             GenericWorkloadTrace.from_file(generic.workload_trace_path),
         )
-    from kubernetriks_tpu.trace.alibaba import (
-        AlibabaClusterTraceV2017,
-        AlibabaWorkloadTraceV2017,
-    )
+    from kubernetriks_tpu.trace import feeder
+
+    if feeder.native_available():
+        cluster_cls = feeder.NativeAlibabaClusterTrace
+        workload_cls = feeder.NativeAlibabaWorkloadTrace
+    else:
+        logging.getLogger(__name__).info(
+            "native trace feeder unavailable (%s); using the Python parser",
+            feeder.native_build_error(),
+        )
+        from kubernetriks_tpu.trace.alibaba import (
+            AlibabaClusterTraceV2017,
+            AlibabaWorkloadTraceV2017,
+        )
+
+        cluster_cls = AlibabaClusterTraceV2017
+        workload_cls = AlibabaWorkloadTraceV2017
 
     cluster = (
-        AlibabaClusterTraceV2017.from_file(alibaba.machine_events_trace_path)
+        cluster_cls.from_file(alibaba.machine_events_trace_path)
         if alibaba.machine_events_trace_path
         else EmptyTrace()
     )
-    workload = AlibabaWorkloadTraceV2017.from_files(
+    workload = workload_cls.from_files(
         alibaba.batch_instance_trace_path, alibaba.batch_task_trace_path
     )
     return cluster, workload

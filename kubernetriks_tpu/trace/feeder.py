@@ -1,0 +1,329 @@
+"""ctypes binding for the native C++ trace feeder (native/trace_feeder.cc).
+
+The feeder is the framework's native host-side data loader: it parses the
+Alibaba v2017 CSVs (batch_instance joined to batch_task; machine_events),
+applies the reference's validity filters (reference:
+src/trace/alibaba_cluster_trace_v2017/workload.rs:56-120, cluster.rs:55-105)
+and returns dense, time-sorted numpy arrays ready to be compiled into device
+tensors. The pure-Python pipeline in kubernetriks_tpu.trace.alibaba has
+identical semantics and serves as both fallback and oracle.
+
+The shared library is built on demand with g++ (cached next to the source,
+keyed on source mtime); if no toolchain is available the callers fall back to
+the Python path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SOURCE = os.path.join(_REPO_ROOT, "native", "trace_feeder.cc")
+_LIB = os.path.join(_REPO_ROOT, "native", "build", "libtrace_feeder.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_error: Optional[str] = None
+
+
+def _build_library() -> Optional[str]:
+    """Compile the feeder if missing or stale. Returns an error string or None."""
+    try:
+        os.makedirs(os.path.dirname(_LIB), exist_ok=True)
+        if not os.path.exists(_SOURCE):
+            return f"feeder source not found: {_SOURCE}"
+        if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(_SOURCE):
+            return None
+    except OSError as exc:
+        return f"cannot stage native build dir: {exc}"
+    # Build to a per-process temp path, then rename into place: concurrent
+    # builders (pytest workers, parallel CLI runs) must never dlopen a
+    # half-written .so.
+    tmp = f"{_LIB}.tmp.{os.getpid()}"
+    cmd = [
+        "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+        _SOURCE, "-o", tmp,
+    ]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+        if proc.returncode != 0:
+            return f"g++ failed: {proc.stderr[-2000:]}"
+        os.replace(tmp, _LIB)
+    except (OSError, subprocess.TimeoutExpired) as exc:
+        return f"g++ invocation failed: {exc}"
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+    return None
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _build_error
+    with _lock:
+        if _lib is not None or _build_error is not None:
+            return _lib
+        err = _build_library()
+        if err is not None:
+            _build_error = err
+            return None
+        lib = ctypes.CDLL(_LIB)
+        lib.feeder_parse_workload.restype = ctypes.c_void_p
+        lib.feeder_parse_workload.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+        lib.feeder_parse_machines.restype = ctypes.c_void_p
+        lib.feeder_parse_machines.argtypes = [ctypes.c_char_p]
+        lib.feeder_error.restype = ctypes.c_char_p
+        lib.feeder_error.argtypes = [ctypes.c_void_p]
+        lib.feeder_workload_count.restype = ctypes.c_int64
+        lib.feeder_workload_count.argtypes = [ctypes.c_void_p]
+        lib.feeder_machine_count.restype = ctypes.c_int64
+        lib.feeder_machine_count.argtypes = [ctypes.c_void_p]
+        f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+        i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+        i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+        lib.feeder_workload_fill.restype = None
+        lib.feeder_workload_fill.argtypes = [
+            ctypes.c_void_p, f64p, i64p, i64p, f64p, i64p, i64p, i64p,
+        ]
+        lib.feeder_machine_fill.restype = None
+        lib.feeder_machine_fill.argtypes = [ctypes.c_void_p, f64p, i32p, i64p, i64p, i64p]
+        lib.feeder_free.restype = None
+        lib.feeder_free.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def native_build_error() -> Optional[str]:
+    _load()
+    return _build_error
+
+
+@dataclass
+class WorkloadArrays:
+    """Dense pod-creation events, stably sorted by start timestamp."""
+
+    start_ts: np.ndarray       # (P,) float64 seconds
+    cpu_millicores: np.ndarray  # (P,) int64
+    ram_bytes: np.ndarray       # (P,) int64
+    duration: np.ndarray        # (P,) float64 seconds
+    job_id: np.ndarray          # (P,) int64; -1 encodes a missing job id
+    task_id: np.ndarray         # (P,) int64
+    pod_no: np.ndarray          # (P,) int64 per-trace running pod counter
+
+    def pod_name(self, i: int) -> str:
+        # Mirrors the Python path's f"{job_id}_{task_id}_{n}" naming, where a
+        # missing job id renders as the literal "None".
+        jid = "None" if self.job_id[i] == -1 else str(int(self.job_id[i]))
+        return f"{jid}_{int(self.task_id[i])}_{int(self.pod_no[i])}"
+
+
+@dataclass
+class ClusterArrays:
+    """Dense node lifecycle events (kind 0 = create, 1 = remove), sorted."""
+
+    ts: np.ndarray             # (M,) float64 seconds
+    kind: np.ndarray           # (M,) int32
+    cpu_millicores: np.ndarray  # (M,) int64 (creates only)
+    ram_bytes: np.ndarray       # (M,) int64 (creates only)
+    machine_id: np.ndarray      # (M,) int64
+
+    def node_name(self, i: int) -> str:
+        return f"alibaba_node_{int(self.machine_id[i])}"
+
+
+def _take_handle(lib: ctypes.CDLL, handle: int) -> int:
+    if not handle:
+        raise RuntimeError("native feeder returned a null handle")
+    err = lib.feeder_error(ctypes.c_void_p(handle)).decode()
+    if err:
+        lib.feeder_free(ctypes.c_void_p(handle))
+        raise ValueError(err)
+    return handle
+
+
+def load_workload_arrays(
+    batch_instance_path: str, batch_task_path: str
+) -> WorkloadArrays:
+    """Parse + join + filter the workload CSVs natively."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native feeder unavailable: {_build_error}")
+    handle = _take_handle(
+        lib,
+        lib.feeder_parse_workload(
+            batch_instance_path.encode(), batch_task_path.encode()
+        ),
+    )
+    try:
+        n = lib.feeder_workload_count(ctypes.c_void_p(handle))
+        out = WorkloadArrays(
+            start_ts=np.empty(n, np.float64),
+            cpu_millicores=np.empty(n, np.int64),
+            ram_bytes=np.empty(n, np.int64),
+            duration=np.empty(n, np.float64),
+            job_id=np.empty(n, np.int64),
+            task_id=np.empty(n, np.int64),
+            pod_no=np.empty(n, np.int64),
+        )
+        if n:
+            lib.feeder_workload_fill(
+                ctypes.c_void_p(handle),
+                out.start_ts, out.cpu_millicores, out.ram_bytes,
+                out.duration, out.job_id, out.task_id, out.pod_no,
+            )
+        return out
+    finally:
+        lib.feeder_free(ctypes.c_void_p(handle))
+
+
+def load_cluster_arrays(machine_events_path: str) -> ClusterArrays:
+    """Parse + dedup the machine-events CSV natively."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native feeder unavailable: {_build_error}")
+    handle = _take_handle(
+        lib, lib.feeder_parse_machines(machine_events_path.encode())
+    )
+    try:
+        n = lib.feeder_machine_count(ctypes.c_void_p(handle))
+        out = ClusterArrays(
+            ts=np.empty(n, np.float64),
+            kind=np.empty(n, np.int32),
+            cpu_millicores=np.empty(n, np.int64),
+            ram_bytes=np.empty(n, np.int64),
+            machine_id=np.empty(n, np.int64),
+        )
+        if n:
+            lib.feeder_machine_fill(
+                ctypes.c_void_p(handle),
+                out.ts, out.kind, out.cpu_millicores, out.ram_bytes,
+                out.machine_id,
+            )
+        return out
+    finally:
+        lib.feeder_free(ctypes.c_void_p(handle))
+
+
+def workload_events_from_arrays(arrays: WorkloadArrays) -> List[Tuple[float, object]]:
+    """Materialize the dense arrays back into CreatePodRequest trace events
+    (object form used by the scalar path and the batched trace compiler)."""
+    from kubernetriks_tpu.core.events import CreatePodRequest
+    from kubernetriks_tpu.core.types import Pod
+
+    events = []
+    for i in range(len(arrays.start_ts)):
+        pod = Pod.new(
+            arrays.pod_name(i),
+            int(arrays.cpu_millicores[i]),
+            int(arrays.ram_bytes[i]),
+            float(arrays.duration[i]),
+        )
+        events.append((float(arrays.start_ts[i]), CreatePodRequest(pod=pod)))
+    return events
+
+
+def cluster_events_from_arrays(arrays: ClusterArrays) -> List[Tuple[float, object]]:
+    from kubernetriks_tpu.core.events import CreateNodeRequest, RemoveNodeRequest
+    from kubernetriks_tpu.core.types import Node
+
+    events = []
+    for i in range(len(arrays.ts)):
+        name = arrays.node_name(i)
+        if int(arrays.kind[i]) == 0:
+            events.append(
+                (
+                    float(arrays.ts[i]),
+                    CreateNodeRequest(
+                        node=Node.new(
+                            name,
+                            int(arrays.cpu_millicores[i]),
+                            int(arrays.ram_bytes[i]),
+                        )
+                    ),
+                )
+            )
+        else:
+            events.append((float(arrays.ts[i]), RemoveNodeRequest(node_name=name)))
+    return events
+
+
+def iter_time_slabs(
+    arrays: WorkloadArrays, slab_seconds: float
+) -> List[Tuple[float, float, slice]]:
+    """Index the sorted workload into [t0, t0+slab) windows for streaming:
+    host->device transfer happens one slab at a time so multi-million-row
+    traces never need to sit in HBM whole (SURVEY §5.8 'host/device
+    streaming'). Returns (slab_start, slab_end, index_slice) triples."""
+    if len(arrays.start_ts) == 0:
+        return []
+    t0 = float(arrays.start_ts[0])
+    t_end = float(arrays.start_ts[-1])
+    slabs = []
+    lo = 0
+    slab_start = t0
+    while slab_start <= t_end:
+        slab_end = slab_start + slab_seconds
+        hi = int(np.searchsorted(arrays.start_ts, slab_end, side="left"))
+        if hi > lo:
+            slabs.append((slab_start, slab_end, slice(lo, hi)))
+        lo = hi
+        slab_start = slab_end
+    return slabs
+
+
+class NativeAlibabaWorkloadTrace:
+    """Trace-interface adapter over the native workload arrays: drop-in for
+    AlibabaWorkloadTraceV2017 when the C++ feeder is available."""
+
+    def __init__(self, arrays: WorkloadArrays) -> None:
+        self.arrays: Optional[WorkloadArrays] = arrays
+
+    @staticmethod
+    def from_files(
+        batch_instance_trace_path: str, batch_task_trace_path: str
+    ) -> "NativeAlibabaWorkloadTrace":
+        return NativeAlibabaWorkloadTrace(
+            load_workload_arrays(batch_instance_trace_path, batch_task_trace_path)
+        )
+
+    def convert_to_simulator_events(self):
+        arrays, self.arrays = self.arrays, None
+        if arrays is None:
+            return []
+        return workload_events_from_arrays(arrays)
+
+    def event_count(self) -> int:
+        return 0 if self.arrays is None else len(self.arrays.start_ts)
+
+
+class NativeAlibabaClusterTrace:
+    """Trace-interface adapter over the native machine-event arrays."""
+
+    def __init__(self, arrays: ClusterArrays) -> None:
+        self.arrays: Optional[ClusterArrays] = arrays
+
+    @staticmethod
+    def from_file(machine_events_trace_path: str) -> "NativeAlibabaClusterTrace":
+        return NativeAlibabaClusterTrace(load_cluster_arrays(machine_events_trace_path))
+
+    def convert_to_simulator_events(self):
+        arrays, self.arrays = self.arrays, None
+        if arrays is None:
+            return []
+        return cluster_events_from_arrays(arrays)
+
+    def event_count(self) -> int:
+        return 0 if self.arrays is None else len(self.arrays.ts)

@@ -216,3 +216,40 @@ def test_batched_hpa_scale_up_after_deep_scale_down():
             assert sim.hpa_replicas(c) == {"pod_group_1": replicas}, (
                 f"at t={until}: {sim.hpa_replicas(c)}"
             )
+
+
+def test_batched_hpa_ring_survives_many_load_cycles():
+    """Slots are ring-reused: an HPA group cycling down/up for many load
+    periods never exhausts its reserve (regression: tail used to be a
+    monotonic allocator, silently pinning the group once cumulative
+    scale-ups passed the reserve)."""
+    config = default_test_simulation_config()
+    config.horizontal_pod_autoscaler.enabled = True
+
+    # 200 s load period: 2 pods' worth of load for 100 s, then 12 pods' worth.
+    workload = HIGH_INITIAL_WORKLOAD_TRACE.replace(
+        "initial_pod_count: 6", "initial_pod_count: 2"
+    ).replace(
+        "max_pod_count: 3", "max_pod_count: 6"
+    ).replace(
+        "- duration: 300.0\n                total_load: 0.6",
+        "- duration: 100.0\n                total_load: 1.2",
+    ).replace(
+        "- duration: 300.0\n                total_load: 6",
+        "- duration: 100.0\n                total_load: 12",
+    )
+    sim = _build(config, CLUSTER_TRACE, workload)
+
+    # Reserve = 2 + 2*6 = 14 slots; each period churns ~4 creations, so by
+    # t=3000 (~15 periods) a monotonic allocator would long be exhausted.
+    samples = []
+    for cycle_end in range(61, 3001, 60):
+        sim.step_until_time(float(cycle_end))
+        samples.append(sim.hpa_replicas(0)["pod_group_1"])
+    late = samples[len(samples) // 2 :]
+    # Steady-state oscillation 2 -> 4 -> 6 -> 2 keeps hitting both the clamp
+    # and the trough long after the reserve would have been exhausted.
+    assert max(late) == 6, samples
+    assert min(late) == 2, samples
+    counters = sim.metrics_summary()["counters"]
+    assert counters["total_scaled_up_pods"] > 14 * N_CLUSTERS  # > reserve

@@ -1,0 +1,289 @@
+"""Native C++ trace feeder == pure-Python oracle, row for row.
+
+The feeder (native/trace_feeder.cc via kubernetriks_tpu.trace.feeder) must
+reproduce the Python pipeline's join/filter/convert semantics exactly
+(reference: src/trace/alibaba_cluster_trace_v2017/{workload,cluster}.rs), so
+every test here runs both implementations on the same CSVs and diffs events.
+"""
+
+import numpy as np
+import pytest
+
+from kubernetriks_tpu.core.events import CreateNodeRequest, CreatePodRequest, RemoveNodeRequest
+from kubernetriks_tpu.trace import feeder
+from kubernetriks_tpu.trace.alibaba import (
+    AlibabaClusterTraceV2017,
+    AlibabaWorkloadTraceV2017,
+    read_batch_instances,
+    read_batch_tasks,
+    read_machine_events,
+)
+
+pytestmark = pytest.mark.skipif(
+    not feeder.native_available(),
+    reason=f"native feeder unavailable: {feeder.native_build_error()}",
+)
+
+
+WORKLOAD_TASKS = (
+    # create, end, job, task, n_inst, status, cpus(santicores), norm_mem
+    "100,200,1,10,2,Terminated,50,0.015625\n"     # 500 mcpu, 2 GiB
+    "100,300,1,11,1,Terminated,100,0.25\n"        # 1000 mcpu, 32 GiB
+    "100,300,1,12,1,Terminated,,\n"               # missing resources -> filtered
+    "100,300,1,13,1,Terminated,64,0.5\n"
+)
+WORKLOAD_INSTANCES = (
+    "41562,41618,1,10,299,Terminated,1,2\n"   # valid
+    "41563,41619,1,10,300,Terminated,2,2\n"   # valid (same task, 2nd instance)
+    ",41618,1,10,299,Interrupted,1,2\n"       # no start -> filtered
+    "41562,,1,10,299,Interrupted,1,2\n"       # no end -> filtered
+    "41562,41618,1,,299,Failed,1,2\n"         # no task id -> filtered
+    "41562,41618,1,99,299,Terminated,1,2\n"   # unknown task -> filtered
+    "41562,41618,1,12,299,Terminated,1,2\n"   # task lacks resources -> filtered
+    "0,41618,1,11,299,Terminated,1,2\n"       # start <= 0 -> filtered
+    "41618,41618,1,11,299,Terminated,1,2\n"   # start >= end -> filtered
+    "41000,41001,1,11,299,Terminated,1,2\n"   # valid
+    "41000,41100,,13,1,Terminated,1,1\n"      # valid, missing job id
+)
+MACHINE_EVENTS = (
+    "10,1,add,,64,0.69\n"
+    "10,2,add,,32,0.5\n"
+    "50,1,softerror,links_broken,,\n"
+    "60,1,harderror,,,\n"        # re-removal -> deduped
+    "70,3,softerror,,,\n"        # ghost node -> deduped
+    "80,2,harderror,,,\n"
+    "90,4,add,,8,0.125\n"
+)
+
+
+def _python_workload_events(instances_text, tasks_text):
+    trace = AlibabaWorkloadTraceV2017(
+        read_batch_instances(instances_text), read_batch_tasks(tasks_text)
+    )
+    return trace.convert_to_simulator_events()
+
+
+def _python_cluster_events(machines_text):
+    return AlibabaClusterTraceV2017(
+        read_machine_events(machines_text)
+    ).convert_to_simulator_events()
+
+
+def _write(tmp_path, name, text):
+    path = tmp_path / name
+    path.write_text(text)
+    return str(path)
+
+
+def test_workload_native_matches_python(tmp_path):
+    inst = _write(tmp_path, "batch_instance.csv", WORKLOAD_INSTANCES)
+    task = _write(tmp_path, "batch_task.csv", WORKLOAD_TASKS)
+
+    arrays = feeder.load_workload_arrays(inst, task)
+    native = feeder.workload_events_from_arrays(arrays)
+    python = _python_workload_events(WORKLOAD_INSTANCES, WORKLOAD_TASKS)
+
+    assert len(native) == len(python) == 4
+    for (nts, nev), (pts, pev) in zip(native, python):
+        assert nts == pts
+        assert isinstance(nev, CreatePodRequest)
+        assert nev.pod.metadata.name == pev.pod.metadata.name
+        assert nev.pod.spec.resources.requests.cpu == pev.pod.spec.resources.requests.cpu
+        assert nev.pod.spec.resources.requests.ram == pev.pod.spec.resources.requests.ram
+        assert nev.pod.spec.running_duration == pev.pod.spec.running_duration
+    # The missing-job-id row renders like the Python f-string.
+    assert any(ev.pod.metadata.name.startswith("None_13_") for _, ev in native)
+
+
+def test_cluster_native_matches_python(tmp_path):
+    path = _write(tmp_path, "machine_events.csv", MACHINE_EVENTS)
+
+    arrays = feeder.load_cluster_arrays(path)
+    native = feeder.cluster_events_from_arrays(arrays)
+    python = _python_cluster_events(MACHINE_EVENTS)
+
+    assert len(native) == len(python) == 5
+    for (nts, nev), (pts, pev) in zip(native, python):
+        assert nts == pts
+        assert type(nev) is type(pev)
+        if isinstance(nev, CreateNodeRequest):
+            assert nev.node.metadata.name == pev.node.metadata.name
+            assert nev.node.status.capacity.cpu == pev.node.status.capacity.cpu
+            assert nev.node.status.capacity.ram == pev.node.status.capacity.ram
+        else:
+            assert isinstance(nev, RemoveNodeRequest)
+            assert nev.node_name == pev.node_name
+
+
+def test_duplicate_task_id_raises(tmp_path):
+    inst = _write(tmp_path, "i.csv", WORKLOAD_INSTANCES)
+    task = _write(tmp_path, "t.csv", "1,2,3,64,1,T,50,0.5\n1,2,3,64,1,T,50,0.5\n")
+    with pytest.raises(ValueError, match="duplicated task id: 64"):
+        feeder.load_workload_arrays(inst, task)
+
+
+def test_add_without_resources_raises(tmp_path):
+    path = _write(tmp_path, "m.csv", "10,1,add,,,\n")
+    with pytest.raises(ValueError, match="lacks cpu/memory"):
+        feeder.load_cluster_arrays(path)
+
+
+def test_unknown_machine_event_raises(tmp_path):
+    path = _write(tmp_path, "m.csv", "10,1,add,,64,0.5\n20,1,frobnicate,,,\n")
+    with pytest.raises(ValueError, match="Unsupported operation"):
+        feeder.load_cluster_arrays(path)
+
+
+def test_native_matches_python_on_random_trace(tmp_path):
+    """Fuzz: a few thousand random rows with every failure mode mixed in."""
+    rng = np.random.default_rng(7)
+    n_tasks, n_inst = 200, 4000
+    task_lines = []
+    for tid in range(n_tasks):
+        if rng.random() < 0.1:
+            cpu, mem = "", ""
+        else:
+            cpu, mem = str(rng.integers(10, 640)), f"{rng.random():.6f}"
+        task_lines.append(f"1,2,{rng.integers(1, 50)},{tid},1,Terminated,{cpu},{mem}")
+    inst_lines = []
+    for _ in range(n_inst):
+        start = rng.integers(-10, 5000)
+        end = start + rng.integers(-5, 500)
+        tid = rng.integers(0, int(n_tasks * 1.1))  # some unknown tasks
+        s = "" if rng.random() < 0.05 else str(start)
+        e = "" if rng.random() < 0.05 else str(end)
+        t = "" if rng.random() < 0.05 else str(tid)
+        j = "" if rng.random() < 0.05 else str(rng.integers(1, 50))
+        inst_lines.append(f"{s},{e},{j},{t},1,Terminated,1,1")
+    inst_text = "\n".join(inst_lines) + "\n"
+    task_text = "\n".join(task_lines) + "\n"
+
+    inst = _write(tmp_path, "bi.csv", inst_text)
+    task = _write(tmp_path, "bt.csv", task_text)
+
+    arrays = feeder.load_workload_arrays(inst, task)
+    native = feeder.workload_events_from_arrays(arrays)
+    python = _python_workload_events(inst_text, task_text)
+
+    assert len(native) == len(python)
+    for (nts, nev), (pts, pev) in zip(native, python):
+        assert nts == pts
+        assert nev.pod.metadata.name == pev.pod.metadata.name
+        assert nev.pod.spec.resources.requests.cpu == pev.pod.spec.resources.requests.cpu
+        assert nev.pod.spec.resources.requests.ram == pev.pod.spec.resources.requests.ram
+        assert nev.pod.spec.running_duration == pev.pod.spec.running_duration
+
+
+def test_time_slab_iteration(tmp_path):
+    inst = _write(tmp_path, "bi.csv", WORKLOAD_INSTANCES)
+    task = _write(tmp_path, "bt.csv", WORKLOAD_TASKS)
+    arrays = feeder.load_workload_arrays(inst, task)
+
+    slabs = feeder.iter_time_slabs(arrays, slab_seconds=100.0)
+    # Slabs cover every event exactly once, in order.
+    covered = []
+    for t0, t1, idx in slabs:
+        chunk = arrays.start_ts[idx]
+        assert ((chunk >= t0) & (chunk < t1)).all()
+        covered.extend(chunk.tolist())
+    assert covered == arrays.start_ts.tolist()
+
+
+def test_compile_from_arrays_matches_event_compile(tmp_path):
+    """Dense-array fast path == compile_cluster_trace over the event objects."""
+    from kubernetriks_tpu.batched.trace_compile import (
+        compile_cluster_trace,
+        compile_from_arrays,
+    )
+    from kubernetriks_tpu.test_util import default_test_simulation_config
+
+    inst = _write(tmp_path, "bi.csv", WORKLOAD_INSTANCES)
+    task = _write(tmp_path, "bt.csv", WORKLOAD_TASKS)
+    machines = _write(tmp_path, "me.csv", MACHINE_EVENTS)
+
+    w_arrays = feeder.load_workload_arrays(inst, task)
+    c_arrays = feeder.load_cluster_arrays(machines)
+    config = default_test_simulation_config()
+
+    fast = compile_from_arrays(c_arrays, w_arrays, config)
+    slow = compile_cluster_trace(
+        feeder.cluster_events_from_arrays(c_arrays),
+        feeder.workload_events_from_arrays(w_arrays),
+        config,
+    )
+
+    np.testing.assert_array_equal(fast.ev_time, slow.ev_time)
+    np.testing.assert_array_equal(fast.ev_kind, slow.ev_kind)
+    np.testing.assert_array_equal(fast.ev_slot, slow.ev_slot)
+    np.testing.assert_array_equal(fast.node_cap_cpu, slow.node_cap_cpu)
+    np.testing.assert_array_equal(fast.node_cap_ram, slow.node_cap_ram)
+    np.testing.assert_array_equal(fast.pod_req_cpu, slow.pod_req_cpu)
+    np.testing.assert_array_equal(fast.pod_req_ram, slow.pod_req_ram)
+    np.testing.assert_array_equal(fast.pod_duration, slow.pod_duration)
+    assert fast.node_names == slow.node_names
+    assert fast.pod_names == slow.pod_names
+
+
+def test_batched_sim_runs_from_native_arrays(tmp_path):
+    """End to end: native feeder -> compile_from_arrays -> BatchedSimulation."""
+    from kubernetriks_tpu.batched.engine import BatchedSimulation
+    from kubernetriks_tpu.batched.trace_compile import compile_from_arrays
+    from kubernetriks_tpu.test_util import default_test_simulation_config
+
+    # One 64-core node, two pods that fit.
+    machines = _write(tmp_path, "me.csv", "1,1,add,,64,0.5\n")
+    task = _write(tmp_path, "bt.csv", "100,200,1,10,2,Terminated,50,0.015625\n")
+    inst = _write(
+        tmp_path, "bi.csv",
+        "100,150,1,10,1,Terminated,1,2\n200,260,1,10,2,Terminated,2,2\n",
+    )
+    config = default_test_simulation_config()
+    compiled = compile_from_arrays(
+        feeder.load_cluster_arrays(machines),
+        feeder.load_workload_arrays(inst, task),
+        config,
+    )
+    sim = BatchedSimulation(config, [compiled] * 2)
+    sim.run_to_completion()
+    counters = sim.metrics_summary()["counters"]
+    assert counters["pods_succeeded"] == 2 * 2
+    assert counters["processed_nodes"] == 1 * 2
+
+
+def test_same_tick_create_remove_with_asymmetric_shifts(tmp_path):
+    """A same-timestamp add+softerror pair must keep create-before-remove
+    ordering even when shift_create_node > shift_remove_node (regression:
+    the remove used to sort first, crashing one compiler and silently
+    diverging in the other)."""
+    from kubernetriks_tpu.batched.state import EV_CREATE_NODE, EV_REMOVE_NODE
+    from kubernetriks_tpu.batched.trace_compile import (
+        compile_cluster_trace,
+        compile_from_arrays,
+    )
+    from kubernetriks_tpu.test_util import default_test_simulation_config
+
+    machines = _write(
+        tmp_path, "me.csv", "100,1,add,,64,0.5\n100,1,softerror,,,\n"
+    )
+    inst = _write(tmp_path, "bi.csv", "100,150,1,10,1,Terminated,1,1\n")
+    task = _write(tmp_path, "bt.csv", "1,2,1,10,1,Terminated,50,0.015625\n")
+
+    config = default_test_simulation_config()
+    # Make the create shift strictly larger than the remove shift.
+    config.ps_to_sched_network_delay = 1.0
+    config.as_to_node_network_delay = 0.0
+
+    c_arrays = feeder.load_cluster_arrays(machines)
+    w_arrays = feeder.load_workload_arrays(inst, task)
+    fast = compile_from_arrays(c_arrays, w_arrays, config)
+    slow = compile_cluster_trace(
+        feeder.cluster_events_from_arrays(c_arrays),
+        feeder.workload_events_from_arrays(w_arrays),
+        config,
+    )
+    for compiled in (fast, slow):
+        kinds = list(compiled.ev_kind)
+        assert kinds.index(EV_CREATE_NODE) < kinds.index(EV_REMOVE_NODE)
+    np.testing.assert_array_equal(fast.ev_time, slow.ev_time)
+    np.testing.assert_array_equal(fast.ev_kind, slow.ev_kind)
+    np.testing.assert_array_equal(fast.ev_slot, slow.ev_slot)
