@@ -435,6 +435,8 @@ def _run_scheduling_cycle(
     T: jnp.ndarray,
     consts: StepConstants,
     max_pods_per_cycle: int,
+    use_pallas: bool = False,
+    pallas_interpret: bool = False,
 ) -> ClusterBatchState:
     """One vectorized kube-scheduler cycle at time T for every cluster
     (scalar equivalent: reference scheduler.rs:246-333)."""
@@ -449,6 +451,50 @@ def _run_scheduling_cycle(
     alive = state.nodes.alive
     alive_count = alive.sum(axis=1).astype(jnp.float32)
     time_dtype = cc.pods.queue_ts.dtype
+
+    if use_pallas:
+        # The (C, N)-heavy core runs as a fused VMEM kernel; the (C,)-shaped
+        # timing/metric mechanics below replicate the scan path's float-op
+        # ordering exactly (see ops/scheduler_kernel.py).
+        from kubernetriks_tpu.ops.scheduler_kernel import fused_schedule_cycle
+
+        assign_k, fitany_k, best_k, alloc_cpu, alloc_ram = fused_schedule_cycle(
+            alive,
+            state.nodes.alloc_cpu,
+            state.nodes.alloc_ram,
+            cand_valid,
+            cand_req_cpu,
+            cand_req_ram,
+            interpret=pallas_interpret,
+        )
+        park_k = cand_valid & ~fitany_k
+        pod_sched_time = consts.time_per_node * alive_count  # (C,)
+
+        def mech_body(carry, xs):
+            cycle_dur, metrics = carry
+            valid, assign, initial_ts, duration = xs
+            pod_queue_time = T - initial_ts + cycle_dur
+            cycle_dur_post = cycle_dur + jnp.where(valid, pod_sched_time, 0.0)
+            start = (T + cycle_dur_post + consts.delta_bind_start).astype(time_dtype)
+            finish = jnp.where(duration >= 0, start + duration, INF).astype(time_dtype)
+            park_ts = (T + cycle_dur_post).astype(time_dtype)
+            metrics = metrics._replace(
+                scheduling_decisions=metrics.scheduling_decisions
+                + assign.astype(jnp.int32),
+                queue_time=metrics.queue_time.add(pod_queue_time, assign),
+                algo_latency=metrics.algo_latency.add(pod_sched_time, assign),
+            )
+            return (cycle_dur_post, metrics), (start, finish, park_ts)
+
+        (_, metrics), (start_k, finish_k, park_ts_k) = jax.lax.scan(
+            mech_body,
+            (jnp.zeros((C,), time_dtype), state.metrics),
+            (cand_valid.T, assign_k.T, cand_initial_ts.T, cand_duration.T),
+        )
+        return commit_cycle(
+            state, cc, T, alloc_cpu, alloc_ram, metrics,
+            assign_k, park_k, best_k, start_k.T, finish_k.T, park_ts_k.T,
+        )
 
     def body(carry, xs):
         alloc_cpu, alloc_ram, cycle_dur, metrics = carry
@@ -516,12 +562,16 @@ def _window_body(
     autoscale_statics=None,
     max_ca_pods_per_cycle: int = 64,
     max_pods_per_scale_down: int = 8,
+    use_pallas: bool = False,
+    pallas_interpret: bool = False,
 ) -> ClusterBatchState:
     window_end = jnp.broadcast_to(window_end, state.time.shape)
     state = _apply_window_events(
         state, slab, window_end, consts, max_events_per_window
     )
-    state = _run_scheduling_cycle(state, window_end, consts, max_pods_per_cycle)
+    state = _run_scheduling_cycle(
+        state, window_end, consts, max_pods_per_cycle, use_pallas, pallas_interpret
+    )
     if autoscale_statics is not None:
         # Autoscaler ticks due by this window run after the scheduling cycle
         # (the scalar snapshot lands between cycles; SURVEY.md §3.5); their
@@ -547,6 +597,8 @@ _STEP_STATICS = (
     "max_pods_per_cycle",
     "max_ca_pods_per_cycle",
     "max_pods_per_scale_down",
+    "use_pallas",
+    "pallas_interpret",
 )
 
 
@@ -561,6 +613,8 @@ def window_step(
     autoscale_statics=None,
     max_ca_pods_per_cycle: int = 64,
     max_pods_per_scale_down: int = 8,
+    use_pallas: bool = False,
+    pallas_interpret: bool = False,
 ) -> ClusterBatchState:
     """Advance every cluster to `window_end` (the next scheduling-cycle time)."""
     return _window_body(
@@ -573,6 +627,8 @@ def window_step(
         autoscale_statics,
         max_ca_pods_per_cycle,
         max_pods_per_scale_down,
+        use_pallas,
+        pallas_interpret,
     )
 
 
@@ -587,6 +643,8 @@ def run_windows(
     autoscale_statics=None,
     max_ca_pods_per_cycle: int = 64,
     max_pods_per_scale_down: int = 8,
+    use_pallas: bool = False,
+    pallas_interpret: bool = False,
 ) -> ClusterBatchState:
     """Scan a whole sequence of scheduling-cycle windows on-device (the hot
     benchmark loop: no host round-trips between cycles)."""
@@ -603,6 +661,8 @@ def run_windows(
                 autoscale_statics,
                 max_ca_pods_per_cycle,
                 max_pods_per_scale_down,
+                use_pallas,
+                pallas_interpret,
             ),
             None,
         )

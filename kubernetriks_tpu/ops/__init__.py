@@ -1,0 +1,1 @@
+"""TPU kernels (pallas) for the framework's hot ops."""

@@ -1,0 +1,173 @@
+"""Pallas TPU kernel: fused kube-scheduler cycle over a cluster batch.
+
+The batched scheduling cycle (batched/step.py _run_scheduling_cycle, scalar
+equivalent reference: src/core/scheduler/scheduler.rs:246-333) is a K-step
+sequential loop — pod k's Fit filter + LeastAllocatedResources score +
+last-wins argmax (reference: src/core/scheduler/plugin.rs:33-63,
+kube_scheduler.rs:140-150) must see the allocatable updates of pods 0..k-1.
+As a lax.scan, each of the K iterations round-trips the (C, N) allocatable
+arrays through HBM. This kernel runs the whole loop with the node tile pinned
+in VMEM: one HBM read and one write of node state per cycle instead of K.
+
+The kernel computes only the state-dependent core (fit/score/argmax +
+allocatable updates) and returns per-candidate decisions; the cheap (C,)-
+shaped timing/metric mechanics stay in step.py where they replicate the
+scan path's float-op ordering bit for bit.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = float(np.float32(-np.inf))
+
+# Cluster rows per grid program (f32/i32 sublane tile is 8).
+_TC = 8
+_LANE = 128
+
+
+def default_enabled() -> bool:
+    """Use the kernel when running on a real TPU backend unless overridden
+    via KUBERNETRIKS_PALLAS=0/1."""
+    env = os.environ.get("KUBERNETRIKS_PALLAS")
+    if env is not None:
+        return env not in ("0", "false", "off")
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def _cycle_kernel(
+    n_real: int,
+    k_pods: int,
+    alive_ref,
+    alloc_cpu_ref,
+    alloc_ram_ref,
+    valid_ref,
+    req_cpu_ref,
+    req_ram_ref,
+    cpu_out,
+    ram_out,
+    assign_out,
+    fitany_out,
+    best_out,
+):
+    cpu_out[:] = alloc_cpu_ref[:]
+    ram_out[:] = alloc_ram_ref[:]
+    alive = alive_ref[:] != 0  # (TC, Np)
+    iota = jax.lax.broadcasted_iota(jnp.int32, alive.shape, 1)
+    lane_ok = iota < n_real
+
+    def body(k, _):
+        cpu = cpu_out[:]
+        ram = ram_out[:]
+        req_cpu = req_cpu_ref[:, pl.ds(k, 1)]  # (TC, 1) int32
+        req_ram = req_ram_ref[:, pl.ds(k, 1)]
+        valid = valid_ref[:, pl.ds(k, 1)] != 0
+
+        fit = alive & (req_cpu <= cpu) & (req_ram <= ram)
+        cpu_f = cpu.astype(jnp.float32)
+        ram_f = ram.astype(jnp.float32)
+        cpu_score = jnp.where(
+            cpu > 0, (cpu_f - req_cpu.astype(jnp.float32)) * 100.0 / cpu_f, _NEG_INF
+        )
+        ram_score = jnp.where(
+            ram > 0, (ram_f - req_ram.astype(jnp.float32)) * 100.0 / ram_f, _NEG_INF
+        )
+        score = jnp.where(fit, (cpu_score + ram_score) * 0.5, _NEG_INF)
+
+        # Last-max-wins argmax over the real lanes (ties resolve to the
+        # highest node slot, matching the reference's `>=` sweep).
+        max_score = jnp.max(score, axis=1, keepdims=True)
+        best = jnp.max(
+            jnp.where((score == max_score) & lane_ok, iota, -1),
+            axis=1,
+            keepdims=True,
+        )  # (TC, 1)
+        any_fit = jnp.any(fit, axis=1, keepdims=True)  # padded lanes never fit
+        assign = valid & any_fit
+
+        upd = assign & (iota == best)
+        cpu_out[:] = cpu - jnp.where(upd, req_cpu, 0)
+        ram_out[:] = ram - jnp.where(upd, req_ram, 0)
+        assign_out[:, pl.ds(k, 1)] = assign.astype(jnp.int32)
+        fitany_out[:, pl.ds(k, 1)] = any_fit.astype(jnp.int32)
+        best_out[:, pl.ds(k, 1)] = best
+        return 0
+
+    jax.lax.fori_loop(0, k_pods, body, 0)
+
+
+def _pad_axis(x: jnp.ndarray, axis: int, to: int, value) -> jnp.ndarray:
+    pad = to - x.shape[axis]
+    if pad <= 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths, constant_values=value)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def fused_schedule_cycle(
+    alive: jnp.ndarray,      # (C, N) bool
+    alloc_cpu: jnp.ndarray,  # (C, N) int32
+    alloc_ram: jnp.ndarray,  # (C, N) int32
+    valid: jnp.ndarray,      # (C, K) bool
+    req_cpu: jnp.ndarray,    # (C, K) int32
+    req_ram: jnp.ndarray,    # (C, K) int32
+    interpret: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Run the K-pod scheduling loop in VMEM.
+
+    Returns (assign (C,K) bool, fit_any (C,K) bool, best (C,K) int32,
+    new_alloc_cpu (C,N) int32, new_alloc_ram (C,N) int32), identical to the
+    lax.scan formulation in batched/step.py.
+    """
+    C, N = alloc_cpu.shape
+    K = valid.shape[1]
+    Cp = -(-C // _TC) * _TC
+    Np = -(-N // _LANE) * _LANE
+    Kp = -(-K // _LANE) * _LANE
+
+    alive_p = _pad_axis(_pad_axis(alive.astype(jnp.int32), 1, Np, 0), 0, Cp, 0)
+    cpu_p = _pad_axis(_pad_axis(alloc_cpu, 1, Np, 0), 0, Cp, 0)
+    ram_p = _pad_axis(_pad_axis(alloc_ram, 1, Np, 0), 0, Cp, 0)
+    valid_p = _pad_axis(_pad_axis(valid.astype(jnp.int32), 1, Kp, 0), 0, Cp, 0)
+    reqc_p = _pad_axis(_pad_axis(req_cpu, 1, Kp, 0), 0, Cp, 0)
+    reqr_p = _pad_axis(_pad_axis(req_ram, 1, Kp, 0), 0, Cp, 0)
+
+    node_spec = pl.BlockSpec((_TC, Np), lambda i: (i, 0), memory_space=pltpu.VMEM)
+    cand_spec = pl.BlockSpec((_TC, Kp), lambda i: (i, 0), memory_space=pltpu.VMEM)
+
+    kernel = functools.partial(_cycle_kernel, N, K)
+    cpu_o, ram_o, assign_o, fitany_o, best_o = pl.pallas_call(
+        kernel,
+        grid=(Cp // _TC,),
+        in_specs=[node_spec, node_spec, node_spec, cand_spec, cand_spec, cand_spec],
+        out_specs=[node_spec, node_spec, cand_spec, cand_spec, cand_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((Cp, Np), jnp.int32),
+            jax.ShapeDtypeStruct((Cp, Np), jnp.int32),
+            jax.ShapeDtypeStruct((Cp, Kp), jnp.int32),
+            jax.ShapeDtypeStruct((Cp, Kp), jnp.int32),
+            jax.ShapeDtypeStruct((Cp, Kp), jnp.int32),
+        ],
+        interpret=interpret,
+    )(alive_p, cpu_p, ram_p, valid_p, reqc_p, reqr_p)
+
+    return (
+        assign_o[:C, :K] != 0,
+        fitany_o[:C, :K] != 0,
+        best_o[:C, :K],
+        cpu_o[:C, :N],
+        ram_o[:C, :N],
+    )
