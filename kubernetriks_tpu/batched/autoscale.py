@@ -40,6 +40,7 @@ import jax.numpy as jnp
 
 from kubernetriks_tpu.batched.state import (
     ClusterBatchState,
+    TIME_DTYPE,
     PHASE_EMPTY,
     PHASE_FAILED,
     PHASE_QUEUED,
@@ -63,7 +64,7 @@ class AutoscaleStatics(NamedTuple):
     pg_max_pods: jnp.ndarray  # int32 max simultaneous replicas
     pg_target_cpu: jnp.ndarray  # float32; <=0 means metric unset
     pg_target_ram: jnp.ndarray  # float32; <=0 means metric unset
-    pg_creation: jnp.ndarray  # float32 trace creation time; +inf = padding
+    pg_creation: jnp.ndarray  # TIME_DTYPE trace creation time; +inf = padding
     # Piecewise-cyclic load curves, (C, Gp, U); duration 0 = padding unit.
     pg_cpu_dur: jnp.ndarray
     pg_cpu_load: jnp.ndarray
@@ -102,8 +103,8 @@ class AutoscaleState(NamedTuple):
     hpa_tail: jnp.ndarray  # (C, Gp) int32 next creation offset (== total_created)
     ca_count: jnp.ndarray  # (C, Gn) int32 current CA nodes per group
     ca_cursor: jnp.ndarray  # (C, Gn) int32 next reserved slot offset
-    hpa_next: jnp.ndarray  # (C,) float32 next HPA tick
-    ca_next: jnp.ndarray  # (C,) float32 next CA tick
+    hpa_next: jnp.ndarray  # (C,) TIME_DTYPE next HPA tick
+    ca_next: jnp.ndarray  # (C,) TIME_DTYPE next CA tick
 
 
 def init_autoscale_state(statics: AutoscaleStatics) -> AutoscaleState:
@@ -116,8 +117,8 @@ def init_autoscale_state(statics: AutoscaleStatics) -> AutoscaleState:
         hpa_tail=statics.pg_initial.astype(jnp.int32),
         ca_count=jnp.zeros((C, Gn), jnp.int32),
         ca_cursor=jnp.zeros((C, Gn), jnp.int32),
-        hpa_next=jnp.zeros((C,), jnp.float32),
-        ca_next=jnp.zeros((C,), jnp.float32),
+        hpa_next=jnp.zeros((C,), TIME_DTYPE),
+        ca_next=jnp.zeros((C,), TIME_DTYPE),
     )
 
 
@@ -212,7 +213,7 @@ def hpa_pass(
     down = jnp.minimum(jnp.maximum(-delta, 0), current)
 
     slot_start_p = st.pg_slot_start[rows, gid_c]  # (C, P); garbage where gid<0
-    off = jnp.arange(P)[None, :] - slot_start_p
+    off = jnp.arange(P, dtype=jnp.int32)[None, :] - slot_start_p
     in_group = gid >= 0
     count_p = count_g[rows, gid_c]
     tail_ring = jnp.mod(auto.hpa_tail, count_g)[rows, gid_c]
@@ -239,7 +240,7 @@ def hpa_pass(
     down_p = down[rows, gid_c]
 
     activate = in_group & (rel_tail < up_p) & reusable
-    rank = jnp.cumsum(activate, axis=1) - 1
+    rank = jnp.cumsum(activate, axis=1, dtype=jnp.int32) - 1
     n_up = activate.sum(axis=1).astype(jnp.int32)
     enqueue_ts = (T[:, None] + st.d_hpa_up).astype(pods.queue_ts.dtype)
     phase = jnp.where(activate, PHASE_QUEUED, pods.phase)
@@ -264,8 +265,8 @@ def hpa_pass(
     )
 
     metrics = metrics._replace(
-        scaled_up_pods=metrics.scaled_up_pods + up.sum(axis=1),
-        scaled_down_pods=metrics.scaled_down_pods + down.sum(axis=1),
+        scaled_up_pods=metrics.scaled_up_pods + up.sum(axis=1, dtype=jnp.int32),
+        scaled_down_pods=metrics.scaled_down_pods + down.sum(axis=1, dtype=jnp.int32),
     )
     auto = auto._replace(
         hpa_head=auto.hpa_head + down,
@@ -436,7 +437,7 @@ def _ca_scale_down(
         attempt = eligible & (cnt <= K_sd)  # overflow: conservatively skip
 
         pod_order = jnp.argsort(
-            jnp.where(on, jnp.arange(P)[None, :], _BIG_I32), axis=1
+            jnp.where(on, jnp.arange(P, dtype=jnp.int32)[None, :], _BIG_I32), axis=1
         )[:, :K_sd]
         pvalid = on[rows, pod_order] & attempt[:, None]
         prcpu = pods.req_cpu[rows, pod_order]
@@ -526,8 +527,8 @@ def ca_pass(
     )
 
     metrics = metrics._replace(
-        scaled_up_nodes=metrics.scaled_up_nodes + planned.sum(axis=1),
-        scaled_down_nodes=metrics.scaled_down_nodes + removed.sum(axis=1),
+        scaled_up_nodes=metrics.scaled_up_nodes + planned.sum(axis=1, dtype=jnp.int32),
+        scaled_down_nodes=metrics.scaled_down_nodes + removed.sum(axis=1, dtype=jnp.int32),
     )
     auto = auto._replace(
         ca_count=auto.ca_count + planned_per_group - removed_per_group,

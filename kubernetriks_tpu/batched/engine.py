@@ -78,7 +78,7 @@ def build_autoscale_statics(
     pg_max_pods = np.zeros((C, Gp), np.int32)
     pg_target_cpu = np.zeros((C, Gp), np.float32)
     pg_target_ram = np.zeros((C, Gp), np.float32)
-    pg_creation = np.full((C, Gp), np.inf, np.float32)
+    pg_creation = np.full((C, Gp), np.inf, np.float64)
     pg_cpu_dur = np.zeros((C, Gp, U), np.float32)
     pg_cpu_load = np.zeros((C, Gp, U), np.float32)
     pg_cpu_const = np.zeros((C, Gp), bool)
@@ -163,7 +163,7 @@ def build_autoscale_statics(
         ca_config.kube_cluster_autoscaler or KubeClusterAutoscalerConfig()
     ).scale_down_utilization_threshold
 
-    f32 = lambda x: jnp.asarray(x, jnp.float32)  # noqa: E731
+    f64 = lambda x: jnp.asarray(x, jnp.float64)  # noqa: E731  (time-like scalars match the f64 oracle)
     statics = AutoscaleStatics(
         pg_slot_start=jnp.asarray(pg_slot_start),
         pg_slot_count=jnp.asarray(pg_slot_count),
@@ -191,21 +191,21 @@ def build_autoscale_statics(
         ),
         ca_slots=jnp.asarray(ca_slots),
         ca_slot_group=jnp.asarray(ca_slot_group),
-        hpa_interval=f32(config.horizontal_pod_autoscaler.scan_interval),
-        ca_interval=f32(ca_config.scan_interval),
-        hpa_tolerance=f32(hpa_tol),
-        ca_threshold=f32(ca_thresh),
-        d_hpa_register=f32(delays.as_to_hpa_network_delay),
-        d_hpa_up=f32(delays.as_to_ca_network_delay + d_pod_enqueue),
-        d_hpa_down=f32(
+        hpa_interval=f64(config.horizontal_pod_autoscaler.scan_interval),
+        ca_interval=f64(ca_config.scan_interval),
+        hpa_tolerance=f64(hpa_tol),
+        ca_threshold=f64(ca_thresh),
+        d_hpa_register=f64(delays.as_to_hpa_network_delay),
+        d_hpa_up=f64(delays.as_to_ca_network_delay + d_pod_enqueue),
+        d_hpa_down=f64(
             delays.as_to_ca_network_delay + delays.as_to_ps_network_delay
         ),
-        d_ca_up=f32(
+        d_ca_up=f64(
             3.0 * delays.as_to_ca_network_delay
             + 5.0 * delays.as_to_ps_network_delay
             + delays.ps_to_sched_network_delay
         ),
-        d_ca_down=f32(
+        d_ca_down=f64(
             3.0 * delays.as_to_ca_network_delay
             + 4.0 * delays.as_to_ps_network_delay
             + delays.as_to_node_network_delay

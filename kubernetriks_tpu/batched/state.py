@@ -20,8 +20,20 @@ from __future__ import annotations
 
 from typing import NamedTuple, Optional
 
-import jax.numpy as jnp
-import numpy as np
+import jax
+
+# Simulation time is float64 end to end: at Alibaba-scale timestamps (~7e5 s)
+# float32 resolution (~0.06 s) is coarser than the modeled control-plane
+# delays (0.023-0.152 s, reference: src/config.yaml:73-78), so f32 delay
+# composition silently diverges from the scalar f64 oracle. XLA emulates f64
+# on TPU; only the time-like arrays pay for it — the (C, N)/(C, K) fit/score
+# work stays int32/float32.
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+TIME_DTYPE = jnp.float64
 
 # Pod phases.
 PHASE_EMPTY = 0  # slot not yet created
@@ -53,8 +65,8 @@ class NodeArrays(NamedTuple):
     alloc_cpu: jnp.ndarray  # int32
     alloc_ram: jnp.ndarray  # int32
     # Pending on-device effects (cluster-autoscaler actions); +inf = none.
-    create_time: jnp.ndarray  # float32
-    remove_time: jnp.ndarray  # float32
+    create_time: jnp.ndarray  # TIME_DTYPE
+    remove_time: jnp.ndarray  # TIME_DTYPE
 
 
 class PodArrays(NamedTuple):
@@ -63,15 +75,15 @@ class PodArrays(NamedTuple):
     phase: jnp.ndarray  # int32
     req_cpu: jnp.ndarray  # int32 millicores
     req_ram: jnp.ndarray  # int32 ram units
-    duration: jnp.ndarray  # float32 seconds; <0 means long-running service
-    queue_ts: jnp.ndarray  # float32: queue-priority / eligibility timestamp
+    duration: jnp.ndarray  # TIME_DTYPE seconds; <0 means long-running service
+    queue_ts: jnp.ndarray  # TIME_DTYPE: queue-priority / eligibility timestamp
     queue_seq: jnp.ndarray  # int32: FIFO tie-break within equal timestamps
-    initial_attempt_ts: jnp.ndarray  # float32
+    initial_attempt_ts: jnp.ndarray  # TIME_DTYPE
     attempts: jnp.ndarray  # int32
     node: jnp.ndarray  # int32 node slot, -1 = none
-    start_time: jnp.ndarray  # float32
-    finish_time: jnp.ndarray  # float32, +inf = no pending finish
-    removal_time: jnp.ndarray  # float32 pending HPA scale-down effect; +inf = none
+    start_time: jnp.ndarray  # TIME_DTYPE
+    finish_time: jnp.ndarray  # TIME_DTYPE, +inf = no pending finish
+    removal_time: jnp.ndarray  # TIME_DTYPE pending HPA scale-down effect; +inf = none
 
 
 class EstArrays(NamedTuple):
@@ -126,10 +138,10 @@ class ClusterBatchState(NamedTuple):
     """Complete batched simulation state; a pytree of arrays with leading
     cluster axis C, shardable across a device mesh on that axis."""
 
-    time: jnp.ndarray  # (C,) float32 current simulation time
+    time: jnp.ndarray  # (C,) TIME_DTYPE current simulation time
     queue_seq_counter: jnp.ndarray  # (C,) int32 next queue sequence number
     event_cursor: jnp.ndarray  # (C,) int32 next unapplied trace event
-    last_flush_time: jnp.ndarray  # (C,) float32 last unschedulable-leftover flush
+    last_flush_time: jnp.ndarray  # (C,) TIME_DTYPE last unschedulable-leftover flush
     requeue_signal: jnp.ndarray  # (C,) bool: node-add/pod-finish since last cycle
     nodes: NodeArrays
     pods: PodArrays
@@ -142,7 +154,7 @@ class TraceSlab(NamedTuple):
     """(C, E) compiled trace events, time-sorted per cluster, padded with
     EV_NONE/time=+inf."""
 
-    time: jnp.ndarray  # float32
+    time: jnp.ndarray  # TIME_DTYPE
     kind: jnp.ndarray  # int32
     slot: jnp.ndarray  # int32 (node slot for node events, pod slot for pod events)
 
@@ -203,22 +215,22 @@ def init_state(
         cap_ram=jnp.asarray(node_cap_ram, jnp.int32),
         alloc_cpu=jnp.asarray(node_cap_cpu, jnp.int32),
         alloc_ram=jnp.asarray(node_cap_ram, jnp.int32),
-        create_time=jnp.full((C, N), INF, jnp.float32),
-        remove_time=jnp.full((C, N), INF, jnp.float32),
+        create_time=jnp.full((C, N), INF, TIME_DTYPE),
+        remove_time=jnp.full((C, N), INF, TIME_DTYPE),
     )
     pods = PodArrays(
         phase=jnp.zeros((C, P), jnp.int32),
         req_cpu=jnp.asarray(pod_req_cpu, jnp.int32),
         req_ram=jnp.asarray(pod_req_ram, jnp.int32),
-        duration=jnp.asarray(pod_duration, jnp.float32),
-        queue_ts=jnp.zeros((C, P), jnp.float32),
+        duration=jnp.asarray(pod_duration, TIME_DTYPE),
+        queue_ts=jnp.zeros((C, P), TIME_DTYPE),
         queue_seq=jnp.zeros((C, P), jnp.int32),
-        initial_attempt_ts=jnp.zeros((C, P), jnp.float32),
+        initial_attempt_ts=jnp.zeros((C, P), TIME_DTYPE),
         attempts=jnp.zeros((C, P), jnp.int32),
         node=jnp.full((C, P), -1, jnp.int32),
-        start_time=jnp.zeros((C, P), jnp.float32),
-        finish_time=jnp.full((C, P), INF, jnp.float32),
-        removal_time=jnp.full((C, P), INF, jnp.float32),
+        start_time=jnp.zeros((C, P), TIME_DTYPE),
+        finish_time=jnp.full((C, P), INF, TIME_DTYPE),
+        removal_time=jnp.full((C, P), INF, TIME_DTYPE),
     )
     metrics = MetricArrays(
         pods_succeeded=jnp.zeros((C,), jnp.int32),
@@ -235,10 +247,10 @@ def init_state(
         pod_duration=EstArrays.zeros((C,)),
     )
     return ClusterBatchState(
-        time=jnp.zeros((C,), jnp.float32),
+        time=jnp.zeros((C,), TIME_DTYPE),
         queue_seq_counter=jnp.zeros((C,), jnp.int32),
         event_cursor=jnp.zeros((C,), jnp.int32),
-        last_flush_time=jnp.zeros((C,), jnp.float32),
+        last_flush_time=jnp.zeros((C,), TIME_DTYPE),
         requeue_signal=jnp.zeros((C,), bool),
         nodes=nodes,
         pods=pods,

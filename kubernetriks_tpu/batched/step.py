@@ -128,7 +128,7 @@ def _apply_window_events(
         .min(jnp.where(is_cp, ev_t, INF), mode="drop")
     )
     # Queue sequence numbers follow slab (== emission) order.
-    create_rank = jnp.cumsum(is_cp, axis=1) - 1
+    create_rank = jnp.cumsum(is_cp, axis=1, dtype=jnp.int32) - 1
     pod_create_seq = (
         jnp.zeros((C, P), jnp.int32)
         .at[rows, drop_slot(is_cp, P)]
@@ -199,7 +199,7 @@ def _apply_window_events(
 
     # Reschedule pods of removed nodes (reference: scheduler.rs:336-364; slot
     # order stands in for the scalar sorted-name order).
-    resched_rank = jnp.cumsum(rescheds, axis=1) - 1
+    resched_rank = jnp.cumsum(rescheds, axis=1, dtype=jnp.int32) - 1
     resched_ts = pod_node_removal + consts.delta_reschedule
     phase = jnp.where(rescheds, PHASE_QUEUED, phase)
     queue_ts = jnp.where(rescheds, resched_ts, queue_ts)
@@ -396,7 +396,7 @@ def commit_cycle(
 
     new_phase = jnp.where(
         assign_k, PHASE_RUNNING, jnp.where(park_k, PHASE_UNSCHEDULABLE, -1)
-    )
+    ).astype(pods.phase.dtype)
     touched = assign_k | park_k
     phase = pods.phase.at[rows, jnp.where(touched, cand, P)].set(
         jnp.where(touched, new_phase, 0), mode="drop"
@@ -520,7 +520,7 @@ def _run_scheduling_cycle(
         score = jnp.where(fit, (cpu_score + ram_score) * 0.5, -INF)
         # Last-max-wins argmax, matching the reference's `>=` sweep over
         # name-sorted nodes (kube_scheduler.rs:140-150).
-        best = (N - 1) - jnp.argmax(score[:, ::-1], axis=1)
+        best = (jnp.int32(N - 1) - jnp.argmax(score[:, ::-1], axis=1)).astype(jnp.int32)
         any_fit = fit.any(axis=1)
 
         (alloc_cpu, alloc_ram, metrics, assign, park, start, finish, park_ts,

@@ -75,14 +75,14 @@ def _compile_usage_model(model_config) -> Tuple[List[Tuple[float, float]], bool]
 class CompiledClusterTrace:
     """One cluster's compiled trace + payload tables (numpy, host-side)."""
 
-    ev_time: np.ndarray  # (E,) float32
+    ev_time: np.ndarray  # (E,) float64
     ev_kind: np.ndarray  # (E,) int32
     ev_slot: np.ndarray  # (E,) int32
     node_cap_cpu: np.ndarray  # (N,) int32
     node_cap_ram: np.ndarray  # (N,) int32 (ram units)
     pod_req_cpu: np.ndarray  # (P,) int32
     pod_req_ram: np.ndarray  # (P,) int32 (ram units)
-    pod_duration: np.ndarray  # (P,) float32 (-1 for long-running)
+    pod_duration: np.ndarray  # (P,) float64 (-1 for long-running)
     node_names: List[str] = field(default_factory=list)
     pod_names: List[str] = field(default_factory=list)
     pod_groups: List[CompiledPodGroup] = field(default_factory=list)
@@ -267,14 +267,14 @@ def compile_cluster_trace(
             )
 
     return CompiledClusterTrace(
-        ev_time=np.asarray(ev_time, np.float32),
+        ev_time=np.asarray(ev_time, np.float64),
         ev_kind=np.asarray(ev_kind, np.int32),
         ev_slot=np.asarray(ev_slot, np.int32),
         node_cap_cpu=np.asarray(node_cap_cpu, np.int32).reshape(-1),
         node_cap_ram=np.asarray(node_cap_ram, np.int32).reshape(-1),
         pod_req_cpu=np.asarray(pod_req_cpu, np.int32).reshape(-1),
         pod_req_ram=np.asarray(pod_req_ram, np.int32).reshape(-1),
-        pod_duration=np.asarray(pod_duration, np.float32).reshape(-1),
+        pod_duration=np.asarray(pod_duration, np.float64).reshape(-1),
         node_names=node_names,
         pod_names=pod_names,
         pod_groups=pod_groups,
@@ -296,14 +296,14 @@ def pad_and_batch(
     # +1: always keep a (time=+inf, EV_NONE) sentinel after the last real event.
     N, P, E = max(N, 1), max(P, 1), max(E, 0) + 1
 
-    ev_time = np.full((C, E), np.inf, np.float32)
+    ev_time = np.full((C, E), np.inf, np.float64)
     ev_kind = np.zeros((C, E), np.int32)
     ev_slot = np.zeros((C, E), np.int32)
     node_cap_cpu = np.zeros((C, N), np.int32)
     node_cap_ram = np.zeros((C, N), np.int32)
     pod_req_cpu = np.zeros((C, P), np.int32)
     pod_req_ram = np.zeros((C, P), np.int32)
-    pod_duration = np.full((C, P), -1.0, np.float32)
+    pod_duration = np.full((C, P), -1.0, np.float64)
 
     for i, c in enumerate(compiled):
         ev_time[i, : c.n_events] = c.ev_time
@@ -387,7 +387,7 @@ def compile_from_arrays(
     w_time = workload_arrays.start_ts.astype(np.float64)
     pod_req_cpu = workload_arrays.cpu_millicores.astype(np.int32)
     pod_req_ram = (-(-workload_arrays.ram_bytes // ram_unit)).astype(np.int32)
-    pod_duration = workload_arrays.duration.astype(np.float32)
+    pod_duration = workload_arrays.duration.astype(np.float64)
     pod_names = [workload_arrays.pod_name(i) for i in range(P)]
 
     # --- stable merge: primary time, cluster events before workload at ties
@@ -404,7 +404,7 @@ def compile_from_arrays(
     order = np.lexsort((source, times))  # stable within each source stream
 
     return CompiledClusterTrace(
-        ev_time=times[order].astype(np.float32),
+        ev_time=times[order],
         ev_kind=kinds[order],
         ev_slot=slots[order],
         node_cap_cpu=np.asarray(node_cap_cpu, np.int32).reshape(-1),

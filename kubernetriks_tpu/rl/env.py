@@ -110,7 +110,7 @@ def policy_cycle(
         rng, sub = jax.random.split(rng)
         sampled = jax.random.categorical(sub, safe_logits, axis=-1)
         best = jnp.argmax(safe_logits, axis=-1)
-        action = jnp.where(greedy, best, sampled)
+        action = jnp.where(greedy, best, sampled).astype(jnp.int32)
         log_probs = jax.nn.log_softmax(safe_logits, axis=-1)
         log_prob = log_probs[rows1, action]
 

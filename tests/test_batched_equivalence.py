@@ -212,6 +212,70 @@ def test_pod_removal_while_running_matches():
     assert bm["counters"]["pods_succeeded"] == 0
 
 
+def test_large_timestamp_equivalence_f64():
+    """Fidelity at Alibaba-scale timestamps: the same scenario shifted to
+    t ~ 1e6 s must still match the scalar f64 oracle with the reference's
+    sub-0.1 s network delays (f32 sim time has ~0.06 s resolution there, which
+    would swallow the delays; reference delay values: src/config.yaml:73-78)."""
+    T0 = 1_000_000.0  # multiple of the 10 s cycle interval
+    config = default_test_simulation_config(
+        "\n".join(
+            [
+                "as_to_ps_network_delay: 0.050",
+                "ps_to_sched_network_delay: 0.089",
+                "sched_to_as_network_delay: 0.023",
+                "as_to_node_network_delay: 0.152",
+            ]
+        )
+    )
+
+    cluster_yaml = CLUSTER_YAML.replace("timestamp: 5", f"timestamp: {5 + T0}").replace(
+        "timestamp: 200", f"timestamp: {200 + T0}"
+    )
+    events = ""
+    specs = [
+        ("pod_00", 2000, 4 * GiB, 50.0, 10 + T0),
+        ("pod_01", 2000, 4 * GiB, 80.0, 11 + T0),
+        ("pod_02", 4000, 8 * GiB, 40.0, 12 + T0),
+        ("pod_03", 4000, 8 * GiB, 30.0, 13 + T0),
+        ("pod_04", 12000, 24 * GiB, 60.0, 20 + T0),  # waits for node_02
+        ("pod_05", 1000, 2 * GiB, 25.0, 95 + T0),
+    ]
+    for spec in specs:
+        events += pod_yaml(*spec)
+    workload_yaml = "events:" + events
+
+    scalar = run_scalar(config, cluster_yaml, workload_yaml, T0 + 2000.0)
+
+    batched = build_batched_from_traces(
+        config,
+        GenericClusterTrace.from_yaml(cluster_yaml).convert_to_simulator_events(),
+        GenericWorkloadTrace.from_yaml(workload_yaml).convert_to_simulator_events(),
+        n_clusters=1,
+    )
+    # Windows before T0 are no-ops (no events, empty queues); skip them.
+    batched.next_window = T0
+    batched.step_until_time(T0 + 2000.0)
+
+    view = batched.pod_view(0)
+    for name, *_ in specs:
+        scalar_pod = scalar.persistent_storage.succeeded_pods.get(name)
+        assert scalar_pod is not None, f"{name} did not succeed in scalar run"
+        b = view[name]
+        assert b["phase"] == PHASE_SUCCEEDED, name
+        assert b["node"] == scalar_pod.status.assigned_node, name
+        scalar_start = scalar_pod.get_condition(
+            PodConditionType.POD_RUNNING
+        ).last_transition_time
+        # f64 resolution at t=1e6 is ~1e-10 s; the delays must survive exactly.
+        assert b["start_time"] == pytest.approx(scalar_start, abs=1e-6), name
+
+    sm = scalar.metrics_collector.accumulated_metrics
+    bm = batched.metrics_summary()
+    assert bm["counters"]["pods_succeeded"] == sm.pods_succeeded
+    assert bm["counters"]["terminated_pods"] == sm.internal.terminated_pods
+
+
 def test_larger_batch_replicates_cluster_zero():
     """Every cluster in a homogeneous batch produces identical results."""
     config = default_test_simulation_config()
