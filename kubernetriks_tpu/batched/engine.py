@@ -227,8 +227,13 @@ class BatchedSimulation:
         ca_slot_multiplier: int = 2,
         max_ca_pods_per_cycle: int = 64,
         max_pods_per_scale_down: int = 8,
+        use_pallas: Optional[bool] = None,
+        pallas_interpret: bool = False,
     ) -> None:
         self.config = config
+        self._use_pallas_requested = use_pallas
+        self.pallas_interpret = bool(pallas_interpret)
+        self.use_pallas = bool(use_pallas)  # finalized after shapes are known
         if config.enable_unscheduled_pods_conditional_move:
             raise NotImplementedError(
                 "enable_unscheduled_pods_conditional_move is not yet supported "
@@ -293,6 +298,24 @@ class BatchedSimulation:
         # unboundedly, reference scheduler.rs:261; the batched path bounds each
         # cycle and catches up next cycle).
         self.max_pods_per_cycle = max(1, max_pods_per_cycle or self.n_pods)
+
+        # Finalize the Pallas decision now that shapes are known. Default: on
+        # for single-device real-TPU runs whose blocks fit VMEM (overridable
+        # via the use_pallas arg or KUBERNETRIKS_PALLAS=0/1); off under a mesh
+        # — pallas_call has no GSPMD partitioning rule for the C-sharded state,
+        # so the scan path keeps multi-chip runs sharded.
+        from kubernetriks_tpu.ops.scheduler_kernel import default_enabled, kernel_fits
+
+        if self._use_pallas_requested is None:
+            # n_clusters >= 64: the kernel pads the cluster batch to full
+            # 128-lane tiles, so tiny batches would waste most of each tile's
+            # VPU work; the scan path is the better default there.
+            self.use_pallas = (
+                default_enabled()
+                and mesh is None
+                and self.n_clusters >= 64
+                and kernel_fits(self.n_nodes, self.max_pods_per_cycle)
+            )
 
         self.state = init_state(
             C,
@@ -380,6 +403,8 @@ class BatchedSimulation:
             self.autoscale_statics,
             self.max_ca_pods_per_cycle,
             self.max_pods_per_scale_down,
+            self.use_pallas,
+            self.pallas_interpret,
         )
         self.next_window = float(windows[-1]) + self.config.scheduling_cycle_interval
 
@@ -395,6 +420,8 @@ class BatchedSimulation:
             self.autoscale_statics,
             self.max_ca_pods_per_cycle,
             self.max_pods_per_scale_down,
+            self.use_pallas,
+            self.pallas_interpret,
         )
         self.next_window += self.config.scheduling_cycle_interval
 

@@ -22,6 +22,8 @@ from typing import NamedTuple, Optional
 
 import jax
 
+# NOTE: importing this module enables jax_enable_x64 PROCESS-WIDE (a hard
+# requirement of the whole batched subsystem, not an accident).
 # Simulation time is float64 end to end: at Alibaba-scale timestamps (~7e5 s)
 # float32 resolution (~0.06 s) is coarser than the modeled control-plane
 # delays (0.023-0.152 s, reference: src/config.yaml:73-78), so f32 delay

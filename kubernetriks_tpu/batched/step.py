@@ -285,6 +285,36 @@ class CycleCandidates(NamedTuple):
     initial_ts: jnp.ndarray
 
 
+def decision_mechanics(
+    metrics,
+    valid,
+    assign,
+    duration,
+    T,
+    cycle_dur,
+    pod_queue_time,
+    pod_sched_time,
+    consts: StepConstants,
+):
+    """The per-pod timing/metric mechanics shared BIT-FOR-BIT by the lax.scan
+    path, the Pallas path's mech scan, and the RL path: cycle-duration
+    accumulation, start/finish/park timestamps, decision metrics. Keeping this
+    in exactly one place is what guarantees scan/Pallas float-op parity."""
+    time_dtype = T.dtype
+    cycle_dur_post = cycle_dur + jnp.where(valid, pod_sched_time, 0.0)
+    start = (T + cycle_dur_post + consts.delta_bind_start).astype(time_dtype)
+    finish = jnp.where(duration >= 0, start + duration, INF).astype(time_dtype)
+    # Unschedulable park: new insert timestamp = T + cycle duration
+    # (reference: scheduler.rs:282-306).
+    park_ts = (T + cycle_dur_post).astype(time_dtype)
+    metrics = metrics._replace(
+        scheduling_decisions=metrics.scheduling_decisions + assign.astype(jnp.int32),
+        queue_time=metrics.queue_time.add(pod_queue_time, assign),
+        algo_latency=metrics.algo_latency.add(pod_sched_time, assign),
+    )
+    return metrics, start, finish, park_ts, cycle_dur_post
+
+
 def apply_decision(
     alloc_cpu,
     alloc_ram,
@@ -307,8 +337,6 @@ def apply_decision(
     node slot; `any_fit` gates assignment vs unschedulable park."""
     C = valid.shape[0]
     rows1 = jnp.arange(C)
-    time_dtype = T.dtype
-    cycle_dur_post = cycle_dur + jnp.where(valid, pod_sched_time, 0.0)
 
     assign = valid & any_fit
     park = valid & ~any_fit
@@ -317,16 +345,9 @@ def apply_decision(
     alloc_cpu = alloc_cpu.at[rows1, action_c].add(jnp.where(assign, -req_cpu, 0))
     alloc_ram = alloc_ram.at[rows1, action_c].add(jnp.where(assign, -req_ram, 0))
 
-    start = (T + cycle_dur_post + consts.delta_bind_start).astype(time_dtype)
-    finish = jnp.where(duration >= 0, start + duration, INF).astype(time_dtype)
-    # Unschedulable park: new insert timestamp = T + cycle duration
-    # (reference: scheduler.rs:282-306).
-    park_ts = (T + cycle_dur_post).astype(time_dtype)
-
-    metrics = metrics._replace(
-        scheduling_decisions=metrics.scheduling_decisions + assign.astype(jnp.int32),
-        queue_time=metrics.queue_time.add(pod_queue_time, assign),
-        algo_latency=metrics.algo_latency.add(pod_sched_time, assign),
+    metrics, start, finish, park_ts, cycle_dur_post = decision_mechanics(
+        metrics, valid, assign, duration, T, cycle_dur,
+        pod_queue_time, pod_sched_time, consts,
     )
     return alloc_cpu, alloc_ram, metrics, assign, park, start, finish, park_ts, cycle_dur_post
 
@@ -474,15 +495,9 @@ def _run_scheduling_cycle(
             cycle_dur, metrics = carry
             valid, assign, initial_ts, duration = xs
             pod_queue_time = T - initial_ts + cycle_dur
-            cycle_dur_post = cycle_dur + jnp.where(valid, pod_sched_time, 0.0)
-            start = (T + cycle_dur_post + consts.delta_bind_start).astype(time_dtype)
-            finish = jnp.where(duration >= 0, start + duration, INF).astype(time_dtype)
-            park_ts = (T + cycle_dur_post).astype(time_dtype)
-            metrics = metrics._replace(
-                scheduling_decisions=metrics.scheduling_decisions
-                + assign.astype(jnp.int32),
-                queue_time=metrics.queue_time.add(pod_queue_time, assign),
-                algo_latency=metrics.algo_latency.add(pod_sched_time, assign),
+            metrics, start, finish, park_ts, cycle_dur_post = decision_mechanics(
+                metrics, valid, assign, duration, T, cycle_dur,
+                pod_queue_time, pod_sched_time, consts,
             )
             return (cycle_dur_post, metrics), (start, finish, park_ts)
 
@@ -506,18 +521,28 @@ def _run_scheduling_cycle(
         pod_sched_time = consts.time_per_node * alive_count
 
         # Fit filter + LeastAllocatedResources score (reference: plugin.rs:33-63).
+        # Scores are float32 on BOTH batched paths (this scan and the Pallas
+        # kernel) — f64 is emulated on TPU; the precision only affects argmax
+        # tie-breaks between near-equal node scores, which the cross-path
+        # equivalence tests cover.
         fit = (
             alive
             & (req_cpu[:, None] <= alloc_cpu)
             & (req_ram[:, None] <= alloc_ram)
         )
+        alloc_cpu_f = alloc_cpu.astype(jnp.float32)
+        alloc_ram_f = alloc_ram.astype(jnp.float32)
         cpu_score = jnp.where(
-            alloc_cpu > 0, (alloc_cpu - req_cpu[:, None]) * 100.0 / alloc_cpu, -INF
+            alloc_cpu > 0,
+            (alloc_cpu_f - req_cpu[:, None].astype(jnp.float32)) * 100.0 / alloc_cpu_f,
+            -INF,
         )
         ram_score = jnp.where(
-            alloc_ram > 0, (alloc_ram - req_ram[:, None]) * 100.0 / alloc_ram, -INF
+            alloc_ram > 0,
+            (alloc_ram_f - req_ram[:, None].astype(jnp.float32)) * 100.0 / alloc_ram_f,
+            -INF,
         )
-        score = jnp.where(fit, (cpu_score + ram_score) * 0.5, -INF)
+        score = jnp.where(fit, (cpu_score + ram_score) * jnp.float32(0.5), -INF)
         # Last-max-wins argmax, matching the reference's `>=` sweep over
         # name-sorted nodes (kube_scheduler.rs:140-150).
         best = (jnp.int32(N - 1) - jnp.argmax(score[:, ::-1], axis=1)).astype(jnp.int32)

@@ -9,6 +9,12 @@ As a lax.scan, each of the K iterations round-trips the (C, N) allocatable
 arrays through HBM. This kernel runs the whole loop with the node tile pinned
 in VMEM: one HBM read and one write of node state per cycle instead of K.
 
+Layout: the kernel works TRANSPOSED — clusters ride the 128-wide lane
+dimension (one grid program per 128-cluster tile) and node/candidate slots
+ride sublanes, because Mosaic only allows dynamic slicing (the per-iteration
+candidate row `pl.ds(k, 1)`) on sublane dimensions; lane-dim indices must be
+statically 128-aligned.
+
 The kernel computes only the state-dependent core (fit/score/argmax +
 allocatable updates) and returns per-candidate decisions; the cheap (C,)-
 shaped timing/metric mechanics stay in step.py where they replicate the
@@ -29,9 +35,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = float(np.float32(-np.inf))
 
-# Cluster rows per grid program (f32/i32 sublane tile is 8).
-_TC = 8
-_LANE = 128
+_LANE = 128  # clusters per grid program (lane tile)
+_SUB = 8  # f32/i32 sublane tile
 
 
 def default_enabled() -> bool:
@@ -46,65 +51,99 @@ def default_enabled() -> bool:
         return False
 
 
+# Conservative per-core VMEM budget for the kernel's resident blocks; real
+# v5e VMEM is ~128 MiB but leave headroom for Mosaic's own buffers and the
+# surrounding fusion.
+_VMEM_BUDGET_BYTES = 12 * 1024 * 1024
+
+
+def kernel_fits(n_nodes: int, k_pods: int) -> bool:
+    """Whether one grid program's VMEM blocks (5 node blocks of (Np, 128) +
+    6 candidate blocks of (Kp, 128), all int32) fit the budget; callers fall
+    back to the lax.scan formulation when they don't."""
+    np_pad = -(-n_nodes // _SUB) * _SUB
+    kp_pad = -(-k_pods // _SUB) * _SUB
+    resident = (5 * np_pad + 6 * kp_pad) * _LANE * 4
+    return resident <= _VMEM_BUDGET_BYTES
+
+
 def _cycle_kernel(
     n_real: int,
     k_pods: int,
-    alive_ref,
-    alloc_cpu_ref,
-    alloc_ram_ref,
-    valid_ref,
-    req_cpu_ref,
-    req_ram_ref,
-    cpu_out,
-    ram_out,
-    assign_out,
-    fitany_out,
-    best_out,
+    alive_ref,      # (Np, LC) int32
+    alloc_cpu_ref,  # (Np, LC) int32
+    alloc_ram_ref,  # (Np, LC) int32
+    valid_ref,      # (Kp, LC) int32
+    req_cpu_ref,    # (Kp, LC) int32
+    req_ram_ref,    # (Kp, LC) int32
+    cpu_out,        # (Np, LC) int32
+    ram_out,        # (Np, LC) int32
+    assign_out,     # (Kp, LC) int32
+    fitany_out,     # (Kp, LC) int32
+    best_out,       # (Kp, LC) int32
 ):
+    # All literals are explicitly typed: with jax_enable_x64 on (the batched
+    # path's time arrays are f64), bare Python scalars trace as weak i64/f64
+    # constants, which Mosaic cannot lower inside the kernel.
+    i0 = jnp.int32(0)
+    neg1 = jnp.int32(-1)
+    hundred = jnp.float32(100.0)
+    half = jnp.float32(0.5)
+    neg_inf = jnp.float32(_NEG_INF)
+
     cpu_out[:] = alloc_cpu_ref[:]
     ram_out[:] = alloc_ram_ref[:]
-    alive = alive_ref[:] != 0  # (TC, Np)
-    iota = jax.lax.broadcasted_iota(jnp.int32, alive.shape, 1)
-    lane_ok = iota < n_real
+    alive = alive_ref[:] != i0  # (Np, LC)
+    iota = jax.lax.broadcasted_iota(jnp.int32, alive.shape, 0)
+    node_ok = iota < jnp.int32(n_real)  # padded sublanes are never real nodes
 
-    def body(k, _):
+    def body(k):
         cpu = cpu_out[:]
         ram = ram_out[:]
-        req_cpu = req_cpu_ref[:, pl.ds(k, 1)]  # (TC, 1) int32
-        req_ram = req_ram_ref[:, pl.ds(k, 1)]
-        valid = valid_ref[:, pl.ds(k, 1)] != 0
+        req_cpu = req_cpu_ref[pl.ds(k, 1), :]  # (1, LC) int32
+        req_ram = req_ram_ref[pl.ds(k, 1), :]
+        valid = valid_ref[pl.ds(k, 1), :] != i0
 
         fit = alive & (req_cpu <= cpu) & (req_ram <= ram)
         cpu_f = cpu.astype(jnp.float32)
         ram_f = ram.astype(jnp.float32)
         cpu_score = jnp.where(
-            cpu > 0, (cpu_f - req_cpu.astype(jnp.float32)) * 100.0 / cpu_f, _NEG_INF
+            cpu > i0, (cpu_f - req_cpu.astype(jnp.float32)) * hundred / cpu_f, neg_inf
         )
         ram_score = jnp.where(
-            ram > 0, (ram_f - req_ram.astype(jnp.float32)) * 100.0 / ram_f, _NEG_INF
+            ram > i0, (ram_f - req_ram.astype(jnp.float32)) * hundred / ram_f, neg_inf
         )
-        score = jnp.where(fit, (cpu_score + ram_score) * 0.5, _NEG_INF)
+        score = jnp.where(fit, (cpu_score + ram_score) * half, neg_inf)
 
-        # Last-max-wins argmax over the real lanes (ties resolve to the
+        # Last-max-wins argmax over the real node sublanes (ties resolve to the
         # highest node slot, matching the reference's `>=` sweep).
-        max_score = jnp.max(score, axis=1, keepdims=True)
+        max_score = jnp.max(score, axis=0, keepdims=True)
         best = jnp.max(
-            jnp.where((score == max_score) & lane_ok, iota, -1),
-            axis=1,
+            jnp.where((score == max_score) & node_ok, iota, neg1),
+            axis=0,
             keepdims=True,
-        )  # (TC, 1)
-        any_fit = jnp.any(fit, axis=1, keepdims=True)  # padded lanes never fit
+        )  # (1, LC)
+        # any() lowers to an i1 reduction Mosaic rejects; reduce in i32.
+        any_fit = (
+            jnp.max(fit.astype(jnp.int32), axis=0, keepdims=True) > i0
+        )  # padded slots never fit
         assign = valid & any_fit
 
         upd = assign & (iota == best)
-        cpu_out[:] = cpu - jnp.where(upd, req_cpu, 0)
-        ram_out[:] = ram - jnp.where(upd, req_ram, 0)
-        assign_out[:, pl.ds(k, 1)] = assign.astype(jnp.int32)
-        fitany_out[:, pl.ds(k, 1)] = any_fit.astype(jnp.int32)
-        best_out[:, pl.ds(k, 1)] = best
-        return 0
+        cpu_out[:] = cpu - jnp.where(upd, req_cpu, i0)
+        ram_out[:] = ram - jnp.where(upd, req_ram, i0)
+        assign_out[pl.ds(k, 1), :] = assign.astype(jnp.int32)
+        fitany_out[pl.ds(k, 1), :] = any_fit.astype(jnp.int32)
+        best_out[pl.ds(k, 1), :] = best
 
-    jax.lax.fori_loop(0, k_pods, body, 0)
+    # An explicit i32-carried while loop: with jax_enable_x64 on, fori_loop
+    # canonicalizes its induction variable to i64, which Mosaic cannot return
+    # from the loop-body region.
+    def loop_body(k):
+        body(k)
+        return k + jnp.int32(1)
+
+    jax.lax.while_loop(lambda k: k < jnp.int32(k_pods), loop_body, jnp.int32(0))
 
 
 def _pad_axis(x: jnp.ndarray, axis: int, to: int, value) -> jnp.ndarray:
@@ -134,40 +173,49 @@ def fused_schedule_cycle(
     """
     C, N = alloc_cpu.shape
     K = valid.shape[1]
-    Cp = -(-C // _TC) * _TC
-    Np = -(-N // _LANE) * _LANE
-    Kp = -(-K // _LANE) * _LANE
+    Cp = -(-C // _LANE) * _LANE
+    Np = -(-N // _SUB) * _SUB
+    Kp = -(-K // _SUB) * _SUB
 
-    alive_p = _pad_axis(_pad_axis(alive.astype(jnp.int32), 1, Np, 0), 0, Cp, 0)
-    cpu_p = _pad_axis(_pad_axis(alloc_cpu, 1, Np, 0), 0, Cp, 0)
-    ram_p = _pad_axis(_pad_axis(alloc_ram, 1, Np, 0), 0, Cp, 0)
-    valid_p = _pad_axis(_pad_axis(valid.astype(jnp.int32), 1, Kp, 0), 0, Cp, 0)
-    reqc_p = _pad_axis(_pad_axis(req_cpu, 1, Kp, 0), 0, Cp, 0)
-    reqr_p = _pad_axis(_pad_axis(req_ram, 1, Kp, 0), 0, Cp, 0)
+    def prep(x, n_sub, fill):
+        # (C, n) -> padded transposed (n_sub, Cp) with clusters on lanes.
+        return _pad_axis(_pad_axis(x.astype(jnp.int32).T, 0, n_sub, fill), 1, Cp, fill)
 
-    node_spec = pl.BlockSpec((_TC, Np), lambda i: (i, 0), memory_space=pltpu.VMEM)
-    cand_spec = pl.BlockSpec((_TC, Kp), lambda i: (i, 0), memory_space=pltpu.VMEM)
+    alive_p = prep(alive, Np, 0)
+    cpu_p = prep(alloc_cpu, Np, 0)
+    ram_p = prep(alloc_ram, Np, 0)
+    valid_p = prep(valid, Kp, 0)
+    reqc_p = prep(req_cpu, Kp, 0)
+    reqr_p = prep(req_ram, Kp, 0)
+
+    node_spec = pl.BlockSpec((Np, _LANE), lambda i: (0, i), memory_space=pltpu.VMEM)
+    cand_spec = pl.BlockSpec((Kp, _LANE), lambda i: (0, i), memory_space=pltpu.VMEM)
 
     kernel = functools.partial(_cycle_kernel, N, K)
-    cpu_o, ram_o, assign_o, fitany_o, best_o = pl.pallas_call(
-        kernel,
-        grid=(Cp // _TC,),
-        in_specs=[node_spec, node_spec, node_spec, cand_spec, cand_spec, cand_spec],
-        out_specs=[node_spec, node_spec, cand_spec, cand_spec, cand_spec],
-        out_shape=[
-            jax.ShapeDtypeStruct((Cp, Np), jnp.int32),
-            jax.ShapeDtypeStruct((Cp, Np), jnp.int32),
-            jax.ShapeDtypeStruct((Cp, Kp), jnp.int32),
-            jax.ShapeDtypeStruct((Cp, Kp), jnp.int32),
-            jax.ShapeDtypeStruct((Cp, Kp), jnp.int32),
-        ],
-        interpret=interpret,
-    )(alive_p, cpu_p, ram_p, valid_p, reqc_p, reqr_p)
+    # Trace the kernel with x64 semantics OFF: the batched path enables
+    # jax_enable_x64 for its f64 time arrays, but under x64 pallas_call's own
+    # index bookkeeping traces as i64, which Mosaic fails to legalize
+    # (func.return). Everything crossing this boundary is i32/bool.
+    with jax.enable_x64(False):
+        cpu_o, ram_o, assign_o, fitany_o, best_o = pl.pallas_call(
+            kernel,
+            grid=(Cp // _LANE,),
+            in_specs=[node_spec, node_spec, node_spec, cand_spec, cand_spec, cand_spec],
+            out_specs=[node_spec, node_spec, cand_spec, cand_spec, cand_spec],
+            out_shape=[
+                jax.ShapeDtypeStruct((Np, Cp), jnp.int32),
+                jax.ShapeDtypeStruct((Np, Cp), jnp.int32),
+                jax.ShapeDtypeStruct((Kp, Cp), jnp.int32),
+                jax.ShapeDtypeStruct((Kp, Cp), jnp.int32),
+                jax.ShapeDtypeStruct((Kp, Cp), jnp.int32),
+            ],
+            interpret=interpret,
+        )(alive_p, cpu_p, ram_p, valid_p, reqc_p, reqr_p)
 
     return (
-        assign_o[:C, :K] != 0,
-        fitany_o[:C, :K] != 0,
-        best_o[:C, :K],
-        cpu_o[:C, :N],
-        ram_o[:C, :N],
+        assign_o[:K, :C].T != 0,
+        fitany_o[:K, :C].T != 0,
+        best_o[:K, :C].T,
+        cpu_o[:N, :C].T,
+        ram_o[:N, :C].T,
     )
