@@ -234,13 +234,9 @@ class BatchedSimulation:
         self._use_pallas_requested = use_pallas
         self.pallas_interpret = bool(pallas_interpret)
         self.use_pallas = bool(use_pallas)  # finalized after shapes are known
-        if config.enable_unscheduled_pods_conditional_move:
-            raise NotImplementedError(
-                "enable_unscheduled_pods_conditional_move is not yet supported "
-                "on the batched path (it always applies the reference's "
-                "default flush-all policy); use the scalar path for "
-                "conditional-move configs"
-            )
+        self.conditional_move = bool(
+            config.enable_unscheduled_pods_conditional_move
+        )
         self.consts = make_step_constants(config)
         self.ram_unit = ram_unit
         C = len(compiled_traces)
@@ -405,6 +401,7 @@ class BatchedSimulation:
             self.max_pods_per_scale_down,
             self.use_pallas,
             self.pallas_interpret,
+            self.conditional_move,
         )
         self.next_window = float(windows[-1]) + self.config.scheduling_cycle_interval
 
@@ -422,6 +419,7 @@ class BatchedSimulation:
             self.max_pods_per_scale_down,
             self.use_pallas,
             self.pallas_interpret,
+            self.conditional_move,
         )
         self.next_window += self.config.scheduling_cycle_interval
 

@@ -145,6 +145,15 @@ class ClusterBatchState(NamedTuple):
     event_cursor: jnp.ndarray  # (C,) int32 next unapplied trace event
     last_flush_time: jnp.ndarray  # (C,) TIME_DTYPE last unschedulable-leftover flush
     requeue_signal: jnp.ndarray  # (C,) bool: node-add/pod-finish since last cycle
+    # Conditional-move accounting (enable_unscheduled_pods_conditional_move,
+    # reference: src/core/scheduler/scheduler.rs:391-409,366-380): per-window
+    # budgets consumed by the resource-aware wake scans in prepare_cycle.
+    wake_node_signal: jnp.ndarray  # (C,) bool: a node was added since last cycle
+    wake_node_cpu: jnp.ndarray  # (C,) int64 summed allocatable of new nodes
+    wake_node_ram: jnp.ndarray  # (C,) int64
+    wake_freed_signal: jnp.ndarray  # (C,) bool: pod finish/removal freed resources
+    wake_freed_cpu: jnp.ndarray  # (C,) int64 summed freed requests
+    wake_freed_ram: jnp.ndarray  # (C,) int64
     nodes: NodeArrays
     pods: PodArrays
     metrics: MetricArrays
@@ -173,7 +182,6 @@ class StepConstants(NamedTuple):
     delta_reschedule: float  # node removal -> its pods re-enqueued
     flush_interval: float  # 30 s (reference: queue.rs:11)
     max_unschedulable_stay: float  # 300 s (reference: queue.rs:8)
-    conditional_move: bool
 
 
 def make_step_constants(config) -> StepConstants:
@@ -194,7 +202,6 @@ def make_step_constants(config) -> StepConstants:
         + config.ps_to_sched_network_delay,
         flush_interval=30.0,
         max_unschedulable_stay=300.0,
-        conditional_move=config.enable_unscheduled_pods_conditional_move,
     )
 
 
@@ -254,6 +261,12 @@ def init_state(
         event_cursor=jnp.zeros((C,), jnp.int32),
         last_flush_time=jnp.zeros((C,), TIME_DTYPE),
         requeue_signal=jnp.zeros((C,), bool),
+        wake_node_signal=jnp.zeros((C,), bool),
+        wake_node_cpu=jnp.zeros((C,), jnp.int64),
+        wake_node_ram=jnp.zeros((C,), jnp.int64),
+        wake_freed_signal=jnp.zeros((C,), bool),
+        wake_freed_cpu=jnp.zeros((C,), jnp.int64),
+        wake_freed_ram=jnp.zeros((C,), jnp.int64),
         nodes=nodes,
         pods=pods,
         metrics=metrics,

@@ -239,6 +239,20 @@ def _apply_window_events(
 
     applied = valid.sum(axis=1).astype(jnp.int32)
     any_created_node = created.any(axis=1)
+    any_freed = (n_done > 0) | (n_removed_running > 0)
+
+    # Conditional-move budgets (consumed by prepare_cycle's wake scans when
+    # enable_unscheduled_pods_conditional_move is on; reference pools budgets
+    # per event, the batched path pools them per window): a new node
+    # contributes its full allocatable (= capacity at creation,
+    # scheduler.rs:393), a finished/removed pod its freed requests
+    # (scheduler.rs:366-380). int64: pooled sums over N/P slots can exceed
+    # int32 (e.g. thousands of 128 GiB nodes in one window) and the scalar
+    # oracle's budgets are unbounded Python ints.
+    wake_node_cpu = (created * nodes.cap_cpu.astype(jnp.int64)).sum(axis=1)
+    wake_node_ram = (created * nodes.cap_ram.astype(jnp.int64)).sum(axis=1)
+    wake_freed_cpu = jnp.where(freed, pods.req_cpu.astype(jnp.int64), 0).sum(axis=1)
+    wake_freed_ram = jnp.where(freed, pods.req_ram.astype(jnp.int64), 0).sum(axis=1)
 
     return state._replace(
         nodes=nodes._replace(
@@ -263,12 +277,76 @@ def _apply_window_events(
         queue_seq_counter=state.queue_seq_counter + n_creates + n_rescheds,
         # Events of interest wake the unschedulable queue (flush-all policy,
         # reference: scheduler.rs:391-410,435-440,445-473).
-        requeue_signal=state.requeue_signal
-        | any_created_node
-        | (n_done > 0)
-        | (n_removed_running > 0),
+        requeue_signal=state.requeue_signal | any_created_node | any_freed,
+        wake_node_signal=state.wake_node_signal | any_created_node,
+        wake_node_cpu=state.wake_node_cpu + wake_node_cpu,
+        wake_node_ram=state.wake_node_ram + wake_node_ram,
+        wake_freed_signal=state.wake_freed_signal | any_freed,
+        wake_freed_cpu=state.wake_freed_cpu + wake_freed_cpu,
+        wake_freed_ram=state.wake_freed_ram + wake_freed_ram,
         time=jnp.maximum(state.time, window_end),
     )
+
+
+def _conditional_wake(
+    state: ClusterBatchState, pods, stale: jnp.ndarray
+) -> jnp.ndarray:
+    """Resource-aware unschedulable wakes for
+    enable_unscheduled_pods_conditional_move, replicating the reference's two
+    greedy budget scans over the unschedulable queue in (insert_ts, name)
+    order — here (queue_ts, queue_seq) order:
+
+    - Node added (reference: src/core/scheduler/scheduler.rs:391-409): a pod
+      that FITS the new node's allocatable consumes the budget and STAYS
+      parked; a pod that does not fit moves to the active queue. (That
+      inverted sense is the reference's actual behavior; preserved as-is.)
+    - Resources freed by pod finish/removal (scheduler.rs:366-380,435-439,
+      462-468): greedy first-fit against the freed budget — a pod that fits
+      consumes the budget and MOVES.
+
+    Deviation (documented): the scalar path runs one scan per event at its
+    effect time; the batched path pools the budgets of all same-window events
+    into one scan pass of each kind.
+    """
+    C, P = pods.phase.shape
+    rows = jnp.arange(C)[:, None]
+    unsched = (pods.phase == PHASE_UNSCHEDULABLE) & ~stale
+
+    u_ts = jnp.where(unsched, pods.queue_ts, INF)
+    u_seq = jnp.where(unsched, pods.queue_seq, jnp.iinfo(jnp.int32).max)
+    order = jnp.lexsort((u_seq, u_ts), axis=1)  # (C, P) unschedulable first
+    o_valid = unsched[rows, order]
+    o_req_cpu = pods.req_cpu[rows, order]
+    o_req_ram = pods.req_ram[rows, order]
+
+    def scan_body(carry, xs):
+        node_cpu, node_ram, freed_cpu, freed_ram = carry
+        valid, req_cpu, req_ram = xs
+        # Scan 1: new-node budget — fits => consume + stay, else move.
+        node_scan = valid & state.wake_node_signal
+        fits_node = node_scan & (req_cpu <= node_cpu) & (req_ram <= node_ram)
+        node_cpu = node_cpu - jnp.where(fits_node, req_cpu, 0)
+        node_ram = node_ram - jnp.where(fits_node, req_ram, 0)
+        move_no_fit = node_scan & ~fits_node
+        # Scan 2: freed budget — fits => consume + move.
+        freed_scan = valid & state.wake_freed_signal
+        fits_freed = freed_scan & (req_cpu <= freed_cpu) & (req_ram <= freed_ram)
+        freed_cpu = freed_cpu - jnp.where(fits_freed, req_cpu, 0)
+        freed_ram = freed_ram - jnp.where(fits_freed, req_ram, 0)
+        return (node_cpu, node_ram, freed_cpu, freed_ram), move_no_fit | fits_freed
+
+    _, move_sorted = jax.lax.scan(
+        scan_body,
+        (
+            state.wake_node_cpu,
+            state.wake_node_ram,
+            state.wake_freed_cpu,
+            state.wake_freed_ram,
+        ),
+        (o_valid.T, o_req_cpu.T, o_req_ram.T),
+    )
+    # Scatter sorted-order decisions back to slot positions.
+    return jnp.zeros((C, P), bool).at[rows, order].set(move_sorted.T)
 
 
 class CycleCandidates(NamedTuple):
@@ -353,7 +431,11 @@ def apply_decision(
 
 
 def prepare_cycle(
-    state: ClusterBatchState, T: jnp.ndarray, consts: StepConstants, K: int
+    state: ClusterBatchState,
+    T: jnp.ndarray,
+    consts: StepConstants,
+    K: int,
+    conditional_move: bool = False,
 ) -> CycleCandidates:
     """Cycle preamble shared by the kube-scheduler and RL-policy cycles:
     unschedulable wake/flush moves, queue sort, top-K compaction."""
@@ -368,7 +450,10 @@ def prepare_cycle(
         & (T[:, None] - pods.queue_ts > consts.max_unschedulable_stay)
         & flush_now[:, None]
     )
-    wake = state.requeue_signal[:, None] & (pods.phase == PHASE_UNSCHEDULABLE)
+    if conditional_move:
+        wake = _conditional_wake(state, pods, stale)
+    else:
+        wake = state.requeue_signal[:, None] & (pods.phase == PHASE_UNSCHEDULABLE)
     to_move = stale | wake
     pods = pods._replace(
         phase=jnp.where(to_move, PHASE_QUEUED, pods.phase),
@@ -446,6 +531,12 @@ def commit_cycle(
         ),
         metrics=metrics,
         requeue_signal=jnp.zeros_like(state.requeue_signal),
+        wake_node_signal=jnp.zeros_like(state.wake_node_signal),
+        wake_node_cpu=jnp.zeros_like(state.wake_node_cpu),
+        wake_node_ram=jnp.zeros_like(state.wake_node_ram),
+        wake_freed_signal=jnp.zeros_like(state.wake_freed_signal),
+        wake_freed_cpu=jnp.zeros_like(state.wake_freed_cpu),
+        wake_freed_ram=jnp.zeros_like(state.wake_freed_ram),
         last_flush_time=cc.last_flush_time,
         time=jnp.maximum(state.time, T),
     )
@@ -458,6 +549,7 @@ def _run_scheduling_cycle(
     max_pods_per_cycle: int,
     use_pallas: bool = False,
     pallas_interpret: bool = False,
+    conditional_move: bool = False,
 ) -> ClusterBatchState:
     """One vectorized kube-scheduler cycle at time T for every cluster
     (scalar equivalent: reference scheduler.rs:246-333)."""
@@ -465,7 +557,7 @@ def _run_scheduling_cycle(
     N = state.nodes.alive.shape[1]
     rows1 = jnp.arange(C)
 
-    cc = prepare_cycle(state, T, consts, max_pods_per_cycle)
+    cc = prepare_cycle(state, T, consts, max_pods_per_cycle, conditional_move)
     cand_valid, cand_req_cpu, cand_req_ram = cc.valid, cc.req_cpu, cc.req_ram
     cand_duration, cand_initial_ts = cc.duration, cc.initial_ts
 
@@ -589,13 +681,20 @@ def _window_body(
     max_pods_per_scale_down: int = 8,
     use_pallas: bool = False,
     pallas_interpret: bool = False,
+    conditional_move: bool = False,
 ) -> ClusterBatchState:
     window_end = jnp.broadcast_to(window_end, state.time.shape)
     state = _apply_window_events(
         state, slab, window_end, consts, max_events_per_window
     )
     state = _run_scheduling_cycle(
-        state, window_end, consts, max_pods_per_cycle, use_pallas, pallas_interpret
+        state,
+        window_end,
+        consts,
+        max_pods_per_cycle,
+        use_pallas,
+        pallas_interpret,
+        conditional_move,
     )
     if autoscale_statics is not None:
         # Autoscaler ticks due by this window run after the scheduling cycle
@@ -624,6 +723,7 @@ _STEP_STATICS = (
     "max_pods_per_scale_down",
     "use_pallas",
     "pallas_interpret",
+    "conditional_move",
 )
 
 
@@ -640,6 +740,7 @@ def window_step(
     max_pods_per_scale_down: int = 8,
     use_pallas: bool = False,
     pallas_interpret: bool = False,
+    conditional_move: bool = False,
 ) -> ClusterBatchState:
     """Advance every cluster to `window_end` (the next scheduling-cycle time)."""
     return _window_body(
@@ -654,6 +755,7 @@ def window_step(
         max_pods_per_scale_down,
         use_pallas,
         pallas_interpret,
+        conditional_move,
     )
 
 
@@ -670,6 +772,7 @@ def run_windows(
     max_pods_per_scale_down: int = 8,
     use_pallas: bool = False,
     pallas_interpret: bool = False,
+    conditional_move: bool = False,
 ) -> ClusterBatchState:
     """Scan a whole sequence of scheduling-cycle windows on-device (the hot
     benchmark loop: no host round-trips between cycles)."""
@@ -688,6 +791,7 @@ def run_windows(
                 max_pods_per_scale_down,
                 use_pallas,
                 pallas_interpret,
+                conditional_move,
             ),
             None,
         )

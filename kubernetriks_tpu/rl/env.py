@@ -72,6 +72,7 @@ def policy_cycle(
     params,
     rng: jnp.ndarray,
     greedy: bool = False,
+    conditional_move: bool = False,
 ) -> Tuple[ClusterBatchState, Transition]:
     """One scheduling cycle where the policy picks nodes; returns the K
     per-cluster transitions. Action space = nodes, masked to Fit-feasible ones;
@@ -80,7 +81,7 @@ def policy_cycle(
     N = state.nodes.alive.shape[1]
     rows1 = jnp.arange(C)
 
-    cc = prepare_cycle(state, T, consts, K)
+    cc = prepare_cycle(state, T, consts, K, conditional_move)
     alive = state.nodes.alive
 
     alive_count = alive.sum(axis=1).astype(jnp.float32)
@@ -158,7 +159,13 @@ def policy_cycle(
 
 @partial(
     jax.jit,
-    static_argnames=("policy_apply", "max_events_per_window", "max_pods_per_cycle", "greedy"),
+    static_argnames=(
+        "policy_apply",
+        "max_events_per_window",
+        "max_pods_per_cycle",
+        "greedy",
+        "conditional_move",
+    ),
 )
 def rollout(
     state: ClusterBatchState,
@@ -171,6 +178,7 @@ def rollout(
     max_events_per_window: int,
     max_pods_per_cycle: int,
     greedy: bool = False,
+    conditional_move: bool = False,
 ) -> Tuple[ClusterBatchState, Transition]:
     """Scan W scheduling windows under the policy; transitions stacked (W, K, C, ...)."""
 
@@ -181,7 +189,7 @@ def rollout(
         st = _apply_window_events(st, slab, w_arr, consts, max_events_per_window)
         st, transition = policy_cycle(
             st, w_arr, consts, max_pods_per_cycle, policy_apply, params, sub,
-            greedy=greedy,
+            greedy=greedy, conditional_move=conditional_move,
         )
         return (st, rng), transition
 

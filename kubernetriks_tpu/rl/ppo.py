@@ -180,6 +180,7 @@ class PPOTrainer:
             self.sim.max_events_per_window,
             self.sim.max_pods_per_cycle,
             greedy=greedy,
+            conditional_move=self.sim.conditional_move,
         )
         # (W, K, C, ...) -> (W*K, C, ...) decision-ordered sequence.
         flat = jax.tree.map(

@@ -276,6 +276,114 @@ def test_large_timestamp_equivalence_f64():
     assert bm["counters"]["terminated_pods"] == sm.internal.terminated_pods
 
 
+def test_conditional_move_matches_scalar():
+    """enable_unscheduled_pods_conditional_move on the batched path: both
+    resource-aware wake scans must mirror the scalar oracle
+    (reference: src/core/scheduler/scheduler.rs:391-409 node-add scan with its
+    inverted fits-stay sense, :366-380 freed-budget first-fit)."""
+    config = default_test_simulation_config(
+        "enable_unscheduled_pods_conditional_move: true"
+    )
+    assert config.enable_unscheduled_pods_conditional_move
+
+    cluster = """
+events:
+- timestamp: 5
+  event_type:
+    !CreateNode
+      node:
+        metadata: {name: node_00}
+        status: {capacity: {cpu: 4000, ram: 8589934592}}
+- timestamp: 60
+  event_type:
+    !CreateNode
+      node:
+        metadata: {name: node_01}
+        status: {capacity: {cpu: 2500, ram: 5368709120}}
+"""
+    # pod_00 fills node_00; pod_01 + pod_02 park unschedulable.
+    # t=60 node_01 arrives: node scan walks (pod_01, pod_02) in park order —
+    # pod_01 (3000 > 2500) does NOT fit => woken (and parks again);
+    # pod_02 (2000 <= 2500) fits => STAYS parked (the reference's inverted
+    # sense) even though node_01 could run it.
+    # t~120 pod_00 finishes: freed scan order is (pod_02 ts~20, pod_01 ts~70);
+    # pod_02 fits the freed (3000, 6 GiB) => woken and scheduled; pod_01 does
+    # not fit the remaining (1000, 2 GiB) => stays until the 300 s stale flush.
+    workload = (
+        "events:"
+        + pod_yaml("pod_00", 3000, 6 * GiB, 100.0, 10)
+        + pod_yaml("pod_01", 3000, 6 * GiB, 40.0, 15)
+        + pod_yaml("pod_02", 2000, 4 * GiB, 40.0, 16)
+    )
+
+    scalar = run_scalar(config, cluster, workload, 600.0)
+    batched = run_batched(config, cluster, workload, 600.0)
+
+    view = batched.pod_view(0)
+    for name in ("pod_00", "pod_01", "pod_02"):
+        scalar_pod = scalar.persistent_storage.succeeded_pods.get(name)
+        assert scalar_pod is not None, f"{name} did not succeed in scalar run"
+        b = view[name]
+        assert b["phase"] == PHASE_SUCCEEDED, name
+        assert b["node"] == scalar_pod.status.assigned_node, name
+        scalar_start = scalar_pod.get_condition(
+            PodConditionType.POD_RUNNING
+        ).last_transition_time
+        assert b["start_time"] == pytest.approx(scalar_start, abs=1e-6), name
+
+    # The stale flush (not the wake scans) is what released pod_01: it parked
+    # again after the node-add wake, then waited out the 300 s stay.
+    scalar_p1_start = (
+        scalar.persistent_storage.succeeded_pods["pod_01"]
+        .get_condition(PodConditionType.POD_RUNNING)
+        .last_transition_time
+    )
+    assert scalar_p1_start > 370.0
+
+    sm = scalar.metrics_collector.accumulated_metrics
+    bm = batched.metrics_summary()
+    assert bm["counters"]["pods_succeeded"] == sm.pods_succeeded == 3
+
+
+def test_conditional_move_fitting_pod_stays_parked():
+    """Pinned reference quirk: after a node-add wake, a pod that FITS the new
+    node stays in the unschedulable queue (scheduler.rs:391-409 returns false
+    => not moved) — on both paths."""
+    config = default_test_simulation_config(
+        "enable_unscheduled_pods_conditional_move: true"
+    )
+    cluster = """
+events:
+- timestamp: 5
+  event_type:
+    !CreateNode
+      node:
+        metadata: {name: node_00}
+        status: {capacity: {cpu: 1000, ram: 2147483648}}
+- timestamp: 40
+  event_type:
+    !CreateNode
+      node:
+        metadata: {name: node_01}
+        status: {capacity: {cpu: 8000, ram: 17179869184}}
+"""
+    workload = "events:" + pod_yaml("pod_00", 4000, 8 * GiB, 50.0, 10)
+
+    # Stop before the 300 s stale flush would release it.
+    scalar = run_scalar(config, cluster, workload, 200.0)
+    batched = run_batched(config, cluster, workload, 200.0)
+
+    assert "pod_00" in scalar.persistent_storage.unscheduled_pods_cache
+    assert len(scalar.scheduler.unschedulable_pods) == 1
+    assert batched.pod_view(0)["pod_00"]["phase"] == PHASE_UNSCHEDULABLE
+    # Flush-all would have scheduled it: rerun without conditional move.
+    config2 = default_test_simulation_config()
+    scalar2 = run_scalar(config2, cluster, workload, 200.0)
+    batched2 = run_batched(config2, cluster, workload, 200.0)
+    assert "pod_00" in scalar2.persistent_storage.succeeded_pods
+    assert batched2.pod_view(0)["pod_00"]["phase"] == PHASE_SUCCEEDED
+
+
 def test_larger_batch_replicates_cluster_zero():
     """Every cluster in a homogeneous batch produces identical results."""
     config = default_test_simulation_config()
