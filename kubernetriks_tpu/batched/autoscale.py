@@ -38,6 +38,7 @@ from typing import NamedTuple, Tuple
 import jax
 import jax.numpy as jnp
 
+from kubernetriks_tpu.batched.step import lexsort_i32
 from kubernetriks_tpu.batched.state import (
     ClusterBatchState,
     TIME_DTYPE,
@@ -145,7 +146,7 @@ def hpa_pass(
     pods, metrics = state.pods, state.metrics
     C, P = pods.phase.shape
     Gp = st.pg_slot_start.shape[1]
-    rows = jnp.arange(C)[:, None]
+    rows = jnp.arange(C, dtype=jnp.int32)[:, None]
 
     due = T >= auto.hpa_next
     active = due[:, None] & (T[:, None] >= st.pg_creation + st.d_hpa_register)
@@ -241,7 +242,7 @@ def hpa_pass(
 
     activate = in_group & (rel_tail < up_p) & reusable
     rank = jnp.cumsum(activate, axis=1, dtype=jnp.int32) - 1
-    n_up = activate.sum(axis=1).astype(jnp.int32)
+    n_up = activate.sum(axis=1, dtype=jnp.int32)
     enqueue_ts = (T[:, None] + st.d_hpa_up).astype(pods.queue_ts.dtype)
     phase = jnp.where(activate, PHASE_QUEUED, pods.phase)
     queue_ts = jnp.where(activate, enqueue_ts, pods.queue_ts)
@@ -306,8 +307,8 @@ def _ca_scale_up(
     C, P = pods.phase.shape
     S = st.ca_slots.shape[1]
     Gn = st.ng_ca_start.shape[1]
-    rows = jnp.arange(C)[:, None]
-    rows1 = jnp.arange(C)
+    rows1 = jnp.arange(C, dtype=jnp.int32)
+    rows = rows1[:, None]
 
     # The storage unscheduled-pods cache: parked pods plus woken-but-unscheduled
     # pods (attempts>=2 after a wake, reference: persistent_storage.rs cache
@@ -317,7 +318,7 @@ def _ca_scale_up(
     )
     key_ts = jnp.where(in_cache, pods.queue_ts, INF)
     key_seq = jnp.where(in_cache, pods.queue_seq, _BIG_I32)
-    order = jnp.lexsort((key_seq, key_ts), axis=1)[:, :K_up]
+    order = lexsort_i32(key_ts, key_seq)[:, :K_up]
     cvalid = in_cache[rows, order] & branch[:, None]
     creq_cpu = pods.req_cpu[rows, order]
     creq_ram = pods.req_ram[rows, order]
@@ -339,7 +340,7 @@ def _ca_scale_up(
         # deduct from the virtual allocatable (reference :81-87).
         fit = planned & (rcpu[:, None] <= palloc_cpu) & (rram[:, None] <= palloc_ram)
         any_fit = fit.any(axis=1)
-        first = jnp.argmin(jnp.where(fit, plan_seq, _BIG_I32), axis=1)
+        first = jax.lax.argmin(jnp.where(fit, plan_seq, _BIG_I32), 1, jnp.int32)
         use = valid & any_fit
         palloc_cpu = palloc_cpu.at[rows1, jnp.where(use, first, S)].add(
             -rcpu, mode="drop"
@@ -358,7 +359,7 @@ def _ca_scale_up(
             & (rram[:, None] <= st.ng_tmpl_ram)
         )
         g_found = g_ok.any(axis=1)
-        g = jnp.argmax(g_ok, axis=1)
+        g = jax.lax.argmax(g_ok, 1, jnp.int32)
         open_ = can_open & g_found
         s_new = (
             st.ng_ca_start[rows1, g]
@@ -404,9 +405,9 @@ def _ca_scale_down(
     N = nodes.alive.shape[1]
     S = st.ca_slots.shape[1]
     Gn = st.ng_ca_start.shape[1]
-    rows = jnp.arange(C)[:, None]
-    rows1 = jnp.arange(C)
-    col_n = jnp.arange(N)[None, :]
+    rows1 = jnp.arange(C, dtype=jnp.int32)
+    rows = rows1[:, None]
+    col_n = jnp.arange(N, dtype=jnp.int32)[None, :]
 
     def outer(carry, xs):
         valloc_cpu, valloc_ram = carry
@@ -433,12 +434,12 @@ def _ca_scale_down(
         # bindings, matching PHASE_RUNNING).
         on = (pods.phase == PHASE_RUNNING) & (pods.node == slot[:, None])
         on = on & slot_ok[:, None]
-        cnt = on.sum(axis=1)
+        cnt = on.sum(axis=1, dtype=jnp.int32)
         attempt = eligible & (cnt <= K_sd)  # overflow: conservatively skip
 
         pod_order = jnp.argsort(
             jnp.where(on, jnp.arange(P, dtype=jnp.int32)[None, :], _BIG_I32), axis=1
-        )[:, :K_sd]
+        ).astype(jnp.int32)[:, :K_sd]
         pvalid = on[rows, pod_order] & attempt[:, None]
         prcpu = pods.req_cpu[rows, pod_order]
         prram = pods.req_ram[rows, pod_order]
@@ -455,7 +456,7 @@ def _ca_scale_down(
                 & (rram[:, None] <= vram)
             )
             any_fit = fit.any(axis=1)
-            tgt = jnp.argmax(fit, axis=1)  # first-fit in slot order
+            tgt = jax.lax.argmax(fit, 1, jnp.int32)  # first-fit in slot order
             place = pv & any_fit
             vcpu = vcpu.at[rows1, jnp.where(place, tgt, N)].add(-rcpu, mode="drop")
             vram = vram.at[rows1, jnp.where(place, tgt, N)].add(-rram, mode="drop")
@@ -516,7 +517,7 @@ def ca_pass(
     # Planned slots come alive at their effect time; removals likewise.
     C, S = planned.shape
     N = nodes.alive.shape[1]
-    rows = jnp.arange(C)[:, None]
+    rows = jnp.arange(C, dtype=jnp.int32)[:, None]
     tgt_create = jnp.where(planned, st.ca_slots, N)
     create_time = nodes.create_time.at[rows, tgt_create].min(
         jnp.broadcast_to((T + st.d_ca_up)[:, None], (C, S)), mode="drop"

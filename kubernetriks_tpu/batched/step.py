@@ -49,12 +49,27 @@ from kubernetriks_tpu.batched.state import (
 INF = jnp.inf
 
 
+def lexsort_i32(primary: jnp.ndarray, secondary: jnp.ndarray) -> jnp.ndarray:
+    """Row-wise stable argsort by (primary, secondary) returning int32 indices.
+
+    Equivalent to jnp.lexsort((secondary, primary), axis=1), but carries an
+    int32 iota payload — under jax_enable_x64, jnp.lexsort's internal index
+    iota is i64, which drags an emulated 64-bit lane through every (C, P)
+    queue sort in the hot loop."""
+    C, P = primary.shape
+    iota = jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32)[None, :], (C, P))
+    _, _, order = jax.lax.sort(
+        (primary, secondary, iota), dimension=1, num_keys=2, is_stable=True
+    )
+    return order
+
+
 def _est_add_reduced(est: EstArrays, values: jnp.ndarray, mask: jnp.ndarray) -> EstArrays:
     """Fold a (C, P) masked batch of samples into (C,) estimator accumulators."""
     values = values.astype(jnp.float32)
     maskf = mask.astype(jnp.float32)
     return EstArrays(
-        count=est.count + mask.sum(axis=1).astype(jnp.int32),
+        count=est.count + mask.sum(axis=1, dtype=jnp.int32),
         total=est.total + (values * maskf).sum(axis=1),
         total_sq=est.total_sq + (values * values * maskf).sum(axis=1),
         minimum=jnp.minimum(est.minimum, jnp.where(mask, values, INF).min(axis=1)),
@@ -68,6 +83,7 @@ def _apply_window_events(
     window_end: jnp.ndarray,
     consts: StepConstants,
     max_events_per_window: int,
+    conditional_move: bool = False,
 ) -> ClusterBatchState:
     """Apply every trace event with effect time STRICTLY before window_end, and
     resolve all pod finishes due in the window.
@@ -75,17 +91,22 @@ def _apply_window_events(
     Strictness: an effect landing exactly at cycle time T is processed after
     the cycle in the scalar kernel (older-event-id-first FIFO), so it belongs
     to the next window.
+
+    Dtype note (applies to this whole module): jax_enable_x64 is on for the
+    f64 time arrays, so every index/count op must pin an explicit 32-bit dtype
+    — untyped arange/argmax/bool-sum default to i64 under x64, and stray i64
+    lanes measurably slow the TPU hot loop (emulated 64-bit).
     """
     pods, nodes, metrics = state.pods, state.nodes, state.metrics
     C, P = pods.phase.shape
     N = nodes.alive.shape[1]
     E_total = slab.time.shape[1]
     E = max_events_per_window
-    rows1 = jnp.arange(C)
+    rows1 = jnp.arange(C, dtype=jnp.int32)
     rows = rows1[:, None]
 
     # Gather this window's slab segment: (C, E) starting at each cursor.
-    offs = state.event_cursor[:, None] + jnp.arange(E)[None, :]
+    offs = state.event_cursor[:, None] + jnp.arange(E, dtype=jnp.int32)[None, :]
     offs_c = jnp.clip(offs, 0, E_total - 1)
     ev_t = slab.time[rows, offs_c]
     ev_k = slab.kind[rows, offs_c]
@@ -137,7 +158,7 @@ def _apply_window_events(
             mode="drop",
         )
     )
-    n_creates = is_cp.sum(axis=1).astype(jnp.int32)
+    n_creates = is_cp.sum(axis=1, dtype=jnp.int32)
     # --- pod removal times --------------------------------------------------
     pod_removal = (
         jnp.full((C, P), INF)
@@ -187,12 +208,12 @@ def _apply_window_events(
     alloc_ram = alloc_ram.at[rows, node_idx].add(jnp.where(freed, pods.req_ram, 0))
 
     # Finished pods.
-    n_done = finishes.sum(axis=1).astype(jnp.int32)
+    n_done = finishes.sum(axis=1, dtype=jnp.int32)
     metrics = metrics._replace(
         pods_succeeded=metrics.pods_succeeded + n_done,
         terminated_pods=metrics.terminated_pods + n_done,
         pod_duration=_est_add_reduced(metrics.pod_duration, pods.duration, finishes),
-        processed_nodes=metrics.processed_nodes + created.sum(axis=1).astype(jnp.int32),
+        processed_nodes=metrics.processed_nodes + created.sum(axis=1, dtype=jnp.int32),
     )
     phase = jnp.where(finishes, PHASE_SUCCEEDED, phase)
     finish_time = jnp.where(finishes, INF, pods.finish_time)
@@ -211,11 +232,11 @@ def _apply_window_events(
     attempts = jnp.where(rescheds, 1, attempts)
     finish_time = jnp.where(rescheds, INF, finish_time)
     pod_node = jnp.where(rescheds, -1, pods.node)
-    n_rescheds = rescheds.sum(axis=1).astype(jnp.int32)
+    n_rescheds = rescheds.sum(axis=1, dtype=jnp.int32)
 
     # Removed-while-running pods terminate as removed
     # (reference: api_server.rs PodRemovedFromNode removed=true accounting).
-    n_removed_running = removed_running.sum(axis=1).astype(jnp.int32)
+    n_removed_running = removed_running.sum(axis=1, dtype=jnp.int32)
     metrics = metrics._replace(
         pods_removed=metrics.pods_removed + n_removed_running,
         terminated_pods=metrics.terminated_pods + n_removed_running,
@@ -237,7 +258,7 @@ def _apply_window_events(
     # alive only via pods.node indices, which is removal-independent).
     alive = alive & ~(node_removal < INF)
 
-    applied = valid.sum(axis=1).astype(jnp.int32)
+    applied = valid.sum(axis=1, dtype=jnp.int32)
     any_created_node = created.any(axis=1)
     any_freed = (n_done > 0) | (n_removed_running > 0)
 
@@ -248,11 +269,19 @@ def _apply_window_events(
     # scheduler.rs:393), a finished/removed pod its freed requests
     # (scheduler.rs:366-380). int64: pooled sums over N/P slots can exceed
     # int32 (e.g. thousands of 128 GiB nodes in one window) and the scalar
-    # oracle's budgets are unbounded Python ints.
-    wake_node_cpu = (created * nodes.cap_cpu.astype(jnp.int64)).sum(axis=1)
-    wake_node_ram = (created * nodes.cap_ram.astype(jnp.int64)).sum(axis=1)
-    wake_freed_cpu = jnp.where(freed, pods.req_cpu.astype(jnp.int64), 0).sum(axis=1)
-    wake_freed_ram = jnp.where(freed, pods.req_ram.astype(jnp.int64), 0).sum(axis=1)
+    # oracle's budgets are unbounded Python ints. Only computed when the
+    # feature is on — the i64 reductions are emulated on TPU and nothing else
+    # reads these fields.
+    if conditional_move:
+        wake_node_cpu = (created * nodes.cap_cpu.astype(jnp.int64)).sum(axis=1)
+        wake_node_ram = (created * nodes.cap_ram.astype(jnp.int64)).sum(axis=1)
+        wake_freed_cpu = jnp.where(freed, pods.req_cpu.astype(jnp.int64), 0).sum(axis=1)
+        wake_freed_ram = jnp.where(freed, pods.req_ram.astype(jnp.int64), 0).sum(axis=1)
+    else:
+        wake_node_cpu = jnp.zeros_like(state.wake_node_cpu)
+        wake_node_ram = jnp.zeros_like(state.wake_node_ram)
+        wake_freed_cpu = jnp.zeros_like(state.wake_freed_cpu)
+        wake_freed_ram = jnp.zeros_like(state.wake_freed_ram)
 
     return state._replace(
         nodes=nodes._replace(
@@ -309,12 +338,12 @@ def _conditional_wake(
     into one scan pass of each kind.
     """
     C, P = pods.phase.shape
-    rows = jnp.arange(C)[:, None]
+    rows = jnp.arange(C, dtype=jnp.int32)[:, None]
     unsched = (pods.phase == PHASE_UNSCHEDULABLE) & ~stale
 
     u_ts = jnp.where(unsched, pods.queue_ts, INF)
     u_seq = jnp.where(unsched, pods.queue_seq, jnp.iinfo(jnp.int32).max)
-    order = jnp.lexsort((u_seq, u_ts), axis=1)  # (C, P) unschedulable first
+    order = lexsort_i32(u_ts, u_seq)  # (C, P) unschedulable first
     o_valid = unsched[rows, order]
     o_req_cpu = pods.req_cpu[rows, order]
     o_req_ram = pods.req_ram[rows, order]
@@ -414,7 +443,7 @@ def apply_decision(
     computation, park timestamps, metric accounting). `action` is the chosen
     node slot; `any_fit` gates assignment vs unschedulable park."""
     C = valid.shape[0]
-    rows1 = jnp.arange(C)
+    rows1 = jnp.arange(C, dtype=jnp.int32)
 
     assign = valid & any_fit
     park = valid & ~any_fit
@@ -439,7 +468,7 @@ def prepare_cycle(
 ) -> CycleCandidates:
     """Cycle preamble shared by the kube-scheduler and RL-policy cycles:
     unschedulable wake/flush moves, queue sort, top-K compaction."""
-    rows = jnp.arange(state.pods.phase.shape[0])[:, None]
+    rows = jnp.arange(state.pods.phase.shape[0], dtype=jnp.int32)[:, None]
     pods = state.pods
 
     # Unschedulable-leftover flush at the 30 s cadence
@@ -465,7 +494,7 @@ def prepare_cycle(
     eligible = (pods.phase == PHASE_QUEUED) & (pods.queue_ts < T[:, None])
     sort_ts = jnp.where(eligible, pods.queue_ts, INF)
     sort_seq = jnp.where(eligible, pods.queue_seq, jnp.iinfo(jnp.int32).max)
-    order = jnp.lexsort((sort_seq, sort_ts), axis=1)  # (C, P)
+    order = lexsort_i32(sort_ts, sort_seq)  # (C, P)
 
     cand = order[:, :K]
     return CycleCandidates(
@@ -496,12 +525,14 @@ def commit_cycle(
 ) -> ClusterBatchState:
     """Scatter the K per-cluster decisions back into (C, P) state."""
     C, P = cc.pods.phase.shape
-    rows = jnp.arange(C)[:, None]
+    rows = jnp.arange(C, dtype=jnp.int32)[:, None]
     pods = cc.pods
     cand = cc.cand
 
     new_phase = jnp.where(
-        assign_k, PHASE_RUNNING, jnp.where(park_k, PHASE_UNSCHEDULABLE, -1)
+        assign_k,
+        jnp.int32(PHASE_RUNNING),
+        jnp.where(park_k, jnp.int32(PHASE_UNSCHEDULABLE), jnp.int32(-1)),
     ).astype(pods.phase.dtype)
     touched = assign_k | park_k
     phase = pods.phase.at[rows, jnp.where(touched, cand, P)].set(
@@ -555,14 +586,13 @@ def _run_scheduling_cycle(
     (scalar equivalent: reference scheduler.rs:246-333)."""
     C, P = state.pods.phase.shape
     N = state.nodes.alive.shape[1]
-    rows1 = jnp.arange(C)
 
     cc = prepare_cycle(state, T, consts, max_pods_per_cycle, conditional_move)
     cand_valid, cand_req_cpu, cand_req_ram = cc.valid, cc.req_cpu, cc.req_ram
     cand_duration, cand_initial_ts = cc.duration, cc.initial_ts
 
     alive = state.nodes.alive
-    alive_count = alive.sum(axis=1).astype(jnp.float32)
+    alive_count = alive.sum(axis=1, dtype=jnp.int32).astype(jnp.float32)
     time_dtype = cc.pods.queue_ts.dtype
 
     if use_pallas:
@@ -637,7 +667,7 @@ def _run_scheduling_cycle(
         score = jnp.where(fit, (cpu_score + ram_score) * jnp.float32(0.5), -INF)
         # Last-max-wins argmax, matching the reference's `>=` sweep over
         # name-sorted nodes (kube_scheduler.rs:140-150).
-        best = (jnp.int32(N - 1) - jnp.argmax(score[:, ::-1], axis=1)).astype(jnp.int32)
+        best = jnp.int32(N - 1) - jax.lax.argmax(score[:, ::-1], 1, jnp.int32)
         any_fit = fit.any(axis=1)
 
         (alloc_cpu, alloc_ram, metrics, assign, park, start, finish, park_ts,
@@ -685,7 +715,7 @@ def _window_body(
 ) -> ClusterBatchState:
     window_end = jnp.broadcast_to(window_end, state.time.shape)
     state = _apply_window_events(
-        state, slab, window_end, consts, max_events_per_window
+        state, slab, window_end, consts, max_events_per_window, conditional_move
     )
     state = _run_scheduling_cycle(
         state,

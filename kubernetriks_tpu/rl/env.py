@@ -79,12 +79,12 @@ def policy_cycle(
     no feasible node -> the pod parks unschedulable (like the Fit filter)."""
     C, P = state.pods.phase.shape
     N = state.nodes.alive.shape[1]
-    rows1 = jnp.arange(C)
+    rows1 = jnp.arange(C, dtype=jnp.int32)
 
     cc = prepare_cycle(state, T, consts, K, conditional_move)
     alive = state.nodes.alive
 
-    alive_count = alive.sum(axis=1).astype(jnp.float32)
+    alive_count = alive.sum(axis=1, dtype=jnp.int32).astype(jnp.float32)
 
     def body(carry, xs):
         alloc_cpu, alloc_ram, cycle_dur, metrics, rng = carry
@@ -110,7 +110,7 @@ def policy_cycle(
         )
         rng, sub = jax.random.split(rng)
         sampled = jax.random.categorical(sub, safe_logits, axis=-1)
-        best = jnp.argmax(safe_logits, axis=-1)
+        best = jax.lax.argmax(safe_logits, 1, jnp.int32)
         action = jnp.where(greedy, best, sampled).astype(jnp.int32)
         log_probs = jax.nn.log_softmax(safe_logits, axis=-1)
         log_prob = log_probs[rows1, action]
@@ -186,7 +186,9 @@ def rollout(
         st, rng = carry
         rng, sub = jax.random.split(rng)
         w_arr = jnp.broadcast_to(w, st.time.shape)
-        st = _apply_window_events(st, slab, w_arr, consts, max_events_per_window)
+        st = _apply_window_events(
+            st, slab, w_arr, consts, max_events_per_window, conditional_move
+        )
         st, transition = policy_cycle(
             st, w_arr, consts, max_pods_per_cycle, policy_apply, params, sub,
             greedy=greedy, conditional_move=conditional_move,
