@@ -13,6 +13,11 @@ scan cadence inside the window step:
   and a nested-scan scale-down with simulated re-placement over shared virtual
   allocatables (reference: kube_cluster_autoscaler.rs:55-307).
 
+Times are the 32-bit (win, off) pairs of timerep.py; the only 64-bit math is
+the load-curve elapsed-time evaluation (float64 on tiny (C, G) shapes — the
+curves cycle over arbitrary-length periods, where float32 elapsed time at
+Alibaba-scale timestamps would blur the curve position).
+
 Documented deviations from the scalar path (replica/node COUNTS match; exact
 identity of scaled-down members may differ):
 - HPA scale-down removes pods in FIFO creation order; the scalar path pops the
@@ -38,10 +43,9 @@ from typing import NamedTuple, Tuple
 import jax
 import jax.numpy as jnp
 
-from kubernetriks_tpu.batched.step import lexsort_i32
+from kubernetriks_tpu.batched.step import lexsort_time_i32
 from kubernetriks_tpu.batched.state import (
     ClusterBatchState,
-    TIME_DTYPE,
     PHASE_EMPTY,
     PHASE_FAILED,
     PHASE_QUEUED,
@@ -49,6 +53,17 @@ from kubernetriks_tpu.batched.state import (
     PHASE_RUNNING,
     PHASE_SUCCEEDED,
     PHASE_UNSCHEDULABLE,
+    StepConstants,
+)
+from kubernetriks_tpu.batched.timerep import (
+    TPair,
+    is_inf,
+    t_add,
+    t_inf,
+    t_le,
+    t_min,
+    t_where,
+    t_zeros,
 )
 
 INF = jnp.inf
@@ -65,7 +80,11 @@ class AutoscaleStatics(NamedTuple):
     pg_max_pods: jnp.ndarray  # int32 max simultaneous replicas
     pg_target_cpu: jnp.ndarray  # float32; <=0 means metric unset
     pg_target_ram: jnp.ndarray  # float32; <=0 means metric unset
-    pg_creation: jnp.ndarray  # TIME_DTYPE trace creation time; +inf = padding
+    # First HPA tick that sees the group: creation + register delay (pair);
+    # win=INF_WIN = padding / HPA disabled.
+    pg_active_from: TPair
+    # Absolute creation time in float64 seconds for load-curve elapsed math.
+    pg_creation_s: jnp.ndarray
     # Piecewise-cyclic load curves, (C, Gp, U); duration 0 = padding unit.
     pg_cpu_dur: jnp.ndarray
     pg_cpu_load: jnp.ndarray
@@ -85,16 +104,15 @@ class AutoscaleStatics(NamedTuple):
     ca_max_nodes: jnp.ndarray  # (C,) int32 global CA node quota
     ca_slots: jnp.ndarray  # (C, S) int32 global node slot of CA slot; -1 pad
     ca_slot_group: jnp.ndarray  # (C, S) int32 owning group; -1 pad
-    # --- scalars ---
-    hpa_interval: jnp.ndarray
-    ca_interval: jnp.ndarray
-    hpa_tolerance: jnp.ndarray
-    ca_threshold: jnp.ndarray
-    d_hpa_register: jnp.ndarray  # group creation -> registered at HPA
-    d_hpa_up: jnp.ndarray  # HPA tick -> scaled-up pod enters scheduler queue
-    d_hpa_down: jnp.ndarray  # HPA tick -> pod removal effect at storage
-    d_ca_up: jnp.ndarray  # CA tick -> scaled-up node schedulable
-    d_ca_down: jnp.ndarray  # CA tick -> node removal effect at node
+    # --- scalar time constants (pairs) ---
+    hpa_interval: TPair
+    ca_interval: TPair
+    hpa_tolerance: jnp.ndarray  # f64 scalar
+    ca_threshold: jnp.ndarray  # f64 scalar
+    d_hpa_up: TPair  # HPA tick -> scaled-up pod enters scheduler queue
+    d_hpa_down: TPair  # HPA tick -> pod removal effect at storage
+    d_ca_up: TPair  # CA tick -> scaled-up node schedulable
+    d_ca_down: TPair  # CA tick -> node removal effect at node
 
 
 class AutoscaleState(NamedTuple):
@@ -104,8 +122,8 @@ class AutoscaleState(NamedTuple):
     hpa_tail: jnp.ndarray  # (C, Gp) int32 next creation offset (== total_created)
     ca_count: jnp.ndarray  # (C, Gn) int32 current CA nodes per group
     ca_cursor: jnp.ndarray  # (C, Gn) int32 next reserved slot offset
-    hpa_next: jnp.ndarray  # (C,) TIME_DTYPE next HPA tick
-    ca_next: jnp.ndarray  # (C,) TIME_DTYPE next CA tick
+    hpa_next: TPair  # (C,) next HPA tick
+    ca_next: TPair  # (C,) next CA tick
 
 
 def init_autoscale_state(statics: AutoscaleStatics) -> AutoscaleState:
@@ -118,44 +136,66 @@ def init_autoscale_state(statics: AutoscaleStatics) -> AutoscaleState:
         hpa_tail=statics.pg_initial.astype(jnp.int32),
         ca_count=jnp.zeros((C, Gn), jnp.int32),
         ca_cursor=jnp.zeros((C, Gn), jnp.int32),
-        hpa_next=jnp.zeros((C,), TIME_DTYPE),
-        ca_next=jnp.zeros((C,), TIME_DTYPE),
+        hpa_next=t_zeros((C,)),
+        ca_next=t_zeros((C,)),
     )
 
 
 def _curve_load(dur, load, total, elapsed):
     """Piecewise-constant cyclic curve lookup (reference semantics:
     src/core/resource_usage/pod_group.rs:71-99). dur/load: (C, G, U);
-    total/elapsed: (C, G)."""
-    safe_total = jnp.maximum(total, 1e-9)
+    total/elapsed: (C, G). elapsed is float64 (see module docstring); the
+    returned load is float32."""
+    safe_total = jnp.maximum(total.astype(jnp.float64), 1e-9)
     pos = jnp.where(total > 0, jnp.mod(elapsed, safe_total), 0.0)
     ecs = jnp.cumsum(dur, axis=-1) - dur  # exclusive start of each unit
     in_unit = (ecs <= pos[..., None]) & (pos[..., None] < ecs + dur)
-    return jnp.where(in_unit, load, 0.0).sum(axis=-1)
+    return jnp.where(in_unit, load, 0.0).sum(axis=-1).astype(jnp.float32)
+
+
+def _broadcast_pair(p: TPair, shape) -> TPair:
+    return TPair(
+        win=jnp.broadcast_to(p.win[..., None], shape),
+        off=jnp.broadcast_to(p.off[..., None], shape),
+    )
 
 
 def hpa_pass(
     state: ClusterBatchState,
     auto: AutoscaleState,
     st: AutoscaleStatics,
-    T: jnp.ndarray,
+    W: jnp.ndarray,
+    consts: StepConstants,
 ) -> Tuple[ClusterBatchState, AutoscaleState]:
-    """One masked HPA cycle at time T for every due cluster
+    """One masked HPA cycle at window W for every due cluster
     (scalar equivalent: horizontal_pod_autoscaler.py run cycle +
     kube_horizontal_pod_autoscaler.py formula)."""
     pods, metrics = state.pods, state.metrics
     C, P = pods.phase.shape
     Gp = st.pg_slot_start.shape[1]
+    interval = jnp.float32(consts.scheduling_interval)
     rows = jnp.arange(C, dtype=jnp.int32)[:, None]
+    T = TPair(win=W, off=jnp.zeros((C,), jnp.float32))  # (C,)
+    Tg = TPair(
+        win=jnp.broadcast_to(W[:, None], (C, Gp)),
+        off=jnp.zeros((C, Gp), jnp.float32),
+    )
 
-    due = T >= auto.hpa_next
-    active = due[:, None] & (T[:, None] >= st.pg_creation + st.d_hpa_register)
+    due = t_le(auto.hpa_next, T)
+    active = due[:, None] & t_le(st.pg_active_from, Tg)
 
     # Group membership and running counts (running = bound AND started by T,
     # mirroring node_component.running_pods at collection time).
     gid = st.pod_group_id
     gid_c = jnp.where(gid >= 0, gid, Gp)
-    running = (pods.phase == PHASE_RUNNING) & (pods.start_time <= T[:, None])
+    started = t_le(
+        pods.start_time,
+        TPair(
+            win=jnp.broadcast_to(W[:, None], (C, P)),
+            off=jnp.zeros((C, P), jnp.float32),
+        ),
+    )
+    running = (pods.phase == PHASE_RUNNING) & started
     run_per_group = (
         jnp.zeros((C, Gp + 1), jnp.int32)
         .at[rows, gid_c]
@@ -164,7 +204,10 @@ def hpa_pass(
     present = run_per_group > 0  # group absent from metrics when nothing runs
     runf = jnp.maximum(run_per_group, 1).astype(jnp.float32)
 
-    elapsed = T[:, None] - st.pg_creation
+    # Elapsed time since group creation, float64 (curves cycle over arbitrary
+    # periods; f32 elapsed at large absolute t would blur the curve position).
+    T_s = W.astype(jnp.float64) * jnp.float64(consts.scheduling_interval)
+    elapsed = T_s[:, None] - st.pg_creation_s
     cpu_load = _curve_load(st.pg_cpu_dur, st.pg_cpu_load, st.pg_cpu_total, elapsed)
     ram_load = _curve_load(st.pg_ram_dur, st.pg_ram_load, st.pg_ram_total, elapsed)
     util_cpu = jnp.where(
@@ -243,26 +286,27 @@ def hpa_pass(
     activate = in_group & (rel_tail < up_p) & reusable
     rank = jnp.cumsum(activate, axis=1, dtype=jnp.int32) - 1
     n_up = activate.sum(axis=1, dtype=jnp.int32)
-    enqueue_ts = (T[:, None] + st.d_hpa_up).astype(pods.queue_ts.dtype)
+    enq = t_add(T, st.d_hpa_up, interval)  # (C,) pair
+    enq_p = _broadcast_pair(enq, (C, P))
     phase = jnp.where(activate, PHASE_QUEUED, pods.phase)
-    queue_ts = jnp.where(activate, enqueue_ts, pods.queue_ts)
+    queue_ts = t_where(activate, enq_p, pods.queue_ts)
     queue_seq = jnp.where(
         activate, state.queue_seq_counter[:, None] + rank, pods.queue_seq
     )
-    initial_attempt_ts = jnp.where(activate, enqueue_ts, pods.initial_attempt_ts)
+    initial_attempt_ts = t_where(activate, enq_p, pods.initial_attempt_ts)
     attempts = jnp.where(activate, 1, pods.attempts)
     # Reset state left over from a previous occupant of a reused slot.
     node = jnp.where(activate, -1, pods.node)
-    start_time = jnp.where(activate, 0.0, pods.start_time)
-    finish_time = jnp.where(activate, jnp.inf, pods.finish_time)
+    start_time = t_where(activate, t_zeros((C, P)), pods.start_time)
+    finish_time = t_where(activate, t_inf((C, P)), pods.finish_time)
 
     # --- scale down: mark ring offsets [head, head+down) for removal -------
     deactivate = in_group & (rel_head < down_p) & ~activate
-    removal_time = jnp.where(activate, jnp.inf, pods.removal_time)
-    removal_time = jnp.where(
-        deactivate,
-        jnp.minimum(removal_time, T[:, None] + st.d_hpa_down),
-        removal_time,
+    removal_time = t_where(activate, t_inf((C, P)), pods.removal_time)
+    rem = t_add(T, st.d_hpa_down, interval)  # (C,) pair
+    rem_p = _broadcast_pair(rem, (C, P))
+    removal_time = t_where(
+        deactivate, t_min(removal_time, rem_p), removal_time
     )
 
     metrics = metrics._replace(
@@ -272,7 +316,9 @@ def hpa_pass(
     auto = auto._replace(
         hpa_head=auto.hpa_head + down,
         hpa_tail=auto.hpa_tail + up,
-        hpa_next=jnp.where(due, auto.hpa_next + st.hpa_interval, auto.hpa_next),
+        hpa_next=t_where(
+            due, t_add(auto.hpa_next, st.hpa_interval, interval), auto.hpa_next
+        ),
     )
     state = state._replace(
         pods=pods._replace(
@@ -296,7 +342,6 @@ def _ca_scale_up(
     state: ClusterBatchState,
     auto: AutoscaleState,
     st: AutoscaleStatics,
-    T: jnp.ndarray,
     branch: jnp.ndarray,
     K_up: int,
 ):
@@ -316,9 +361,9 @@ def _ca_scale_up(
     in_cache = (pods.phase == PHASE_UNSCHEDULABLE) | (
         (pods.phase == PHASE_QUEUED) & (pods.attempts >= 2)
     )
-    key_ts = jnp.where(in_cache, pods.queue_ts, INF)
+    key_t = t_where(in_cache, pods.queue_ts, t_inf((C, P)))
     key_seq = jnp.where(in_cache, pods.queue_seq, _BIG_I32)
-    order = lexsort_i32(key_ts, key_seq)[:, :K_up]
+    order = lexsort_time_i32(key_t, key_seq)[:, :K_up]
     cvalid = in_cache[rows, order] & branch[:, None]
     creq_cpu = pods.req_cpu[rows, order]
     creq_ram = pods.req_ram[rows, order]
@@ -393,7 +438,6 @@ def _ca_scale_down(
     state: ClusterBatchState,
     auto: AutoscaleState,
     st: AutoscaleStatics,
-    T: jnp.ndarray,
     branch: jnp.ndarray,
     K_sd: int,
 ):
@@ -427,7 +471,12 @@ def _ca_scale_down(
         util = jnp.maximum(used_cpu / cap_cpu, used_ram / cap_ram)
         # A node already pending removal (effect time beyond this window) must
         # not be re-selected: it would double-decrement ca_count.
-        not_pending = nodes.remove_time[rows1, slotc] == INF
+        not_pending = is_inf(
+            TPair(
+                win=nodes.remove_time.win[rows1, slotc],
+                off=nodes.remove_time.off[rows1, slotc],
+            )
+        )
         eligible = alive_here & not_pending & (util < st.ca_threshold)
 
         # Pods assigned to this node (storage assignments include in-flight
@@ -494,16 +543,20 @@ def ca_pass(
     state: ClusterBatchState,
     auto: AutoscaleState,
     st: AutoscaleStatics,
-    T: jnp.ndarray,
+    W: jnp.ndarray,
+    consts: StepConstants,
     K_up: int,
     K_sd: int,
 ) -> Tuple[ClusterBatchState, AutoscaleState]:
-    """One masked cluster-autoscaler cycle at time T (scalar equivalent:
+    """One masked cluster-autoscaler cycle at window W (scalar equivalent:
     cluster_autoscaler.py cycle; AUTO info policy: scale up iff the
     unscheduled cache is non-empty, reference: persistent_storage.rs:381-412)."""
     pods, nodes, metrics = state.pods, state.nodes, state.metrics
+    C = pods.phase.shape[0]
+    interval = jnp.float32(consts.scheduling_interval)
+    T = TPair(win=W, off=jnp.zeros((C,), jnp.float32))
 
-    due = T >= auto.ca_next
+    due = t_le(auto.ca_next, T)
     in_cache = (pods.phase == PHASE_UNSCHEDULABLE) | (
         (pods.phase == PHASE_QUEUED) & (pods.attempts >= 2)
     )
@@ -511,20 +564,30 @@ def ca_pass(
     up_branch = due & any_unsched
     down_branch = due & ~any_unsched
 
-    planned, planned_per_group = _ca_scale_up(state, auto, st, T, up_branch, K_up)
-    removed, removed_per_group = _ca_scale_down(state, auto, st, T, down_branch, K_sd)
+    planned, planned_per_group = _ca_scale_up(state, auto, st, up_branch, K_up)
+    removed, removed_per_group = _ca_scale_down(state, auto, st, down_branch, K_sd)
 
-    # Planned slots come alive at their effect time; removals likewise.
-    C, S = planned.shape
+    # Planned slots come alive at their effect time; removals likewise. The
+    # effect-time value is one (C,) pair — scatter a boolean touch mask (fast
+    # 32-bit path) and merge the pair elementwise.
+    _, S = planned.shape
     N = nodes.alive.shape[1]
     rows = jnp.arange(C, dtype=jnp.int32)[:, None]
     tgt_create = jnp.where(planned, st.ca_slots, N)
-    create_time = nodes.create_time.at[rows, tgt_create].min(
-        jnp.broadcast_to((T + st.d_ca_up)[:, None], (C, S)), mode="drop"
+    touch_create = (
+        jnp.zeros((C, N), bool).at[rows, tgt_create].set(True, mode="drop")
+    )
+    eff_up = _broadcast_pair(t_add(T, st.d_ca_up, interval), (C, N))
+    create_time = t_where(
+        touch_create, t_min(nodes.create_time, eff_up), nodes.create_time
     )
     tgt_remove = jnp.where(removed, st.ca_slots, N)
-    remove_time = nodes.remove_time.at[rows, tgt_remove].min(
-        jnp.broadcast_to((T + st.d_ca_down)[:, None], (C, S)), mode="drop"
+    touch_remove = (
+        jnp.zeros((C, N), bool).at[rows, tgt_remove].set(True, mode="drop")
+    )
+    eff_down = _broadcast_pair(t_add(T, st.d_ca_down, interval), (C, N))
+    remove_time = t_where(
+        touch_remove, t_min(nodes.remove_time, eff_down), nodes.remove_time
     )
 
     metrics = metrics._replace(
@@ -534,7 +597,9 @@ def ca_pass(
     auto = auto._replace(
         ca_count=auto.ca_count + planned_per_group - removed_per_group,
         ca_cursor=auto.ca_cursor + planned_per_group,
-        ca_next=jnp.where(due, auto.ca_next + st.ca_interval, auto.ca_next),
+        ca_next=t_where(
+            due, t_add(auto.ca_next, st.ca_interval, interval), auto.ca_next
+        ),
     )
     state = state._replace(
         nodes=nodes._replace(create_time=create_time, remove_time=remove_time),

@@ -33,6 +33,7 @@ from kubernetriks_tpu.batched.state import (
     init_state,
     make_step_constants,
 )
+from kubernetriks_tpu.batched.timerep import TPair, from_f64_np, to_f64
 from kubernetriks_tpu.batched.step import run_windows, window_step
 from kubernetriks_tpu.batched.trace_compile import (
     CompiledClusterTrace,
@@ -78,7 +79,8 @@ def build_autoscale_statics(
     pg_max_pods = np.zeros((C, Gp), np.int32)
     pg_target_cpu = np.zeros((C, Gp), np.float32)
     pg_target_ram = np.zeros((C, Gp), np.float32)
-    pg_creation = np.full((C, Gp), np.inf, np.float64)
+    pg_active_from = np.full((C, Gp), np.inf, np.float64)
+    pg_creation_s = np.zeros((C, Gp), np.float64)
     pg_cpu_dur = np.zeros((C, Gp, U), np.float32)
     pg_cpu_load = np.zeros((C, Gp, U), np.float32)
     pg_cpu_const = np.zeros((C, Gp), bool)
@@ -97,7 +99,14 @@ def build_autoscale_statics(
             pg_target_ram[ci, gi] = g.target_ram
             # With HPA disabled the group's initial pods still run (the
             # api-server expansion is unconditional) but no cycle ever acts.
-            pg_creation[ci, gi] = g.creation_time if hpa_on else np.inf
+            # active_from = creation + register delay (the first HPA tick that
+            # sees the group, reference: horizontal_pod_autoscaler.rs:187-198).
+            pg_creation_s[ci, gi] = g.creation_time
+            pg_active_from[ci, gi] = (
+                g.creation_time + config.as_to_hpa_network_delay
+                if hpa_on
+                else np.inf
+            )
             for ui, (dur, load) in enumerate(g.cpu_units):
                 pg_cpu_dur[ci, gi, ui] = dur
                 pg_cpu_load[ci, gi, ui] = load
@@ -163,7 +172,14 @@ def build_autoscale_statics(
         ca_config.kube_cluster_autoscaler or KubeClusterAutoscalerConfig()
     ).scale_down_utilization_threshold
 
-    f64 = lambda x: jnp.asarray(x, jnp.float64)  # noqa: E731  (time-like scalars match the f64 oracle)
+    interval = config.scheduling_cycle_interval
+
+    def pair(x) -> TPair:
+        """Scalar or array seconds -> device TPair (host-side f64 split)."""
+        w, o = from_f64_np(np.asarray(x, np.float64), interval)
+        return TPair(win=jnp.asarray(w), off=jnp.asarray(o))
+
+    f64 = lambda x: jnp.asarray(x, jnp.float64)  # noqa: E731
     statics = AutoscaleStatics(
         pg_slot_start=jnp.asarray(pg_slot_start),
         pg_slot_count=jnp.asarray(pg_slot_count),
@@ -171,7 +187,8 @@ def build_autoscale_statics(
         pg_max_pods=jnp.asarray(pg_max_pods),
         pg_target_cpu=jnp.asarray(pg_target_cpu),
         pg_target_ram=jnp.asarray(pg_target_ram),
-        pg_creation=jnp.asarray(pg_creation),
+        pg_active_from=pair(pg_active_from),
+        pg_creation_s=jnp.asarray(pg_creation_s),
         pg_cpu_dur=jnp.asarray(pg_cpu_dur),
         pg_cpu_load=jnp.asarray(pg_cpu_load),
         pg_cpu_total=jnp.asarray(pg_cpu_dur.sum(axis=-1)),
@@ -191,21 +208,20 @@ def build_autoscale_statics(
         ),
         ca_slots=jnp.asarray(ca_slots),
         ca_slot_group=jnp.asarray(ca_slot_group),
-        hpa_interval=f64(config.horizontal_pod_autoscaler.scan_interval),
-        ca_interval=f64(ca_config.scan_interval),
+        hpa_interval=pair(config.horizontal_pod_autoscaler.scan_interval),
+        ca_interval=pair(ca_config.scan_interval),
         hpa_tolerance=f64(hpa_tol),
         ca_threshold=f64(ca_thresh),
-        d_hpa_register=f64(delays.as_to_hpa_network_delay),
-        d_hpa_up=f64(delays.as_to_ca_network_delay + d_pod_enqueue),
-        d_hpa_down=f64(
+        d_hpa_up=pair(delays.as_to_ca_network_delay + d_pod_enqueue),
+        d_hpa_down=pair(
             delays.as_to_ca_network_delay + delays.as_to_ps_network_delay
         ),
-        d_ca_up=f64(
+        d_ca_up=pair(
             3.0 * delays.as_to_ca_network_delay
             + 5.0 * delays.as_to_ps_network_delay
             + delays.ps_to_sched_network_delay
         ),
-        d_ca_down=f64(
+        d_ca_down=pair(
             3.0 * delays.as_to_ca_network_delay
             + 4.0 * delays.as_to_ps_network_delay
             + delays.as_to_node_network_delay
@@ -322,19 +338,23 @@ class BatchedSimulation:
             pod_req_cpu,
             pod_req_ram,
             pod_duration,
+            interval=config.scheduling_cycle_interval,
         )
         if self.autoscale_statics is not None:
             self.state = self.state._replace(
                 auto=init_autoscale_state(self.autoscale_statics)
             )
+        ev_win, ev_off = from_f64_np(ev_time, config.scheduling_cycle_interval)
         self.slab = TraceSlab(
-            time=jnp.asarray(ev_time),
+            win=jnp.asarray(ev_win),
+            off=jnp.asarray(ev_off),
             kind=jnp.asarray(ev_kind),
             slot=jnp.asarray(ev_slot),
         )
+        self._ev_time_np = ev_time  # host copy (f64) for completion checks
         self.node_names = [c.node_names + extra_names for c in compiled_traces]
         self.pod_names = [c.pod_names for c in compiled_traces]
-        self.next_window = 0.0
+        self.next_window_idx = 0
 
         self.mesh = mesh
         if mesh is not None:
@@ -377,22 +397,42 @@ class BatchedSimulation:
 
     # --- stepping -----------------------------------------------------------
 
+    @property
+    def next_window(self) -> float:
+        """Next scheduling-cycle time in seconds (windows are indexed; this is
+        the float view tests and callers use)."""
+        return self.next_window_idx * self.config.scheduling_cycle_interval
+
+    @next_window.setter
+    def next_window(self, t: float) -> None:
+        interval = self.config.scheduling_cycle_interval
+        idx = int(round(t / interval))
+        assert abs(idx * interval - t) < 1e-9 * max(1.0, abs(t)), (
+            f"next_window must be a multiple of the {interval}s cycle interval"
+        )
+        self.next_window_idx = idx
+
     def window_times(self, until_time: float) -> np.ndarray:
-        """Scheduling-cycle times in (next_window, until_time], starting at 0
+        """Scheduling-cycle times in [next_window, until_time], starting at 0
         like the scalar scheduler.start()."""
         interval = self.config.scheduling_cycle_interval
-        first = self.next_window
-        count = int(math.floor((until_time - first) / interval)) + 1
-        return first + np.arange(max(count, 0)) * interval
+        idxs = self.window_idxs(until_time)
+        return idxs.astype(np.float64) * interval
+
+    def window_idxs(self, until_time: float) -> np.ndarray:
+        interval = self.config.scheduling_cycle_interval
+        first = self.next_window_idx
+        count = int(math.floor(until_time / interval)) - first + 1
+        return first + np.arange(max(count, 0), dtype=np.int32)
 
     def step_until_time(self, until_time: float) -> None:
-        windows = self.window_times(until_time)
-        if len(windows) == 0:
+        idxs = self.window_idxs(until_time)
+        if len(idxs) == 0:
             return
         self.state = run_windows(
             self.state,
             self.slab,
-            jnp.asarray(windows, self.state.time.dtype),
+            jnp.asarray(idxs, jnp.int32),
             self.consts,
             self.max_events_per_window,
             self.max_pods_per_cycle,
@@ -403,14 +443,14 @@ class BatchedSimulation:
             self.pallas_interpret,
             self.conditional_move,
         )
-        self.next_window = float(windows[-1]) + self.config.scheduling_cycle_interval
+        self.next_window_idx = int(idxs[-1]) + 1
 
     def step_window(self) -> None:
         """Advance a single scheduling cycle (useful for tests)."""
         self.state = window_step(
             self.state,
             self.slab,
-            jnp.asarray(self.next_window, self.state.time.dtype),
+            jnp.asarray(self.next_window_idx, jnp.int32),
             self.consts,
             self.max_events_per_window,
             self.max_pods_per_cycle,
@@ -421,14 +461,14 @@ class BatchedSimulation:
             self.pallas_interpret,
             self.conditional_move,
         )
-        self.next_window += self.config.scheduling_cycle_interval
+        self.next_window_idx += 1
 
     def run_to_completion(self, max_time: float = 1e7) -> None:
         """Step until every trace pod has terminated (scalar equivalent:
         RunUntilAllPodsAreFinishedCallbacks), bounded by max_time."""
         interval = self.config.scheduling_cycle_interval
         chunk = max(64, self.max_events_per_window)
-        finite = self.slab.time[jnp.isfinite(self.slab.time)]
+        finite = self._ev_time_np[np.isfinite(self._ev_time_np)]
         last_event_time = float(finite.max()) if finite.size else 0.0
         while True:
             self.step_until_time(self.next_window + chunk * interval)
@@ -437,11 +477,11 @@ class BatchedSimulation:
             if self.next_window <= last_event_time:
                 continue
             phases = np.asarray(self.state.pods.phase)
-            durations = np.asarray(self.state.pods.duration)
+            service = np.asarray(self.state.pods.duration.win) < 0
             # Finite-duration pods not yet terminal?
             live = (
                 ((phases == PHASE_QUEUED) | (phases == PHASE_UNSCHEDULABLE))
-                | ((phases == PHASE_RUNNING) & (durations >= 0))
+                | ((phases == PHASE_RUNNING) & ~service)
             )
             if not live.any():
                 return
@@ -520,7 +560,13 @@ class BatchedSimulation:
         """Name-keyed pod states for equivalence tests against the scalar path."""
         phases = np.asarray(self.state.pods.phase[cluster])
         nodes = np.asarray(self.state.pods.node[cluster])
-        starts = np.asarray(self.state.pods.start_time[cluster])
+        start_pair = self.state.pods.start_time
+        starts = to_f64(
+            type(start_pair)(
+                win=start_pair.win[cluster], off=start_pair.off[cluster]
+            ),
+            self.config.scheduling_cycle_interval,
+        )
         names = self.pod_names[cluster]
         node_names = self.node_names[cluster]
         out = {}

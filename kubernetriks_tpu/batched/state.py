@@ -14,6 +14,11 @@ Design rules:
 - cpu is int32 millicores; ram is quantized to RAM_UNIT-byte units (ceil for
   requests, floor for capacity) so int32 never overflows and the batched path
   never overcommits relative to the byte-exact scalar path.
+- Simulation time is the (win:int32, off:float32) window-indexed pair of
+  batched/timerep.py: exact integer window classification plus a bounded
+  float32 offset (ulp ≈ 1e-6 s at the default 10 s interval, three orders of
+  magnitude under the smallest modeled delay) — full fidelity at
+  Alibaba-scale timestamps without any 64-bit array in the hot loop.
 """
 
 from __future__ import annotations
@@ -23,19 +28,23 @@ from typing import NamedTuple, Optional
 import jax
 
 # NOTE: importing this module enables jax_enable_x64 PROCESS-WIDE (a hard
-# requirement of the whole batched subsystem, not an accident).
-# Simulation time is float64 end to end: at Alibaba-scale timestamps (~7e5 s)
-# float32 resolution (~0.06 s) is coarser than the modeled control-plane
-# delays (0.023-0.152 s, reference: src/config.yaml:73-78), so f32 delay
-# composition silently diverges from the scalar f64 oracle. XLA emulates f64
-# on TPU; only the time-like arrays pay for it — the (C, N)/(C, K) fit/score
-# work stays int32/float32.
+# requirement of the batched subsystem, not an accident). The hot loop is
+# all-32-bit by design (timerep.py pairs), but two cold spots still want
+# 64-bit types: the HPA load-curve lookup evaluates elapsed time in f64
+# (tiny (C, G)-shaped elementwise math), and the conditional-move wake
+# budgets accumulate in i64 (unbounded in the scalar oracle). Tests also
+# compare device output against the float64 scalar oracle.
 jax.config.update("jax_enable_x64", True)
 
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 
-TIME_DTYPE = jnp.float64
+from kubernetriks_tpu.batched.timerep import (  # noqa: E402
+    TPair,
+    from_f64_np,
+    t_inf,
+    t_zeros,
+)
 
 # Pod phases.
 PHASE_EMPTY = 0  # slot not yet created
@@ -67,8 +76,8 @@ class NodeArrays(NamedTuple):
     alloc_cpu: jnp.ndarray  # int32
     alloc_ram: jnp.ndarray  # int32
     # Pending on-device effects (cluster-autoscaler actions); +inf = none.
-    create_time: jnp.ndarray  # TIME_DTYPE
-    remove_time: jnp.ndarray  # TIME_DTYPE
+    create_time: TPair
+    remove_time: TPair
 
 
 class PodArrays(NamedTuple):
@@ -77,15 +86,17 @@ class PodArrays(NamedTuple):
     phase: jnp.ndarray  # int32
     req_cpu: jnp.ndarray  # int32 millicores
     req_ram: jnp.ndarray  # int32 ram units
-    duration: jnp.ndarray  # TIME_DTYPE seconds; <0 means long-running service
-    queue_ts: jnp.ndarray  # TIME_DTYPE: queue-priority / eligibility timestamp
+    # Static running duration as a time pair; win < 0 marks a long-running
+    # service (the scalar path's running_duration=None).
+    duration: TPair
+    queue_ts: TPair  # queue-priority / eligibility timestamp
     queue_seq: jnp.ndarray  # int32: FIFO tie-break within equal timestamps
-    initial_attempt_ts: jnp.ndarray  # TIME_DTYPE
+    initial_attempt_ts: TPair
     attempts: jnp.ndarray  # int32
     node: jnp.ndarray  # int32 node slot, -1 = none
-    start_time: jnp.ndarray  # TIME_DTYPE
-    finish_time: jnp.ndarray  # TIME_DTYPE, +inf = no pending finish
-    removal_time: jnp.ndarray  # TIME_DTYPE pending HPA scale-down effect; +inf = none
+    start_time: TPair
+    finish_time: TPair  # +inf = no pending finish
+    removal_time: TPair  # pending HPA scale-down effect; +inf = none
 
 
 class EstArrays(NamedTuple):
@@ -140,10 +151,10 @@ class ClusterBatchState(NamedTuple):
     """Complete batched simulation state; a pytree of arrays with leading
     cluster axis C, shardable across a device mesh on that axis."""
 
-    time: jnp.ndarray  # (C,) TIME_DTYPE current simulation time
+    time: jnp.ndarray  # (C,) int32 last completed window index
     queue_seq_counter: jnp.ndarray  # (C,) int32 next queue sequence number
     event_cursor: jnp.ndarray  # (C,) int32 next unapplied trace event
-    last_flush_time: jnp.ndarray  # (C,) TIME_DTYPE last unschedulable-leftover flush
+    last_flush_win: jnp.ndarray  # (C,) int32 last unschedulable-leftover flush window
     requeue_signal: jnp.ndarray  # (C,) bool: node-add/pod-finish since last cycle
     # Conditional-move accounting (enable_unscheduled_pods_conditional_move,
     # reference: src/core/scheduler/scheduler.rs:391-409,366-380): per-window
@@ -163,9 +174,10 @@ class ClusterBatchState(NamedTuple):
 
 class TraceSlab(NamedTuple):
     """(C, E) compiled trace events, time-sorted per cluster, padded with
-    EV_NONE/time=+inf."""
+    EV_NONE/time=+inf (win=INF_WIN)."""
 
-    time: jnp.ndarray  # TIME_DTYPE
+    win: jnp.ndarray  # int32 window index of the event's effect time
+    off: jnp.ndarray  # float32 offset within the window
     kind: jnp.ndarray  # int32
     slot: jnp.ndarray  # int32 (node slot for node events, pod slot for pod events)
 
@@ -214,32 +226,41 @@ def init_state(
     pod_req_cpu: np.ndarray,
     pod_req_ram: np.ndarray,
     pod_duration: np.ndarray,
+    interval: float,
 ) -> ClusterBatchState:
     """Build the initial state with pre-staged payloads (all slots start
-    EMPTY/dead; trace events bring them to life)."""
+    EMPTY/dead; trace events bring them to life). pod_duration: float64
+    seconds, <0 marks a long-running service."""
     C, N, P = n_clusters, n_nodes, n_pods
+    dur = np.asarray(pod_duration, np.float64)
+    service = dur < 0
+    dwin, doff = from_f64_np(np.where(service, 0.0, dur), interval)
+    duration = TPair(
+        win=jnp.asarray(np.where(service, -1, dwin), jnp.int32),
+        off=jnp.asarray(np.where(service, 0.0, doff), jnp.float32),
+    )
     nodes = NodeArrays(
         alive=jnp.zeros((C, N), bool),
         cap_cpu=jnp.asarray(node_cap_cpu, jnp.int32),
         cap_ram=jnp.asarray(node_cap_ram, jnp.int32),
         alloc_cpu=jnp.asarray(node_cap_cpu, jnp.int32),
         alloc_ram=jnp.asarray(node_cap_ram, jnp.int32),
-        create_time=jnp.full((C, N), INF, TIME_DTYPE),
-        remove_time=jnp.full((C, N), INF, TIME_DTYPE),
+        create_time=t_inf((C, N)),
+        remove_time=t_inf((C, N)),
     )
     pods = PodArrays(
         phase=jnp.zeros((C, P), jnp.int32),
         req_cpu=jnp.asarray(pod_req_cpu, jnp.int32),
         req_ram=jnp.asarray(pod_req_ram, jnp.int32),
-        duration=jnp.asarray(pod_duration, TIME_DTYPE),
-        queue_ts=jnp.zeros((C, P), TIME_DTYPE),
+        duration=duration,
+        queue_ts=t_zeros((C, P)),
         queue_seq=jnp.zeros((C, P), jnp.int32),
-        initial_attempt_ts=jnp.zeros((C, P), TIME_DTYPE),
+        initial_attempt_ts=t_zeros((C, P)),
         attempts=jnp.zeros((C, P), jnp.int32),
         node=jnp.full((C, P), -1, jnp.int32),
-        start_time=jnp.zeros((C, P), TIME_DTYPE),
-        finish_time=jnp.full((C, P), INF, TIME_DTYPE),
-        removal_time=jnp.full((C, P), INF, TIME_DTYPE),
+        start_time=t_zeros((C, P)),
+        finish_time=t_inf((C, P)),
+        removal_time=t_inf((C, P)),
     )
     metrics = MetricArrays(
         pods_succeeded=jnp.zeros((C,), jnp.int32),
@@ -256,10 +277,10 @@ def init_state(
         pod_duration=EstArrays.zeros((C,)),
     )
     return ClusterBatchState(
-        time=jnp.zeros((C,), TIME_DTYPE),
+        time=jnp.zeros((C,), jnp.int32),
         queue_seq_counter=jnp.zeros((C,), jnp.int32),
         event_cursor=jnp.zeros((C,), jnp.int32),
-        last_flush_time=jnp.zeros((C,), TIME_DTYPE),
+        last_flush_win=jnp.zeros((C,), jnp.int32),
         requeue_signal=jnp.zeros((C,), bool),
         wake_node_signal=jnp.zeros((C,), bool),
         wake_node_cpu=jnp.zeros((C,), jnp.int64),

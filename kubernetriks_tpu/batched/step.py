@@ -20,6 +20,13 @@ one event at a time) with array programs:
   LeastAllocatedResources score + last-wins argmax, reference semantics:
   src/core/scheduler/kube_scheduler.rs:63-152, plugin.rs:33-63), and results
   scatter back to (C, P) once.
+
+Time is the 32-bit (win, off) pair of timerep.py. Each step runs at window
+index W (cycle time T = W * interval); all event/effect times applied in the
+window are carried as float32 seconds RELATIVE to the previous window's start
+((W-1) * interval) — bounded values whose scatter/gather/sort stay on the
+TPU's fast 32-bit paths — and are renormalized to pairs only when written
+back to persistent state.
 """
 
 from __future__ import annotations
@@ -45,21 +52,46 @@ from kubernetriks_tpu.batched.state import (
     StepConstants,
     TraceSlab,
 )
+from kubernetriks_tpu.batched.timerep import (
+    TPair,
+    t_add,
+    t_inf,
+    t_le,
+    t_lt,
+    t_norm,
+    t_where,
+)
 
 INF = jnp.inf
+
+
+def t_seconds_f32(a: TPair, interval) -> jnp.ndarray:
+    """Pair -> float32 seconds (for metric values and bounded spans)."""
+    return a.win.astype(jnp.float32) * jnp.float32(interval) + a.off
 
 
 def lexsort_i32(primary: jnp.ndarray, secondary: jnp.ndarray) -> jnp.ndarray:
     """Row-wise stable argsort by (primary, secondary) returning int32 indices.
 
-    Equivalent to jnp.lexsort((secondary, primary), axis=1), but carries an
-    int32 iota payload — under jax_enable_x64, jnp.lexsort's internal index
-    iota is i64, which drags an emulated 64-bit lane through every (C, P)
-    queue sort in the hot loop."""
+    Like jnp.lexsort but carries an int32 iota payload — under
+    jax_enable_x64, jnp.lexsort's internal index iota is i64, which drags an
+    emulated 64-bit lane through every (C, P) queue sort in the hot loop."""
     C, P = primary.shape
     iota = jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32)[None, :], (C, P))
     _, _, order = jax.lax.sort(
         (primary, secondary, iota), dimension=1, num_keys=2, is_stable=True
+    )
+    return order
+
+
+def lexsort_time_i32(t: TPair, seq: jnp.ndarray) -> jnp.ndarray:
+    """Row-wise stable argsort by (time pair, seq) -> int32 indices: the
+    batched ActiveQueue ordering ((timestamp, insertion seq) min-heap,
+    reference: src/core/scheduler/queue.rs:13-75)."""
+    C, P = seq.shape
+    iota = jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32)[None, :], (C, P))
+    _, _, _, order = jax.lax.sort(
+        (t.win, t.off, seq, iota), dimension=1, num_keys=3, is_stable=True
     )
     return order
 
@@ -77,41 +109,56 @@ def _est_add_reduced(est: EstArrays, values: jnp.ndarray, mask: jnp.ndarray) -> 
     )
 
 
+def _rel_seconds(t: TPair, base_win: jnp.ndarray, interval) -> jnp.ndarray:
+    """Pair -> float32 seconds relative to base_win * interval. Exact (zero
+    multiplier) for times inside the base window — the common case for
+    this window's events/effects — and correctly ordered for earlier ones."""
+    return (t.win - base_win).astype(jnp.float32) * jnp.float32(interval) + t.off
+
+
 def _apply_window_events(
     state: ClusterBatchState,
     slab: TraceSlab,
-    window_end: jnp.ndarray,
+    W: jnp.ndarray,
     consts: StepConstants,
     max_events_per_window: int,
     conditional_move: bool = False,
 ) -> ClusterBatchState:
-    """Apply every trace event with effect time STRICTLY before window_end, and
-    resolve all pod finishes due in the window.
+    """Apply every trace event with effect time STRICTLY before the cycle time
+    W * interval, and resolve all pod finishes due in the window.
 
     Strictness: an effect landing exactly at cycle time T is processed after
     the cycle in the scalar kernel (older-event-id-first FIFO), so it belongs
-    to the next window.
+    to the next window. With pair times that check is exact: effect applied
+    iff its window index < W.
 
-    Dtype note (applies to this whole module): jax_enable_x64 is on for the
-    f64 time arrays, so every index/count op must pin an explicit 32-bit dtype
-    — untyped arange/argmax/bool-sum default to i64 under x64, and stray i64
+    Dtype note (applies to this whole module): jax_enable_x64 is on (see
+    state.py), so every index/count op must pin an explicit 32-bit dtype —
+    untyped arange/argmax/bool-sum default to i64 under x64, and stray i64
     lanes measurably slow the TPU hot loop (emulated 64-bit).
     """
     pods, nodes, metrics = state.pods, state.nodes, state.metrics
     C, P = pods.phase.shape
     N = nodes.alive.shape[1]
-    E_total = slab.time.shape[1]
+    E_total = slab.win.shape[1]
     E = max_events_per_window
+    interval = jnp.float32(consts.scheduling_interval)
     rows1 = jnp.arange(C, dtype=jnp.int32)
     rows = rows1[:, None]
+    base = W - 1  # (C,) the window the applied events fall in
+    f32inf = jnp.float32(INF)
 
     # Gather this window's slab segment: (C, E) starting at each cursor.
     offs = state.event_cursor[:, None] + jnp.arange(E, dtype=jnp.int32)[None, :]
     offs_c = jnp.clip(offs, 0, E_total - 1)
-    ev_t = slab.time[rows, offs_c]
+    ev_win = slab.win[rows, offs_c]
+    ev_off = slab.off[rows, offs_c]
     ev_k = slab.kind[rows, offs_c]
     ev_s = slab.slot[rows, offs_c]
-    valid = (offs < E_total) & (ev_t < window_end[:, None])
+    valid = (offs < E_total) & (ev_win < W[:, None])
+    # Event time in f32 seconds relative to base (== ev_off when the event is
+    # in this window, which consecutive window stepping guarantees).
+    ev_rel = (ev_win - base[:, None]).astype(jnp.float32) * interval + ev_off
 
     is_cn = valid & (ev_k == EV_CREATE_NODE)
     is_rn = valid & (ev_k == EV_REMOVE_NODE)
@@ -127,26 +174,27 @@ def _apply_window_events(
         jnp.zeros((C, N), bool).at[rows, drop_slot(is_cn, N)].set(True, mode="drop")
     )
     # Pending autoscaler creations due this window (CA scale-up effects).
-    pend_create = (nodes.create_time < window_end[:, None]) & ~nodes.alive
+    pend_create = (nodes.create_time.win < W[:, None]) & ~nodes.alive
     created = created | pend_create
-    node_create_time = jnp.where(pend_create, INF, nodes.create_time)
-    # --- node removal times (scatter-min; +inf = not removed this window) ---
+    node_create_time = t_where(pend_create, t_inf((C, N)), nodes.create_time)
+    # --- node removal times, f32 rel-seconds (+inf = not removed this window)
     node_removal = (
-        jnp.full((C, N), INF)
+        jnp.full((C, N), INF, jnp.float32)
         .at[rows, drop_slot(is_rn, N)]
-        .min(jnp.where(is_rn, ev_t, INF), mode="drop")
+        .min(jnp.where(is_rn, ev_rel, f32inf), mode="drop")
     )
     # Pending autoscaler removals due this window (CA scale-down effects).
+    pend_rm_due = nodes.remove_time.win < W[:, None]
     pend_remove = jnp.where(
-        nodes.remove_time < window_end[:, None], nodes.remove_time, INF
+        pend_rm_due, _rel_seconds(nodes.remove_time, base[:, None], interval), f32inf
     )
     node_removal = jnp.minimum(node_removal, pend_remove)
-    node_remove_time = jnp.where(pend_remove < INF, INF, nodes.remove_time)
+    node_remove_time = t_where(pend_rm_due, t_inf((C, N)), nodes.remove_time)
     # --- pod creations ------------------------------------------------------
-    pod_create_ts = (
-        jnp.full((C, P), INF)
+    pod_create = (
+        jnp.full((C, P), INF, jnp.float32)
         .at[rows, drop_slot(is_cp, P)]
-        .min(jnp.where(is_cp, ev_t, INF), mode="drop")
+        .min(jnp.where(is_cp, ev_rel, f32inf), mode="drop")
     )
     # Queue sequence numbers follow slab (== emission) order.
     create_rank = jnp.cumsum(is_cp, axis=1, dtype=jnp.int32) - 1
@@ -161,28 +209,34 @@ def _apply_window_events(
     n_creates = is_cp.sum(axis=1, dtype=jnp.int32)
     # --- pod removal times --------------------------------------------------
     pod_removal = (
-        jnp.full((C, P), INF)
+        jnp.full((C, P), INF, jnp.float32)
         .at[rows, drop_slot(is_rp, P)]
-        .min(jnp.where(is_rp, ev_t, INF), mode="drop")
+        .min(jnp.where(is_rp, ev_rel, f32inf), mode="drop")
     )
     # Pending HPA scale-down removals due this window.
+    pend_prm_due = pods.removal_time.win < W[:, None]
     pend_pod_removal = jnp.where(
-        pods.removal_time < window_end[:, None], pods.removal_time, INF
+        pend_prm_due, _rel_seconds(pods.removal_time, base[:, None], interval), f32inf
     )
     pod_removal = jnp.minimum(pod_removal, pend_pod_removal)
-    pod_removal_time = jnp.where(pend_pod_removal < INF, INF, pods.removal_time)
+    pod_removal_time = t_where(pend_prm_due, t_inf((C, P)), pods.removal_time)
 
     # --- apply creations ----------------------------------------------------
     alive = nodes.alive | created
     alloc_cpu = jnp.where(created, nodes.cap_cpu, nodes.alloc_cpu)
     alloc_ram = jnp.where(created, nodes.cap_ram, nodes.alloc_ram)
 
-    was_empty_created = (pods.phase == 0) & (pod_create_ts < INF)
-    enqueue_ts = pod_create_ts + consts.delta_pod_enqueue
+    was_empty_created = (pods.phase == 0) & (pod_create < f32inf)
+    enqueue_ts = t_norm(
+        jnp.broadcast_to(base[:, None], (C, P)),
+        jnp.where(was_empty_created, pod_create, 0.0)
+        + jnp.float32(consts.delta_pod_enqueue),
+        interval,
+    )
     phase = jnp.where(was_empty_created, PHASE_QUEUED, pods.phase)
-    queue_ts = jnp.where(was_empty_created, enqueue_ts, pods.queue_ts)
+    queue_ts = t_where(was_empty_created, enqueue_ts, pods.queue_ts)
     queue_seq = jnp.where(was_empty_created, pod_create_seq, pods.queue_seq)
-    initial_attempt_ts = jnp.where(
+    initial_attempt_ts = t_where(
         was_empty_created, enqueue_ts, pods.initial_attempt_ts
     )
     attempts = jnp.where(was_empty_created, 1, pods.attempts)
@@ -191,15 +245,23 @@ def _apply_window_events(
     running = phase == PHASE_RUNNING
     node_idx = jnp.clip(pods.node, 0, None)
     pod_node_removal = jnp.where(
-        pods.node >= 0, node_removal[rows, node_idx], INF
+        pods.node >= 0, node_removal[rows, node_idx], f32inf
     )
-    cutoff = jnp.minimum(
-        jnp.minimum(window_end[:, None], pod_node_removal), pod_removal
+    # Earliest interruption of this pod in rel-seconds; +inf = none.
+    interrupt = jnp.minimum(pod_node_removal, pod_removal)
+    has_interrupt = interrupt < f32inf
+    # cutoff = min(window_end, interruption): window_end is the pair (W, 0),
+    # an interruption the pair (base, interrupt); compare the pod's finish
+    # pair against whichever applies.
+    cut = t_norm(
+        jnp.where(has_interrupt, base[:, None], W[:, None]),
+        jnp.where(has_interrupt, interrupt, 0.0),
+        interval,
     )
-    finishes = running & (pods.finish_time <= cutoff)
-    interrupted = running & ~finishes
+    finishes = running & t_le(pods.finish_time, cut)
+    interrupted = running & ~finishes & has_interrupt
     rescheds = interrupted & (pod_node_removal < pod_removal)
-    removed_running = interrupted & (pod_removal <= pod_node_removal) & (pod_removal < INF)
+    removed_running = interrupted & (pod_removal <= pod_node_removal)
 
     # Free resources of finished and removed-while-running pods (a dead node's
     # allocatable is irrelevant; slots are never reused).
@@ -209,28 +271,34 @@ def _apply_window_events(
 
     # Finished pods.
     n_done = finishes.sum(axis=1, dtype=jnp.int32)
+    duration_s = t_seconds_f32(pods.duration, interval)
     metrics = metrics._replace(
         pods_succeeded=metrics.pods_succeeded + n_done,
         terminated_pods=metrics.terminated_pods + n_done,
-        pod_duration=_est_add_reduced(metrics.pod_duration, pods.duration, finishes),
+        pod_duration=_est_add_reduced(metrics.pod_duration, duration_s, finishes),
         processed_nodes=metrics.processed_nodes + created.sum(axis=1, dtype=jnp.int32),
     )
     phase = jnp.where(finishes, PHASE_SUCCEEDED, phase)
-    finish_time = jnp.where(finishes, INF, pods.finish_time)
+    finish_time = t_where(finishes, t_inf((C, P)), pods.finish_time)
 
     # Reschedule pods of removed nodes (reference: scheduler.rs:336-364; slot
     # order stands in for the scalar sorted-name order).
     resched_rank = jnp.cumsum(rescheds, axis=1, dtype=jnp.int32) - 1
-    resched_ts = pod_node_removal + consts.delta_reschedule
+    resched_ts = t_norm(
+        jnp.broadcast_to(base[:, None], (C, P)),
+        jnp.where(rescheds, pod_node_removal, 0.0)
+        + jnp.float32(consts.delta_reschedule),
+        interval,
+    )
     phase = jnp.where(rescheds, PHASE_QUEUED, phase)
-    queue_ts = jnp.where(rescheds, resched_ts, queue_ts)
+    queue_ts = t_where(rescheds, resched_ts, queue_ts)
     queue_seq = jnp.where(
         rescheds, state.queue_seq_counter[:, None] + n_creates[:, None] + resched_rank,
         queue_seq,
     )
-    initial_attempt_ts = jnp.where(rescheds, resched_ts, initial_attempt_ts)
+    initial_attempt_ts = t_where(rescheds, resched_ts, initial_attempt_ts)
     attempts = jnp.where(rescheds, 1, attempts)
-    finish_time = jnp.where(rescheds, INF, finish_time)
+    finish_time = t_where(rescheds, t_inf((C, P)), finish_time)
     pod_node = jnp.where(rescheds, -1, pods.node)
     n_rescheds = rescheds.sum(axis=1, dtype=jnp.int32)
 
@@ -242,21 +310,21 @@ def _apply_window_events(
         terminated_pods=metrics.terminated_pods + n_removed_running,
     )
     phase = jnp.where(removed_running, PHASE_REMOVED, phase)
-    finish_time = jnp.where(removed_running, INF, finish_time)
+    finish_time = t_where(removed_running, t_inf((C, P)), finish_time)
 
     # Removal of queued/unschedulable (or just-created) pods: dropped from the
     # queues with NO removed/terminated metrics (scalar parity: only
     # PodRemovedFromNode(removed=true) counts, reference: api_server.rs:345-368).
     removed_queued = (
         ((phase == PHASE_QUEUED) | (phase == PHASE_UNSCHEDULABLE))
-        & (pod_removal < INF)
+        & (pod_removal < f32inf)
         & ~removed_running
     )
     phase = jnp.where(removed_queued, PHASE_REMOVED, phase)
 
     # Kill removed nodes AFTER pod resolution (resolution reads pre-window
     # alive only via pods.node indices, which is removal-independent).
-    alive = alive & ~(node_removal < INF)
+    alive = alive & ~(node_removal < f32inf)
 
     applied = valid.sum(axis=1, dtype=jnp.int32)
     any_created_node = created.any(axis=1)
@@ -313,7 +381,7 @@ def _apply_window_events(
         wake_freed_signal=state.wake_freed_signal | any_freed,
         wake_freed_cpu=state.wake_freed_cpu + wake_freed_cpu,
         wake_freed_ram=state.wake_freed_ram + wake_freed_ram,
-        time=jnp.maximum(state.time, window_end),
+        time=jnp.maximum(state.time, W),
     )
 
 
@@ -341,9 +409,9 @@ def _conditional_wake(
     rows = jnp.arange(C, dtype=jnp.int32)[:, None]
     unsched = (pods.phase == PHASE_UNSCHEDULABLE) & ~stale
 
-    u_ts = jnp.where(unsched, pods.queue_ts, INF)
+    u_t = t_where(unsched, pods.queue_ts, t_inf((C, P)))
     u_seq = jnp.where(unsched, pods.queue_seq, jnp.iinfo(jnp.int32).max)
-    order = lexsort_i32(u_ts, u_seq)  # (C, P) unschedulable first
+    order = lexsort_time_i32(u_t, u_seq)  # (C, P) unschedulable first
     o_valid = unsched[rows, order]
     o_req_cpu = pods.req_cpu[rows, order]
     o_req_ram = pods.req_ram[rows, order]
@@ -383,43 +451,41 @@ class CycleCandidates(NamedTuple):
     a pytree, so it composes with jit/scan like the rest of the state."""
 
     pods: "object"  # PodArrays with wake/flush moves applied
-    last_flush_time: jnp.ndarray
+    last_flush_win: jnp.ndarray
     cand: jnp.ndarray  # (C, K) pod slots in queue order
     valid: jnp.ndarray  # (C, K)
     req_cpu: jnp.ndarray
     req_ram: jnp.ndarray
-    duration: jnp.ndarray
-    initial_ts: jnp.ndarray
+    # (C, K) float32 queue wait at cycle start: T - initial_attempt_ts.
+    waited: jnp.ndarray
 
 
 def decision_mechanics(
     metrics,
     valid,
     assign,
-    duration,
-    T,
+    waited,
     cycle_dur,
-    pod_queue_time,
     pod_sched_time,
     consts: StepConstants,
 ):
     """The per-pod timing/metric mechanics shared BIT-FOR-BIT by the lax.scan
     path, the Pallas path's mech scan, and the RL path: cycle-duration
-    accumulation, start/finish/park timestamps, decision metrics. Keeping this
-    in exactly one place is what guarantees scan/Pallas float-op parity."""
-    time_dtype = T.dtype
+    accumulation, start/park offsets (float32 seconds relative to the cycle
+    time T), decision metrics. Keeping this in exactly one place is what
+    guarantees scan/Pallas float-op parity."""
+    pod_queue_time = waited + cycle_dur
     cycle_dur_post = cycle_dur + jnp.where(valid, pod_sched_time, 0.0)
-    start = (T + cycle_dur_post + consts.delta_bind_start).astype(time_dtype)
-    finish = jnp.where(duration >= 0, start + duration, INF).astype(time_dtype)
+    start_s = cycle_dur_post + jnp.float32(consts.delta_bind_start)
     # Unschedulable park: new insert timestamp = T + cycle duration
     # (reference: scheduler.rs:282-306).
-    park_ts = (T + cycle_dur_post).astype(time_dtype)
+    park_s = cycle_dur_post
     metrics = metrics._replace(
         scheduling_decisions=metrics.scheduling_decisions + assign.astype(jnp.int32),
         queue_time=metrics.queue_time.add(pod_queue_time, assign),
         algo_latency=metrics.algo_latency.add(pod_sched_time, assign),
     )
-    return metrics, start, finish, park_ts, cycle_dur_post
+    return metrics, start_s, park_s, cycle_dur_post, pod_queue_time
 
 
 def apply_decision(
@@ -431,17 +497,15 @@ def apply_decision(
     action,
     req_cpu,
     req_ram,
-    duration,
-    T,
+    waited,
     cycle_dur,
-    pod_queue_time,
     pod_sched_time,
     consts: StepConstants,
 ):
     """Decision-independent cycle mechanics shared by the kube and RL paths:
-    commit one chosen node per cluster (resource reservation, start/finish
-    computation, park timestamps, metric accounting). `action` is the chosen
-    node slot; `any_fit` gates assignment vs unschedulable park."""
+    commit one chosen node per cluster (resource reservation, start/park
+    offset computation, metric accounting). `action` is the chosen node slot;
+    `any_fit` gates assignment vs unschedulable park."""
     C = valid.shape[0]
     rows1 = jnp.arange(C, dtype=jnp.int32)
 
@@ -452,31 +516,48 @@ def apply_decision(
     alloc_cpu = alloc_cpu.at[rows1, action_c].add(jnp.where(assign, -req_cpu, 0))
     alloc_ram = alloc_ram.at[rows1, action_c].add(jnp.where(assign, -req_ram, 0))
 
-    metrics, start, finish, park_ts, cycle_dur_post = decision_mechanics(
-        metrics, valid, assign, duration, T, cycle_dur,
-        pod_queue_time, pod_sched_time, consts,
+    metrics, start_s, park_s, cycle_dur_post, pod_queue_time = decision_mechanics(
+        metrics, valid, assign, waited, cycle_dur, pod_sched_time, consts
     )
-    return alloc_cpu, alloc_ram, metrics, assign, park, start, finish, park_ts, cycle_dur_post
+    return (
+        alloc_cpu, alloc_ram, metrics, assign, park,
+        start_s, park_s, cycle_dur_post, pod_queue_time,
+    )
 
 
 def prepare_cycle(
     state: ClusterBatchState,
-    T: jnp.ndarray,
+    W: jnp.ndarray,
     consts: StepConstants,
     K: int,
     conditional_move: bool = False,
 ) -> CycleCandidates:
     """Cycle preamble shared by the kube-scheduler and RL-policy cycles:
-    unschedulable wake/flush moves, queue sort, top-K compaction."""
-    rows = jnp.arange(state.pods.phase.shape[0], dtype=jnp.int32)[:, None]
+    unschedulable wake/flush moves, queue sort, top-K compaction. W: (C,)
+    int32 window index (cycle time T = W * interval)."""
+    C, P = state.pods.phase.shape
+    rows = jnp.arange(C, dtype=jnp.int32)[:, None]
     pods = state.pods
+    interval = jnp.float32(consts.scheduling_interval)
+    Tpair = TPair(
+        win=jnp.broadcast_to(W[:, None], (C, P)),
+        off=jnp.zeros((C, P), jnp.float32),
+    )
 
     # Unschedulable-leftover flush at the 30 s cadence
     # (reference: scheduler.rs:188-203).
-    flush_now = (T - state.last_flush_time) >= consts.flush_interval
+    flush_now = (W - state.last_flush_win).astype(jnp.float32) * interval >= jnp.float32(
+        consts.flush_interval
+    )
+    # Stale: T - queue_ts > max_stay, i.e. queue_ts + max_stay < T.
+    stay_cut = t_norm(
+        pods.queue_ts.win,
+        pods.queue_ts.off + jnp.float32(consts.max_unschedulable_stay),
+        interval,
+    )
     stale = (
         (pods.phase == PHASE_UNSCHEDULABLE)
-        & (T[:, None] - pods.queue_ts > consts.max_unschedulable_stay)
+        & t_lt(stay_cut, Tpair)
         & flush_now[:, None]
     )
     if conditional_move:
@@ -488,46 +569,57 @@ def prepare_cycle(
         phase=jnp.where(to_move, PHASE_QUEUED, pods.phase),
         attempts=pods.attempts + to_move.astype(jnp.int32),
     )
-    last_flush_time = jnp.where(flush_now, T, state.last_flush_time)
+    last_flush_win = jnp.where(flush_now, W, state.last_flush_win)
 
-    # Queue order: (queue_ts, queue_seq); eligible = queued strictly before T.
-    eligible = (pods.phase == PHASE_QUEUED) & (pods.queue_ts < T[:, None])
-    sort_ts = jnp.where(eligible, pods.queue_ts, INF)
+    # Queue order: (queue_ts, queue_seq); eligible = queued strictly before T
+    # — with pair times that is exactly queue_ts.win < W.
+    eligible = (pods.phase == PHASE_QUEUED) & (pods.queue_ts.win < W[:, None])
+    sort_t = t_where(eligible, pods.queue_ts, t_inf((C, P)))
     sort_seq = jnp.where(eligible, pods.queue_seq, jnp.iinfo(jnp.int32).max)
-    order = lexsort_i32(sort_ts, sort_seq)  # (C, P)
+    order = lexsort_time_i32(sort_t, sort_seq)  # (C, P)
 
     cand = order[:, :K]
+    cand_valid = eligible[rows, cand]
+    init_win = pods.initial_attempt_ts.win[rows, cand]
+    init_off = pods.initial_attempt_ts.off[rows, cand]
+    waited = (W[:, None] - init_win).astype(jnp.float32) * interval - init_off
     return CycleCandidates(
         pods=pods,
-        last_flush_time=last_flush_time,
+        last_flush_win=last_flush_win,
         cand=cand,
-        valid=eligible[rows, cand],
+        valid=cand_valid,
         req_cpu=pods.req_cpu[rows, cand],
         req_ram=pods.req_ram[rows, cand],
-        duration=pods.duration[rows, cand],
-        initial_ts=pods.initial_attempt_ts[rows, cand],
+        waited=waited,
     )
 
 
 def commit_cycle(
     state: ClusterBatchState,
     cc: CycleCandidates,
-    T: jnp.ndarray,
+    W: jnp.ndarray,
+    consts: StepConstants,
     alloc_cpu,
     alloc_ram,
     metrics,
     assign_k,
     park_k,
     best_k,
-    start_k,
-    finish_k,
-    park_ts_k,
+    start_s_k,
+    park_s_k,
 ) -> ClusterBatchState:
-    """Scatter the K per-cluster decisions back into (C, P) state."""
+    """Scatter the K per-cluster decisions back into (C, P) state.
+
+    start_s_k / park_s_k are float32 second offsets relative to the cycle
+    time T = W * interval; the absolute start/finish/park pairs are
+    reconstructed elementwise after two cheap float32 scatters (64-bit value
+    scatters are the slow path on TPU)."""
     C, P = cc.pods.phase.shape
     rows = jnp.arange(C, dtype=jnp.int32)[:, None]
     pods = cc.pods
     cand = cc.cand
+    interval = jnp.float32(consts.scheduling_interval)
+    f32inf = jnp.float32(INF)
 
     new_phase = jnp.where(
         assign_k,
@@ -541,15 +633,38 @@ def commit_cycle(
     node = pods.node.at[rows, jnp.where(assign_k, cand, P)].set(
         jnp.where(assign_k, best_k, 0), mode="drop"
     )
-    start_time = pods.start_time.at[rows, jnp.where(assign_k, cand, P)].set(
-        jnp.where(assign_k, start_k, 0.0), mode="drop"
+    start_tmp = (
+        jnp.full((C, P), INF, jnp.float32)
+        .at[rows, jnp.where(assign_k, cand, P)]
+        .set(jnp.where(assign_k, start_s_k, f32inf), mode="drop")
     )
-    finish_time = pods.finish_time.at[rows, jnp.where(assign_k, cand, P)].set(
-        jnp.where(assign_k, finish_k, 0.0), mode="drop"
+    park_tmp = (
+        jnp.full((C, P), INF, jnp.float32)
+        .at[rows, jnp.where(park_k, cand, P)]
+        .set(jnp.where(park_k, park_s_k, f32inf), mode="drop")
     )
-    queue_ts = pods.queue_ts.at[rows, jnp.where(park_k, cand, P)].set(
-        jnp.where(park_k, park_ts_k, 0.0), mode="drop"
+
+    started = start_tmp < f32inf
+    start_pair = t_norm(
+        jnp.broadcast_to(W[:, None], (C, P)),
+        jnp.where(started, start_tmp, 0.0),
+        interval,
     )
+    service = pods.duration.win < 0
+    finish_pair = t_add(start_pair, pods.duration, interval)
+    start_time = t_where(started, start_pair, pods.start_time)
+    finish_time = t_where(
+        started,
+        t_where(service, t_inf((C, P)), finish_pair),
+        pods.finish_time,
+    )
+    parked = park_tmp < f32inf
+    park_pair = t_norm(
+        jnp.broadcast_to(W[:, None], (C, P)),
+        jnp.where(parked, park_tmp, 0.0),
+        interval,
+    )
+    queue_ts = t_where(parked, park_pair, pods.queue_ts)
 
     return state._replace(
         nodes=state.nodes._replace(alloc_cpu=alloc_cpu, alloc_ram=alloc_ram),
@@ -568,32 +683,31 @@ def commit_cycle(
         wake_freed_signal=jnp.zeros_like(state.wake_freed_signal),
         wake_freed_cpu=jnp.zeros_like(state.wake_freed_cpu),
         wake_freed_ram=jnp.zeros_like(state.wake_freed_ram),
-        last_flush_time=cc.last_flush_time,
-        time=jnp.maximum(state.time, T),
+        last_flush_win=cc.last_flush_win,
+        time=jnp.maximum(state.time, W),
     )
 
 
 def _run_scheduling_cycle(
     state: ClusterBatchState,
-    T: jnp.ndarray,
+    W: jnp.ndarray,
     consts: StepConstants,
     max_pods_per_cycle: int,
     use_pallas: bool = False,
     pallas_interpret: bool = False,
     conditional_move: bool = False,
 ) -> ClusterBatchState:
-    """One vectorized kube-scheduler cycle at time T for every cluster
+    """One vectorized kube-scheduler cycle at window W for every cluster
     (scalar equivalent: reference scheduler.rs:246-333)."""
     C, P = state.pods.phase.shape
     N = state.nodes.alive.shape[1]
 
-    cc = prepare_cycle(state, T, consts, max_pods_per_cycle, conditional_move)
+    cc = prepare_cycle(state, W, consts, max_pods_per_cycle, conditional_move)
     cand_valid, cand_req_cpu, cand_req_ram = cc.valid, cc.req_cpu, cc.req_ram
-    cand_duration, cand_initial_ts = cc.duration, cc.initial_ts
 
     alive = state.nodes.alive
     alive_count = alive.sum(axis=1, dtype=jnp.int32).astype(jnp.float32)
-    time_dtype = cc.pods.queue_ts.dtype
+    pod_sched_time = jnp.float32(consts.time_per_node) * alive_count  # (C,)
 
     if use_pallas:
         # The (C, N)-heavy core runs as a fused VMEM kernel; the (C,)-shaped
@@ -611,42 +725,33 @@ def _run_scheduling_cycle(
             interpret=pallas_interpret,
         )
         park_k = cand_valid & ~fitany_k
-        pod_sched_time = consts.time_per_node * alive_count  # (C,)
 
         def mech_body(carry, xs):
             cycle_dur, metrics = carry
-            valid, assign, initial_ts, duration = xs
-            pod_queue_time = T - initial_ts + cycle_dur
-            metrics, start, finish, park_ts, cycle_dur_post = decision_mechanics(
-                metrics, valid, assign, duration, T, cycle_dur,
-                pod_queue_time, pod_sched_time, consts,
+            valid, assign, waited = xs
+            metrics, start_s, park_s, cycle_dur_post, _ = decision_mechanics(
+                metrics, valid, assign, waited, cycle_dur, pod_sched_time, consts
             )
-            return (cycle_dur_post, metrics), (start, finish, park_ts)
+            return (cycle_dur_post, metrics), (start_s, park_s)
 
-        (_, metrics), (start_k, finish_k, park_ts_k) = jax.lax.scan(
+        (_, metrics), (start_s_k, park_s_k) = jax.lax.scan(
             mech_body,
-            (jnp.zeros((C,), time_dtype), state.metrics),
-            (cand_valid.T, assign_k.T, cand_initial_ts.T, cand_duration.T),
+            (jnp.zeros((C,), jnp.float32), state.metrics),
+            (cand_valid.T, assign_k.T, cc.waited.T),
         )
         return commit_cycle(
-            state, cc, T, alloc_cpu, alloc_ram, metrics,
-            assign_k, park_k, best_k, start_k.T, finish_k.T, park_ts_k.T,
+            state, cc, W, consts, alloc_cpu, alloc_ram, metrics,
+            assign_k, park_k, best_k, start_s_k.T, park_s_k.T,
         )
 
     def body(carry, xs):
         alloc_cpu, alloc_ram, cycle_dur, metrics = carry
-        valid, req_cpu, req_ram, duration, initial_ts = xs
-
-        # Queue time uses the cycle duration accumulated BEFORE this pod; the
-        # assignment effect time uses it AFTER (reference: scheduler.rs:270-320).
-        pod_queue_time = T - initial_ts + cycle_dur
-        pod_sched_time = consts.time_per_node * alive_count
+        valid, req_cpu, req_ram, waited = xs
 
         # Fit filter + LeastAllocatedResources score (reference: plugin.rs:33-63).
         # Scores are float32 on BOTH batched paths (this scan and the Pallas
-        # kernel) — f64 is emulated on TPU; the precision only affects argmax
-        # tie-breaks between near-equal node scores, which the cross-path
-        # equivalence tests cover.
+        # kernel); the precision only affects argmax tie-breaks between
+        # near-equal node scores, which the cross-path equivalence tests cover.
         fit = (
             alive
             & (req_cpu[:, None] <= alloc_cpu)
@@ -670,39 +775,32 @@ def _run_scheduling_cycle(
         best = jnp.int32(N - 1) - jax.lax.argmax(score[:, ::-1], 1, jnp.int32)
         any_fit = fit.any(axis=1)
 
-        (alloc_cpu, alloc_ram, metrics, assign, park, start, finish, park_ts,
-         cycle_dur_post) = apply_decision(
+        (alloc_cpu, alloc_ram, metrics, assign, park, start_s, park_s,
+         cycle_dur_post, _) = apply_decision(
             alloc_cpu, alloc_ram, metrics, valid, any_fit, best,
-            req_cpu, req_ram, duration, T, cycle_dur,
-            pod_queue_time, pod_sched_time, consts,
+            req_cpu, req_ram, waited, cycle_dur, pod_sched_time, consts,
         )
-        outs = (assign, park, best, start, finish, park_ts)
+        outs = (assign, park, best, start_s, park_s)
         return (alloc_cpu, alloc_ram, cycle_dur_post, metrics), outs
 
-    xs = (
-        cand_valid.T,
-        cand_req_cpu.T,
-        cand_req_ram.T,
-        cand_duration.T,
-        cand_initial_ts.T,
-    )
+    xs = (cand_valid.T, cand_req_cpu.T, cand_req_ram.T, cc.waited.T)
     (alloc_cpu, alloc_ram, _, metrics), outs = jax.lax.scan(
         body,
-        (state.nodes.alloc_cpu, state.nodes.alloc_ram, jnp.zeros((C,), time_dtype),
+        (state.nodes.alloc_cpu, state.nodes.alloc_ram, jnp.zeros((C,), jnp.float32),
          state.metrics),
         xs,
     )
-    assign_k, park_k, best_k, start_k, finish_k, park_ts_k = (o.T for o in outs)
+    assign_k, park_k, best_k, start_s_k, park_s_k = (o.T for o in outs)
     return commit_cycle(
-        state, cc, T, alloc_cpu, alloc_ram, metrics,
-        assign_k, park_k, best_k, start_k, finish_k, park_ts_k,
+        state, cc, W, consts, alloc_cpu, alloc_ram, metrics,
+        assign_k, park_k, best_k, start_s_k, park_s_k,
     )
 
 
 def _window_body(
     state: ClusterBatchState,
     slab: TraceSlab,
-    window_end: jnp.ndarray,
+    W: jnp.ndarray,
     consts: StepConstants,
     max_events_per_window: int,
     max_pods_per_cycle: int,
@@ -713,13 +811,13 @@ def _window_body(
     pallas_interpret: bool = False,
     conditional_move: bool = False,
 ) -> ClusterBatchState:
-    window_end = jnp.broadcast_to(window_end, state.time.shape)
+    W = jnp.broadcast_to(jnp.asarray(W, jnp.int32), state.time.shape)
     state = _apply_window_events(
-        state, slab, window_end, consts, max_events_per_window, conditional_move
+        state, slab, W, consts, max_events_per_window, conditional_move
     )
     state = _run_scheduling_cycle(
         state,
-        window_end,
+        W,
         consts,
         max_pods_per_cycle,
         use_pallas,
@@ -733,12 +831,13 @@ def _window_body(
         from kubernetriks_tpu.batched.autoscale import ca_pass, hpa_pass
 
         auto = state.auto
-        state, auto = hpa_pass(state, auto, autoscale_statics, window_end)
+        state, auto = hpa_pass(state, auto, autoscale_statics, W, consts)
         state, auto = ca_pass(
             state,
             auto,
             autoscale_statics,
-            window_end,
+            W,
+            consts,
             max_ca_pods_per_cycle,
             max_pods_per_scale_down,
         )
@@ -761,7 +860,7 @@ _STEP_STATICS = (
 def window_step(
     state: ClusterBatchState,
     slab: TraceSlab,
-    window_end: jnp.ndarray,
+    W: jnp.ndarray,
     consts: StepConstants,
     max_events_per_window: int,
     max_pods_per_cycle: int,
@@ -772,11 +871,11 @@ def window_step(
     pallas_interpret: bool = False,
     conditional_move: bool = False,
 ) -> ClusterBatchState:
-    """Advance every cluster to `window_end` (the next scheduling-cycle time)."""
+    """Advance every cluster through scheduling-cycle window index W."""
     return _window_body(
         state,
         slab,
-        window_end,
+        W,
         consts,
         max_events_per_window,
         max_pods_per_cycle,
@@ -793,7 +892,7 @@ def window_step(
 def run_windows(
     state: ClusterBatchState,
     slab: TraceSlab,
-    window_ends: jnp.ndarray,
+    window_idxs: jnp.ndarray,
     consts: StepConstants,
     max_events_per_window: int,
     max_pods_per_cycle: int,
@@ -805,7 +904,8 @@ def run_windows(
     conditional_move: bool = False,
 ) -> ClusterBatchState:
     """Scan a whole sequence of scheduling-cycle windows on-device (the hot
-    benchmark loop: no host round-trips between cycles)."""
+    benchmark loop: no host round-trips between cycles). window_idxs: (Wn,)
+    int32 consecutive window indices."""
 
     def body(carry, w):
         return (
@@ -826,5 +926,5 @@ def run_windows(
             None,
         )
 
-    state, _ = jax.lax.scan(body, state, window_ends)
+    state, _ = jax.lax.scan(body, state, jnp.asarray(window_idxs, jnp.int32))
     return state

@@ -1,0 +1,122 @@
+"""Window-indexed time representation for the batched path.
+
+Simulation time on device is a pair (win: int32, off: float32) with
+``t = win * interval + off`` and ``off ∈ [0, interval)`` — the TPU-native
+answer to the precision problem that float64 solves on CPU:
+
+- The reference composes sub-0.1 s control-plane delays onto absolute
+  timestamps up to ~7e5 s (Alibaba traces; delays: src/config.yaml:73-78).
+  float32 absolute seconds lose the delays (ulp ≈ 0.06 s at 7e5); float64 is
+  emulated on TPU and makes every scatter/gather/sort in the hot loop pay a
+  64-bit tax (measured ~2x whole-step cost on v5e).
+- The pair splits time into an EXACT integer scheduling-window index (the
+  only discrete decision the simulation makes: which window an event lands
+  in) and a bounded offset whose float32 ulp is interval * 2^-24 ≈ 1e-6 s at
+  the default 10 s interval — three orders of magnitude below the smallest
+  modeled delay, and independent of absolute simulation time.
+
+All pair ops are elementwise 32-bit; comparisons are lexicographic. Offsets
+never store +inf: infinity ("no pending effect") is win >= INF_WIN with
+off = 0, so arithmetic never produces NaN.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+# "+infinity" window index. Small enough that INF_WIN + INF_WIN + slack fits
+# int32 (adds of two times never both exceed one INF), large enough
+# (~5e9 simulated seconds at interval=10) to exceed any real trace horizon.
+INF_WIN = 1 << 29
+
+
+class TPair(NamedTuple):
+    """A batch of simulation times: (win * interval + off) seconds."""
+
+    win: jnp.ndarray  # int32 window index; >= INF_WIN means +inf
+    off: jnp.ndarray  # float32 offset in [0, interval); 0 where +inf
+
+
+def t_full(shape, win: int, off: float = 0.0) -> TPair:
+    return TPair(
+        win=jnp.full(shape, win, jnp.int32),
+        off=jnp.full(shape, off, jnp.float32),
+    )
+
+
+def t_inf(shape) -> TPair:
+    return t_full(shape, INF_WIN, 0.0)
+
+
+def t_zeros(shape) -> TPair:
+    return t_full(shape, 0, 0.0)
+
+
+def is_inf(a: TPair) -> jnp.ndarray:
+    return a.win >= INF_WIN
+
+
+def t_lt(a: TPair, b: TPair) -> jnp.ndarray:
+    return (a.win < b.win) | ((a.win == b.win) & (a.off < b.off))
+
+
+def t_le(a: TPair, b: TPair) -> jnp.ndarray:
+    return (a.win < b.win) | ((a.win == b.win) & (a.off <= b.off))
+
+
+def t_min(a: TPair, b: TPair) -> TPair:
+    take_b = t_lt(b, a)
+    return TPair(
+        win=jnp.where(take_b, b.win, a.win),
+        off=jnp.where(take_b, b.off, a.off),
+    )
+
+
+def t_where(mask: jnp.ndarray, a: TPair, b: TPair) -> TPair:
+    return TPair(
+        win=jnp.where(mask, a.win, b.win), off=jnp.where(mask, a.off, b.off)
+    )
+
+
+def t_norm(win: jnp.ndarray, off: jnp.ndarray, interval: jnp.ndarray) -> TPair:
+    """Renormalize an unnormalized pair (off may be >= interval, any finite
+    value >= 0) back to off ∈ [0, interval). Infinite pairs (win >= INF_WIN)
+    pass through — their off stays 0 by construction."""
+    off = off.astype(jnp.float32)
+    q = jnp.floor(off / interval)
+    return TPair(
+        win=(win + q.astype(jnp.int32)).astype(jnp.int32),
+        off=(off - q * interval).astype(jnp.float32),
+    )
+
+
+def t_add(a: TPair, b: TPair, interval: jnp.ndarray) -> TPair:
+    """a + b. Offsets sum to < 2*interval, so one carry normalizes."""
+    return t_norm(a.win + b.win, a.off + b.off, interval)
+
+
+def to_f64(a: TPair, interval: float) -> np.ndarray:
+    """Host-side absolute seconds (numpy float64); +inf where infinite."""
+    win = np.asarray(a.win, np.int64)
+    off = np.asarray(a.off, np.float64)
+    t = win * float(interval) + off
+    return np.where(win >= INF_WIN, np.inf, t)
+
+
+def from_f64_np(t: np.ndarray, interval: float):
+    """Host-side split of absolute float64 seconds into (win, off) numpy
+    arrays. +inf maps to (INF_WIN, 0). The split is computed in float64, so
+    win is exact and off carries only the final float32 rounding
+    (≤ interval * 2^-25)."""
+    t = np.asarray(t, np.float64)
+    finite = np.isfinite(t)
+    win = np.where(finite, np.floor(t / interval), INF_WIN).astype(np.int64)
+    off = np.where(finite, t - win * float(interval), 0.0)
+    # Guard the floor against f64 division rounding at exact multiples.
+    over = finite & (off >= interval)
+    win = np.where(over, win + 1, win)
+    off = np.where(over, off - interval, off)
+    return win.astype(np.int32), off.astype(np.float32)

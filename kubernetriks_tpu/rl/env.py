@@ -65,7 +65,7 @@ def featurize(
 
 def policy_cycle(
     state: ClusterBatchState,
-    T: jnp.ndarray,
+    W: jnp.ndarray,
     consts: StepConstants,
     K: int,
     policy_apply,
@@ -74,24 +74,23 @@ def policy_cycle(
     greedy: bool = False,
     conditional_move: bool = False,
 ) -> Tuple[ClusterBatchState, Transition]:
-    """One scheduling cycle where the policy picks nodes; returns the K
-    per-cluster transitions. Action space = nodes, masked to Fit-feasible ones;
-    no feasible node -> the pod parks unschedulable (like the Fit filter)."""
+    """One scheduling cycle (at window index W) where the policy picks nodes;
+    returns the K per-cluster transitions. Action space = nodes, masked to
+    Fit-feasible ones; no feasible node -> the pod parks unschedulable (like
+    the Fit filter)."""
     C, P = state.pods.phase.shape
     N = state.nodes.alive.shape[1]
     rows1 = jnp.arange(C, dtype=jnp.int32)
 
-    cc = prepare_cycle(state, T, consts, K, conditional_move)
+    cc = prepare_cycle(state, W, consts, K, conditional_move)
     alive = state.nodes.alive
 
     alive_count = alive.sum(axis=1, dtype=jnp.int32).astype(jnp.float32)
+    pod_sched_time = jnp.float32(consts.time_per_node) * alive_count
 
     def body(carry, xs):
         alloc_cpu, alloc_ram, cycle_dur, metrics, rng = carry
-        valid, req_cpu, req_ram, duration, initial_ts = xs
-
-        pod_queue_time = T - initial_ts + cycle_dur
-        pod_sched_time = consts.time_per_node * alive_count
+        valid, req_cpu, req_ram, waited = xs
 
         obs = featurize(
             alive, alloc_cpu, alloc_ram, state.nodes.cap_cpu, state.nodes.cap_ram,
@@ -115,13 +114,12 @@ def policy_cycle(
         log_probs = jax.nn.log_softmax(safe_logits, axis=-1)
         log_prob = log_probs[rows1, action]
 
-        # Shared decision mechanics (resource reservation, start/finish/park,
+        # Shared decision mechanics (resource reservation, start/park offsets,
         # metrics) — single-sourced with the kube cycle in batched/step.py.
-        (alloc_cpu, alloc_ram, metrics, assign, park, start, finish, park_ts,
-         cycle_dur_post) = apply_decision(
+        (alloc_cpu, alloc_ram, metrics, assign, park, start_s, park_s,
+         cycle_dur_post, pod_queue_time) = apply_decision(
             alloc_cpu, alloc_ram, metrics, valid, any_fit, action,
-            req_cpu, req_ram, duration, T, cycle_dur,
-            pod_queue_time, pod_sched_time, consts,
+            req_cpu, req_ram, waited, cycle_dur, pod_sched_time, consts,
         )
 
         # Reward: +1 per placement, -1 per unschedulable park, minus a queue
@@ -139,20 +137,20 @@ def policy_cycle(
             reward=reward,
             valid=valid,
         )
-        outs = (assign, park, action, start, finish, park_ts, transition)
+        outs = (assign, park, action, start_s, park_s, transition)
         return (alloc_cpu, alloc_ram, cycle_dur_post, metrics, rng), outs
 
-    xs = (cc.valid.T, cc.req_cpu.T, cc.req_ram.T, cc.duration.T, cc.initial_ts.T)
+    xs = (cc.valid.T, cc.req_cpu.T, cc.req_ram.T, cc.waited.T)
     (alloc_cpu, alloc_ram, _, metrics, _), outs = jax.lax.scan(
         body,
         (state.nodes.alloc_cpu, state.nodes.alloc_ram,
-         jnp.zeros((C,), cc.pods.queue_ts.dtype), state.metrics, rng),
+         jnp.zeros((C,), jnp.float32), state.metrics, rng),
         xs,
     )
-    assign_k, park_k, action_k, start_k, finish_k, park_ts_k, transitions = outs
+    assign_k, park_k, action_k, start_s_k, park_s_k, transitions = outs
     state = commit_cycle(
-        state, cc, T, alloc_cpu, alloc_ram, metrics,
-        assign_k.T, park_k.T, action_k.T, start_k.T, finish_k.T, park_ts_k.T,
+        state, cc, W, consts, alloc_cpu, alloc_ram, metrics,
+        assign_k.T, park_k.T, action_k.T, start_s_k.T, park_s_k.T,
     )
     return state, transitions  # transitions stacked over K on axis 0
 
@@ -170,7 +168,7 @@ def policy_cycle(
 def rollout(
     state: ClusterBatchState,
     slab: TraceSlab,
-    window_ends: jnp.ndarray,
+    window_idxs: jnp.ndarray,
     consts: StepConstants,
     params,
     rng: jnp.ndarray,
@@ -180,12 +178,13 @@ def rollout(
     greedy: bool = False,
     conditional_move: bool = False,
 ) -> Tuple[ClusterBatchState, Transition]:
-    """Scan W scheduling windows under the policy; transitions stacked (W, K, C, ...)."""
+    """Scan scheduling windows (int32 indices) under the policy; transitions
+    stacked (W, K, C, ...)."""
 
     def body(carry, w):
         st, rng = carry
         rng, sub = jax.random.split(rng)
-        w_arr = jnp.broadcast_to(w, st.time.shape)
+        w_arr = jnp.broadcast_to(jnp.asarray(w, jnp.int32), st.time.shape)
         st = _apply_window_events(
             st, slab, w_arr, consts, max_events_per_window, conditional_move
         )
@@ -195,7 +194,9 @@ def rollout(
         )
         return (st, rng), transition
 
-    (state, _), transitions = jax.lax.scan(body, (state, rng), window_ends)
+    (state, _), transitions = jax.lax.scan(
+        body, (state, rng), jnp.asarray(window_idxs, jnp.int32)
+    )
     return state, transitions
 
 

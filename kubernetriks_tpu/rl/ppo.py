@@ -157,7 +157,7 @@ class PPOTrainer:
         )
         self.sim = sim
         self.config = config
-        self.windows = np.arange(windows_per_rollout) * sim.config.scheduling_cycle_interval
+        self.windows = np.arange(windows_per_rollout, dtype=np.int32)
         rng = jax.random.PRNGKey(seed)
         self.rng, init_rng = jax.random.split(rng)
         n_nodes = sim.state.nodes.alive.shape[1]
@@ -172,7 +172,7 @@ class PPOTrainer:
         final_state, transitions = rollout(
             self.initial_state,
             self.sim.slab,
-            jnp.asarray(self.windows, self.initial_state.time.dtype),
+            jnp.asarray(self.windows, jnp.int32),
             self.sim.consts,
             self.params,
             sub,
