@@ -7,9 +7,10 @@ The reference publishes no benchmark numbers (BASELINE.md); vs_baseline is
 measured against the driver-set north star of 1M decisions/s on a v5e-8,
 i.e. 125k decisions/s per chip (BASELINE.json).
 
-Scenario: 1024 simulated 64-node clusters, Poisson pod arrivals (2 pods/s for
-1000 s, ~2k pods per cluster), default kube-scheduler filter/score, stepped in
-20-window device chunks.
+Scenario: 1024 simulated 256-node clusters (the BASELINE.md tracked
+"1024x256-node vmap batch on single TPU" config), Poisson pod arrivals
+(2 pods/s for 1000 s, ~2k pods per cluster), default kube-scheduler
+filter/score, stepped in 20-window device chunks.
 """
 
 import json
@@ -32,7 +33,7 @@ def main() -> None:
     config = SimulationConfig.from_yaml(
         "sim_name: bench\nseed: 1\nscheduling_cycle_interval: 10.0"
     )
-    cluster = UniformClusterTrace(64, cpu=64000, ram=128 * 1024**3)
+    cluster = UniformClusterTrace(256, cpu=64000, ram=128 * 1024**3)
     workload = PoissonWorkloadTrace(
         rate_per_second=2.0,
         horizon=1000.0,
@@ -71,7 +72,7 @@ def main() -> None:
     print(
         json.dumps(
             {
-                "metric": "pod-scheduling decisions/sec (single chip, 1024x64-node clusters)",
+                "metric": "pod-scheduling decisions/sec (single chip, 1024x256-node clusters)",
                 "value": round(decisions_per_sec),
                 "unit": "decisions/s",
                 "vs_baseline": round(

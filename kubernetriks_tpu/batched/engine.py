@@ -302,9 +302,14 @@ class BatchedSimulation:
         self.n_pods = pod_req_cpu.shape[1]
         self.n_events = ev_time.shape[1]
 
-        # Cap per-window event work: worst-case events falling in one window.
+        # Per-window event application runs in CHUNKS of this size inside a
+        # while_loop until the window's due events are exhausted, so this is a
+        # typical-case tile size, not a worst-case bound: a trace whose worst
+        # window has thousands of events (e.g. the t=0 cluster creation burst)
+        # pays a few extra loop iterations there instead of taxing every
+        # window with a burst-sized gather/scatter.
         if max_events_per_window is None:
-            max_events_per_window = self._max_events_in_any_window(ev_time)
+            max_events_per_window = min(self._max_events_in_any_window(ev_time), 128)
         self.max_events_per_window = max(1, max_events_per_window)
         # Cap per-cycle scheduling work (the scalar path drains the queue
         # unboundedly, reference scheduler.rs:261; the batched path bounds each

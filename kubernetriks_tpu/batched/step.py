@@ -148,41 +148,93 @@ def _apply_window_events(
     base = W - 1  # (C,) the window the applied events fall in
     f32inf = jnp.float32(INF)
 
-    # Gather this window's slab segment: (C, E) starting at each cursor.
-    offs = state.event_cursor[:, None] + jnp.arange(E, dtype=jnp.int32)[None, :]
-    offs_c = jnp.clip(offs, 0, E_total - 1)
-    ev_win = slab.win[rows, offs_c]
-    ev_off = slab.off[rows, offs_c]
-    ev_k = slab.kind[rows, offs_c]
-    ev_s = slab.slot[rows, offs_c]
-    valid = (offs < E_total) & (ev_win < W[:, None])
-    # Event time in f32 seconds relative to base (== ev_off when the event is
-    # in this window, which consecutive window stepping guarantees).
-    ev_rel = (ev_win - base[:, None]).astype(jnp.float32) * interval + ev_off
+    # --- bulk-apply the window's slab events, E at a time -------------------
+    # E is a CHUNK size, not a worst-case bound: chunks apply inside a
+    # while_loop until no cluster has a due event left. A trace with a burst
+    # window (e.g. 1000 CreateNodes at t=0) takes a few extra iterations in
+    # that one window instead of taxing every window with a burst-sized
+    # gather/scatter. Due events are a sorted prefix of the slab, so a chunk
+    # boundary never skips one.
+    def chunk_due(cursor):
+        nxt = slab.win[rows1, jnp.clip(cursor, 0, E_total - 1)]
+        return (cursor < E_total) & (nxt < W)
 
-    is_cn = valid & (ev_k == EV_CREATE_NODE)
-    is_rn = valid & (ev_k == EV_REMOVE_NODE)
-    is_cp = valid & (ev_k == EV_CREATE_POD)
-    is_rp = valid & (ev_k == EV_REMOVE_POD)
+    def chunk_cond(carry):
+        return jnp.any(chunk_due(carry[0]))
 
-    # Scatter helpers: out-of-range slot drops the write.
-    def drop_slot(mask, width):
-        return jnp.where(mask, ev_s, width)
+    def chunk_body(carry):
+        (cursor, created, node_removal, pod_create, pod_create_seq,
+         pod_removal, n_creates) = carry
+        offs = cursor[:, None] + jnp.arange(E, dtype=jnp.int32)[None, :]
+        offs_c = jnp.clip(offs, 0, E_total - 1)
+        ev_win = slab.win[rows, offs_c]
+        ev_off = slab.off[rows, offs_c]
+        ev_k = slab.kind[rows, offs_c]
+        ev_s = slab.slot[rows, offs_c]
+        valid = (offs < E_total) & (ev_win < W[:, None])
+        # Event time in f32 seconds relative to base (== ev_off when the
+        # event is in this window, which consecutive stepping guarantees).
+        ev_rel = (ev_win - base[:, None]).astype(jnp.float32) * interval + ev_off
 
-    # --- node creations -----------------------------------------------------
-    created = (
-        jnp.zeros((C, N), bool).at[rows, drop_slot(is_cn, N)].set(True, mode="drop")
+        is_cn = valid & (ev_k == EV_CREATE_NODE)
+        is_rn = valid & (ev_k == EV_REMOVE_NODE)
+        is_cp = valid & (ev_k == EV_CREATE_POD)
+        is_rp = valid & (ev_k == EV_REMOVE_POD)
+
+        # Scatter helpers: out-of-range slot drops the write.
+        def drop_slot(mask, width):
+            return jnp.where(mask, ev_s, width)
+
+        created = created.at[rows, drop_slot(is_cn, N)].set(True, mode="drop")
+        node_removal = node_removal.at[rows, drop_slot(is_rn, N)].min(
+            jnp.where(is_rn, ev_rel, f32inf), mode="drop"
+        )
+        pod_create = pod_create.at[rows, drop_slot(is_cp, P)].min(
+            jnp.where(is_cp, ev_rel, f32inf), mode="drop"
+        )
+        # Queue sequence numbers follow slab (== emission) order, continuing
+        # across chunks via the running n_creates.
+        create_rank = jnp.cumsum(is_cp, axis=1, dtype=jnp.int32) - 1
+        pod_create_seq = pod_create_seq.at[rows, drop_slot(is_cp, P)].max(
+            jnp.where(
+                is_cp,
+                state.queue_seq_counter[:, None] + n_creates[:, None] + create_rank,
+                0,
+            ),
+            mode="drop",
+        )
+        pod_removal = pod_removal.at[rows, drop_slot(is_rp, P)].min(
+            jnp.where(is_rp, ev_rel, f32inf), mode="drop"
+        )
+        return (
+            cursor + valid.sum(axis=1, dtype=jnp.int32),
+            created,
+            node_removal,
+            pod_create,
+            pod_create_seq,
+            pod_removal,
+            n_creates + is_cp.sum(axis=1, dtype=jnp.int32),
+        )
+
+    (event_cursor, created, node_removal, pod_create, pod_create_seq,
+     pod_removal, n_creates) = jax.lax.while_loop(
+        chunk_cond,
+        chunk_body,
+        (
+            state.event_cursor,
+            jnp.zeros((C, N), bool),
+            jnp.full((C, N), INF, jnp.float32),
+            jnp.full((C, P), INF, jnp.float32),
+            jnp.zeros((C, P), jnp.int32),
+            jnp.full((C, P), INF, jnp.float32),
+            jnp.zeros((C,), jnp.int32),
+        ),
     )
+
     # Pending autoscaler creations due this window (CA scale-up effects).
     pend_create = (nodes.create_time.win < W[:, None]) & ~nodes.alive
     created = created | pend_create
     node_create_time = t_where(pend_create, t_inf((C, N)), nodes.create_time)
-    # --- node removal times, f32 rel-seconds (+inf = not removed this window)
-    node_removal = (
-        jnp.full((C, N), INF, jnp.float32)
-        .at[rows, drop_slot(is_rn, N)]
-        .min(jnp.where(is_rn, ev_rel, f32inf), mode="drop")
-    )
     # Pending autoscaler removals due this window (CA scale-down effects).
     pend_rm_due = nodes.remove_time.win < W[:, None]
     pend_remove = jnp.where(
@@ -190,29 +242,6 @@ def _apply_window_events(
     )
     node_removal = jnp.minimum(node_removal, pend_remove)
     node_remove_time = t_where(pend_rm_due, t_inf((C, N)), nodes.remove_time)
-    # --- pod creations ------------------------------------------------------
-    pod_create = (
-        jnp.full((C, P), INF, jnp.float32)
-        .at[rows, drop_slot(is_cp, P)]
-        .min(jnp.where(is_cp, ev_rel, f32inf), mode="drop")
-    )
-    # Queue sequence numbers follow slab (== emission) order.
-    create_rank = jnp.cumsum(is_cp, axis=1, dtype=jnp.int32) - 1
-    pod_create_seq = (
-        jnp.zeros((C, P), jnp.int32)
-        .at[rows, drop_slot(is_cp, P)]
-        .max(
-            jnp.where(is_cp, state.queue_seq_counter[:, None] + create_rank, 0),
-            mode="drop",
-        )
-    )
-    n_creates = is_cp.sum(axis=1, dtype=jnp.int32)
-    # --- pod removal times --------------------------------------------------
-    pod_removal = (
-        jnp.full((C, P), INF, jnp.float32)
-        .at[rows, drop_slot(is_rp, P)]
-        .min(jnp.where(is_rp, ev_rel, f32inf), mode="drop")
-    )
     # Pending HPA scale-down removals due this window.
     pend_prm_due = pods.removal_time.win < W[:, None]
     pend_pod_removal = jnp.where(
@@ -244,8 +273,14 @@ def _apply_window_events(
     # --- resolve running pods: finish vs node removal vs pod removal --------
     running = phase == PHASE_RUNNING
     node_idx = jnp.clip(pods.node, 0, None)
-    pod_node_removal = jnp.where(
-        pods.node >= 0, node_removal[rows, node_idx], f32inf
+    # The per-pod node-removal gather is a (C, P)-indexed op — one of the two
+    # most expensive ops in the step — and most windows remove no node at
+    # all; branch around it (the predicate reduction is replicated, so the
+    # cond also holds under a C-sharded mesh).
+    pod_node_removal = jax.lax.cond(
+        (node_removal < f32inf).any(),
+        lambda: jnp.where(pods.node >= 0, node_removal[rows, node_idx], f32inf),
+        lambda: jnp.full((C, P), INF, jnp.float32),
     )
     # Earliest interruption of this pod in rel-seconds; +inf = none.
     interrupt = jnp.minimum(pod_node_removal, pod_removal)
@@ -264,10 +299,45 @@ def _apply_window_events(
     removed_running = interrupted & (pod_removal <= pod_node_removal)
 
     # Free resources of finished and removed-while-running pods (a dead node's
-    # allocatable is irrelevant; slots are never reused).
+    # allocatable is irrelevant; slots are never reused). A straight
+    # (C, P)-indexed scatter is the single most expensive op in the step, and
+    # only a handful of pods free per window — compact the freed pods to the
+    # front with one cheap sort and scatter E-sized chunks instead (integer
+    # adds commute, so the reordering is exact).
     freed = finishes | removed_running
-    alloc_cpu = alloc_cpu.at[rows, node_idx].add(jnp.where(freed, pods.req_cpu, 0))
-    alloc_ram = alloc_ram.at[rows, node_idx].add(jnp.where(freed, pods.req_ram, 0))
+    F = min(P, 128)  # freed-compaction chunk width (independent of E)
+    iota_p = jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32)[None, :], (C, P))
+    _, forder = jax.lax.sort(
+        (jnp.where(freed, 0, 1).astype(jnp.int32), iota_p),
+        dimension=1,
+        num_keys=1,
+        is_stable=True,
+    )
+    # Pad with out-of-range sentinels so the chunk slice never clamps back
+    # onto already-applied entries.
+    forder = jnp.concatenate([forder, jnp.full((C, F), P, jnp.int32)], axis=1)
+    fmax = freed.sum(axis=1, dtype=jnp.int32).max()
+
+    def free_cond(carry):
+        return carry[0] < fmax
+
+    def free_body(carry):
+        fstart, acpu, aram = carry
+        idx = jax.lax.dynamic_slice(forder, (jnp.int32(0), fstart), (C, F))
+        idx_c = jnp.clip(idx, 0, P - 1)
+        fv = (idx < P) & freed[rows, idx_c]
+        tgt = jnp.where(fv, node_idx[rows, idx_c], N)
+        acpu = acpu.at[rows, tgt].add(
+            jnp.where(fv, pods.req_cpu[rows, idx_c], 0), mode="drop"
+        )
+        aram = aram.at[rows, tgt].add(
+            jnp.where(fv, pods.req_ram[rows, idx_c], 0), mode="drop"
+        )
+        return (fstart + jnp.int32(F), acpu, aram)
+
+    _, alloc_cpu, alloc_ram = jax.lax.while_loop(
+        free_cond, free_body, (jnp.int32(0), alloc_cpu, alloc_ram)
+    )
 
     # Finished pods.
     n_done = finishes.sum(axis=1, dtype=jnp.int32)
@@ -326,7 +396,6 @@ def _apply_window_events(
     # alive only via pods.node indices, which is removal-independent).
     alive = alive & ~(node_removal < f32inf)
 
-    applied = valid.sum(axis=1, dtype=jnp.int32)
     any_created_node = created.any(axis=1)
     any_freed = (n_done > 0) | (n_removed_running > 0)
 
@@ -370,7 +439,7 @@ def _apply_window_events(
             removal_time=pod_removal_time,
         ),
         metrics=metrics,
-        event_cursor=state.event_cursor + applied,
+        event_cursor=event_cursor,
         queue_seq_counter=state.queue_seq_counter + n_creates + n_rescheds,
         # Events of interest wake the unschedulable queue (flush-all policy,
         # reference: scheduler.rs:391-410,435-440,445-473).

@@ -97,6 +97,21 @@ def _cycle_kernel(
     iota = jax.lax.broadcasted_iota(jnp.int32, alive.shape, 0)
     node_ok = iota < jnp.int32(n_real)  # padded sublanes are never real nodes
 
+    # Outputs must be fully initialized even for skipped iterations.
+    assign_out[:] = jnp.zeros_like(assign_out)
+    fitany_out[:] = jnp.zeros_like(fitany_out)
+    best_out[:] = jnp.zeros_like(best_out)
+
+    # The loop only needs to reach the tile's last valid candidate — a
+    # data-dependent early exit the lax.scan formulation cannot express.
+    # prepare_cycle sorts eligible pods first, so valid is a per-cluster
+    # prefix and typical cycles have far fewer pending pods than the static
+    # K budget. Skipped iterations leave assign/fitany/best zeroed, which the
+    # callers never read (they gate every consumer on `valid`).
+    iota_k = jax.lax.broadcasted_iota(jnp.int32, (valid_ref.shape[0], valid_ref.shape[1]), 0)
+    k_live = jnp.max(jnp.where(valid_ref[:] != i0, iota_k + jnp.int32(1), i0))
+    k_bound = jnp.minimum(k_live, jnp.int32(k_pods))
+
     def body(k):
         cpu = cpu_out[:]
         ram = ram_out[:]
@@ -143,7 +158,7 @@ def _cycle_kernel(
         body(k)
         return k + jnp.int32(1)
 
-    jax.lax.while_loop(lambda k: k < jnp.int32(k_pods), loop_body, jnp.int32(0))
+    jax.lax.while_loop(lambda k: k < k_bound, loop_body, jnp.int32(0))
 
 
 def _pad_axis(x: jnp.ndarray, axis: int, to: int, value) -> jnp.ndarray:

@@ -384,6 +384,36 @@ events:
     assert batched2.pod_view(0)["pod_00"]["phase"] == PHASE_SUCCEEDED
 
 
+def test_multi_chunk_event_drain_matches_single_chunk():
+    """Event application drains a window's due events in chunks of
+    max_events_per_window inside a while_loop; a burst window (more events
+    than the chunk size) must produce bit-identical state to a single big
+    chunk — covers the cross-chunk cursor / n_creates / queue-seq carry."""
+    import jax
+
+    config = default_test_simulation_config()
+    workload_yaml, pod_names = make_workload()
+
+    big = run_batched(config, CLUSTER_YAML, workload_yaml, 2000.0)
+
+    tiny = build_batched_from_traces(
+        config,
+        GenericClusterTrace.from_yaml(CLUSTER_YAML).convert_to_simulator_events(),
+        GenericWorkloadTrace.from_yaml(workload_yaml).convert_to_simulator_events(),
+        n_clusters=1,
+        max_events_per_window=2,  # forces multi-iteration drains
+    )
+    tiny.step_until_time(2000.0)
+
+    flat_a, _ = jax.tree_util.tree_flatten_with_path(big.state)
+    flat_b, _ = jax.tree_util.tree_flatten_with_path(tiny.state)
+    for (path, a), (_, b) in zip(flat_a, flat_b):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b),
+            err_msg=jax.tree_util.keystr(path),
+        )
+
+
 def test_larger_batch_replicates_cluster_zero():
     """Every cluster in a homogeneous batch produces identical results."""
     config = default_test_simulation_config()

@@ -89,11 +89,16 @@ def test_kernel_matches_scan_reference(shape):
         alive, alloc_cpu, alloc_ram, valid, req_cpu, req_ram
     )
     np.testing.assert_array_equal(np.asarray(out[0]), a_ref)
-    np.testing.assert_array_equal(np.asarray(out[1]), f_ref)
-    # best is only defined where something fits (both paths leave garbage
-    # sentinel values where fit_any is false).
+    # fit_any/best are only defined for valid candidates: the kernel's
+    # early-exit loop skips iterations past the tile's last valid candidate
+    # (leaving zeros), and best additionally holds garbage sentinels where
+    # fit_any is false on both paths. Every consumer gates on `valid`.
     np.testing.assert_array_equal(
-        np.where(f_ref, np.asarray(out[2]), -1), np.where(f_ref, b_ref, -1)
+        np.where(valid, np.asarray(out[1]), False), np.where(valid, f_ref, False)
+    )
+    defined = valid & f_ref
+    np.testing.assert_array_equal(
+        np.where(defined, np.asarray(out[2]), -1), np.where(defined, b_ref, -1)
     )
     np.testing.assert_array_equal(np.asarray(out[3]), cpu_ref)
     np.testing.assert_array_equal(np.asarray(out[4]), ram_ref)
