@@ -302,16 +302,13 @@ def _apply_window_events(
     # allocatable is irrelevant; slots are never reused). A straight
     # (C, P)-indexed scatter is the single most expensive op in the step, and
     # only a handful of pods free per window — compact the freed pods to the
-    # front with one cheap sort and scatter E-sized chunks instead (integer
+    # front with one cheap sort and scatter F-sized chunks instead (integer
     # adds commute, so the reordering is exact).
     freed = finishes | removed_running
     F = min(P, 128)  # freed-compaction chunk width (independent of E)
     iota_p = jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32)[None, :], (C, P))
-    _, forder = jax.lax.sort(
-        (jnp.where(freed, 0, 1).astype(jnp.int32), iota_p),
-        dimension=1,
-        num_keys=1,
-        is_stable=True,
+    forder = lexsort_i32(
+        jnp.where(freed, 0, 1).astype(jnp.int32), iota_p
     )
     # Pad with out-of-range sentinels so the chunk slice never clamps back
     # onto already-applied entries.

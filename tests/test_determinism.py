@@ -3,9 +3,13 @@ random cluster + workload traces generated from the sim's own seeded RNG, run
 repeatedly; pods_succeeded and all three timing estimators must be
 bit-identical across runs.
 
-Scaled down from the reference's ~≤1000 node / ~≤10000 pod events to keep the
-scalar-Python suite fast; the structure and assertions are identical.
+Runs at the reference's scale (~≤1000 node / ~≤10000 pod events, 1 + 10
+repeat runs, reference: tests/test_determinism.rs:70-126); set
+KUBERNETRIKS_FAST_TESTS=1 to scale down to 150/1500 x 3 for quick local
+iteration.
 """
+
+import os
 
 from kubernetriks_tpu.metrics.collector import MetricsCollector
 from kubernetriks_tpu.sim.callbacks import RunUntilAllPodsAreFinishedCallbacks
@@ -13,8 +17,10 @@ from kubernetriks_tpu.sim.simulator import KubernetriksSimulation
 from kubernetriks_tpu.test_util import default_test_simulation_config
 from kubernetriks_tpu.trace.generic import GenericClusterTrace, GenericWorkloadTrace
 
-MAX_NODE_EVENTS = 150
-MAX_POD_EVENTS = 1500
+_FAST = bool(os.environ.get("KUBERNETRIKS_FAST_TESTS"))
+MAX_NODE_EVENTS = 150 if _FAST else 1000
+MAX_POD_EVENTS = 1500 if _FAST else 10000
+REPEAT_RUNS = 3 if _FAST else 10
 
 
 def generate_cluster_trace(sim: KubernetriksSimulation) -> GenericClusterTrace:
@@ -118,7 +124,7 @@ def run_simulation() -> MetricsCollector:
 def test_simulation_determinism():
     first = run_simulation()
     assert first.accumulated_metrics.pods_succeeded > 0
-    for _ in range(3):
+    for _ in range(REPEAT_RUNS):
         current = run_simulation()
         assert (
             first.accumulated_metrics.pods_succeeded
