@@ -1,0 +1,80 @@
+"""Plot a gauge-metrics CSV (script port of the reference's
+experiments/alibaba_demo.ipynb cells 4-5).
+
+Consumes the 8-column gauge schema written by either backend (scalar:
+MetricsCollector's 5 s cycle; batched: BatchedSimulation.write_gauge_csv —
+both via the CLI's --gauge-csv flag) and renders four panels: current nodes,
+current pods, scheduling-queue length, and cluster cpu/ram utilization with
+their run means.
+
+Usage: python experiments/plot_gauges.py gauge_metrics.csv [out.png] [--stride N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import sys
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def load_gauges(path: str):
+    with open(path) as f:
+        reader = csv.reader(f)
+        header = next(reader)
+        rows = [row for row in reader if row]
+    data = np.asarray(rows, dtype=np.float64)
+    return header, data
+
+
+def plot(path: str, out: str, stride: int = 1) -> None:
+    header, data = load_gauges(path)
+    col = {name: i for i, name in enumerate(header)}
+    data = data[::stride]
+    t = data[:, col["timestamp"]]
+
+    fig, axes = plt.subplots(2, 2, figsize=(12, 8), sharex=True)
+    axes[0, 0].plot(t, data[:, col["current_nodes"]])
+    axes[0, 0].set_title("Nodes")
+    axes[0, 1].plot(t, data[:, col["current_pods"]])
+    axes[0, 1].set_title("Pods")
+    axes[1, 0].plot(t, data[:, col["pods_in_scheduling_queues"]])
+    axes[1, 0].set_title("Pods in scheduling queues")
+
+    cpu = data[:, col["cluster_total_cpu_utilization"]]
+    ram = data[:, col["cluster_total_ram_utilization"]]
+    ax = axes[1, 1]
+    ax.plot(t, cpu, label="CPU utilization")
+    ax.plot(t, ram, label="RAM utilization")
+    ax.axhline(float(cpu.mean()), linestyle="--", alpha=0.6,
+               label=f"CPU mean {cpu.mean():.3f}")
+    ax.axhline(float(ram.mean()), linestyle=":", alpha=0.6,
+               label=f"RAM mean {ram.mean():.3f}")
+    ax.set_title("Cluster utilization")
+    ax.legend(fontsize=8)
+    for row in axes:
+        for a in row:
+            a.set_xlabel("simulation time (s)")
+            a.grid(alpha=0.3)
+    fig.tight_layout()
+    fig.savefig(out, dpi=120)
+    print(f"wrote {out}")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("gauge_csv")
+    parser.add_argument("out", nargs="?", default="gauge_metrics.png")
+    parser.add_argument("--stride", type=int, default=1)
+    args = parser.parse_args(argv)
+    plot(args.gauge_csv, args.out, args.stride)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -452,6 +452,28 @@ def _ca_scale_down(
     rows1 = jnp.arange(C, dtype=jnp.int32)
     rows = rows1[:, None]
     col_n = jnp.arange(N, dtype=jnp.int32)[None, :]
+    iota_p = jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32)[None, :], (C, P))
+
+    # Group running pods by assigned node ONCE (a per-slot (C, P) mask +
+    # argsort made the pass O(S * P log P) per window — fatal at trace scale);
+    # each node's pods become a contiguous segment of `porder`, located by a
+    # scatter-min first-index and scatter-add count.
+    on_any = pods.phase == PHASE_RUNNING
+    key_node = jnp.where(on_any, pods.node, jnp.int32(N))
+    key_sorted, porder = jax.lax.sort(
+        (key_node, iota_p), dimension=1, num_keys=1, is_stable=True
+    )
+    seg_start = (
+        jnp.full((C, N), P, jnp.int32)
+        .at[rows, jnp.where(key_sorted < N, key_sorted, N)]
+        .min(iota_p, mode="drop")
+    )
+    seg_count = (
+        jnp.zeros((C, N), jnp.int32)
+        .at[rows, jnp.where(on_any, jnp.clip(key_node, 0, N - 1), N)]
+        .add(on_any.astype(jnp.int32), mode="drop")
+    )
+    col_k = jnp.arange(K_sd, dtype=jnp.int32)[None, :]
 
     def outer(carry, xs):
         valloc_cpu, valloc_ram = carry
@@ -480,16 +502,15 @@ def _ca_scale_down(
         eligible = alive_here & not_pending & (util < st.ca_threshold)
 
         # Pods assigned to this node (storage assignments include in-flight
-        # bindings, matching PHASE_RUNNING).
-        on = (pods.phase == PHASE_RUNNING) & (pods.node == slot[:, None])
-        on = on & slot_ok[:, None]
-        cnt = on.sum(axis=1, dtype=jnp.int32)
+        # bindings, matching PHASE_RUNNING): the K_sd-slice of this node's
+        # segment in pod-slot order.
+        cnt = seg_count[rows1, slotc] * slot_ok.astype(jnp.int32)
         attempt = eligible & (cnt <= K_sd)  # overflow: conservatively skip
 
-        pod_order = jnp.argsort(
-            jnp.where(on, jnp.arange(P, dtype=jnp.int32)[None, :], _BIG_I32), axis=1
-        ).astype(jnp.int32)[:, :K_sd]
-        pvalid = on[rows, pod_order] & attempt[:, None]
+        seg_pos = jnp.clip(seg_start[rows1, slotc], 0, P - 1)
+        take = jnp.clip(seg_pos[:, None] + col_k, 0, P - 1)
+        pod_order = porder[rows1[:, None], take]
+        pvalid = (col_k < cnt[:, None]) & attempt[:, None]
         prcpu = pods.req_cpu[rows, pod_order]
         prram = pods.req_ram[rows, pod_order]
 

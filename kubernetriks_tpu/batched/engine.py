@@ -360,6 +360,12 @@ class BatchedSimulation:
         self.node_names = [c.node_names + extra_names for c in compiled_traces]
         self.pod_names = [c.pod_names for c in compiled_traces]
         self.next_window_idx = 0
+        # Per-window gauge collection (batched analog of the scalar 5 s gauge
+        # cycle): enable with collect_gauges, read via gauge_series() or
+        # write_gauge_csv().
+        self.collect_gauges = False
+        self._gauge_windows: list = []
+        self._gauge_samples: list = []
 
         self.mesh = mesh
         if mesh is not None:
@@ -434,7 +440,7 @@ class BatchedSimulation:
         idxs = self.window_idxs(until_time)
         if len(idxs) == 0:
             return
-        self.state = run_windows(
+        out = run_windows(
             self.state,
             self.slab,
             jnp.asarray(idxs, jnp.int32),
@@ -447,7 +453,14 @@ class BatchedSimulation:
             self.use_pallas,
             self.pallas_interpret,
             self.conditional_move,
+            self.collect_gauges,
         )
+        if self.collect_gauges:
+            self.state, gauges = out
+            self._gauge_windows.append(np.asarray(idxs))
+            self._gauge_samples.append(np.asarray(gauges))
+        else:
+            self.state = out
         self.next_window_idx = int(idxs[-1]) + 1
 
     def step_window(self) -> None:
@@ -466,6 +479,13 @@ class BatchedSimulation:
             self.pallas_interpret,
             self.conditional_move,
         )
+        if self.collect_gauges:
+            from kubernetriks_tpu.batched.step import gauge_snapshot
+
+            self._gauge_windows.append(
+                np.asarray([self.next_window_idx], np.int32)
+            )
+            self._gauge_samples.append(np.asarray(gauge_snapshot(self.state))[None])
         self.next_window_idx += 1
 
     def run_to_completion(self, max_time: float = 1e7) -> None:
@@ -560,6 +580,36 @@ class BatchedSimulation:
         auto = self.state.auto
         assert auto is not None, "autoscaling is not enabled"
         return np.asarray(auto.ca_count[cluster])
+
+    def gauge_series(self):
+        """(times (W,), samples (W, C, 7)) accumulated gauge time-series;
+        columns follow the scalar GAUGE_CSV_COLUMNS after the timestamp."""
+        if not self._gauge_samples:
+            return np.zeros((0,)), np.zeros((0, self.n_clusters, 7))
+        times = (
+            np.concatenate(self._gauge_windows).astype(np.float64)
+            * self.config.scheduling_cycle_interval
+        )
+        return times, np.concatenate(self._gauge_samples, axis=0)
+
+    def write_gauge_csv(self, path: str, cluster: int = 0) -> None:
+        """Dump one cluster's gauge series in the scalar collector's 8-column
+        schema (reference: src/metrics/collector.rs:216-228), so the offline
+        plotting tooling consumes either backend's output unchanged."""
+        import csv
+
+        from kubernetriks_tpu.metrics.collector import GAUGE_CSV_COLUMNS
+
+        times, samples = self.gauge_series()
+        with open(path, "w", newline="") as f:
+            writer = csv.writer(f)
+            writer.writerow(GAUGE_CSV_COLUMNS)
+            for i, t in enumerate(times):
+                row = samples[i, cluster]
+                writer.writerow(
+                    [t, int(row[0]), int(row[1]), int(row[2]),
+                     float(row[3]), float(row[4]), float(row[5]), float(row[6])]
+                )
 
     def pod_view(self, cluster: int) -> Dict[str, Dict]:
         """Name-keyed pod states for equivalence tests against the scalar path."""

@@ -911,6 +911,49 @@ def _window_body(
     return state
 
 
+def gauge_snapshot(state: ClusterBatchState) -> jnp.ndarray:
+    """(C, 7) on-device gauge readings after a window: current nodes/pods,
+    scheduling-queue length, node-average and cluster-total cpu/ram
+    utilization (scalar equivalents: GaugeMetrics fields fed from
+    collect_utilizations, reference: src/metrics/collector.rs:166-192,
+    352-390). Utilization = requests / capacity over alive nodes."""
+    nodes, pods = state.nodes, state.pods
+    alive = nodes.alive
+    alive_f = alive.astype(jnp.float32)
+    n_alive = alive.sum(axis=1, dtype=jnp.int32)
+    n_alive_f = jnp.maximum(n_alive, 1).astype(jnp.float32)
+
+    live_pod = (
+        (pods.phase == PHASE_QUEUED)
+        | (pods.phase == PHASE_UNSCHEDULABLE)
+        | (pods.phase == PHASE_RUNNING)
+    )
+    queued = (pods.phase == PHASE_QUEUED) | (pods.phase == PHASE_UNSCHEDULABLE)
+
+    cap_cpu = jnp.maximum(nodes.cap_cpu, 1).astype(jnp.float32)
+    cap_ram = jnp.maximum(nodes.cap_ram, 1).astype(jnp.float32)
+    used_cpu = (nodes.cap_cpu - nodes.alloc_cpu).astype(jnp.float32) * alive_f
+    used_ram = (nodes.cap_ram - nodes.alloc_ram).astype(jnp.float32) * alive_f
+
+    node_avg_cpu = (used_cpu / cap_cpu).sum(axis=1) / n_alive_f
+    node_avg_ram = (used_ram / cap_ram).sum(axis=1) / n_alive_f
+    total_cap_cpu = jnp.maximum((cap_cpu * alive_f).sum(axis=1), 1.0)
+    total_cap_ram = jnp.maximum((cap_ram * alive_f).sum(axis=1), 1.0)
+
+    return jnp.stack(
+        [
+            n_alive.astype(jnp.float32),
+            live_pod.sum(axis=1, dtype=jnp.int32).astype(jnp.float32),
+            queued.sum(axis=1, dtype=jnp.int32).astype(jnp.float32),
+            node_avg_cpu,
+            node_avg_ram,
+            used_cpu.sum(axis=1) / total_cap_cpu,
+            used_ram.sum(axis=1) / total_cap_ram,
+        ],
+        axis=-1,
+    )
+
+
 _STEP_STATICS = (
     "max_events_per_window",
     "max_pods_per_cycle",
@@ -954,7 +997,7 @@ def window_step(
     )
 
 
-@partial(jax.jit, static_argnames=_STEP_STATICS)
+@partial(jax.jit, static_argnames=_STEP_STATICS + ("collect_gauges",))
 def run_windows(
     state: ClusterBatchState,
     slab: TraceSlab,
@@ -968,29 +1011,34 @@ def run_windows(
     use_pallas: bool = False,
     pallas_interpret: bool = False,
     conditional_move: bool = False,
-) -> ClusterBatchState:
+    collect_gauges: bool = False,
+):
     """Scan a whole sequence of scheduling-cycle windows on-device (the hot
     benchmark loop: no host round-trips between cycles). window_idxs: (Wn,)
-    int32 consecutive window indices."""
+    int32 consecutive window indices.
+
+    With collect_gauges, returns (state, (Wn, C, 7) gauge time-series) — the
+    batched analog of the scalar 5 s gauge CSV cycle (one sample per window,
+    since batched state only changes at window boundaries)."""
 
     def body(carry, w):
-        return (
-            _window_body(
-                carry,
-                slab,
-                w,
-                consts,
-                max_events_per_window,
-                max_pods_per_cycle,
-                autoscale_statics,
-                max_ca_pods_per_cycle,
-                max_pods_per_scale_down,
-                use_pallas,
-                pallas_interpret,
-                conditional_move,
-            ),
-            None,
+        new = _window_body(
+            carry,
+            slab,
+            w,
+            consts,
+            max_events_per_window,
+            max_pods_per_cycle,
+            autoscale_statics,
+            max_ca_pods_per_cycle,
+            max_pods_per_scale_down,
+            use_pallas,
+            pallas_interpret,
+            conditional_move,
         )
+        return new, (gauge_snapshot(new) if collect_gauges else None)
 
-    state, _ = jax.lax.scan(body, state, jnp.asarray(window_idxs, jnp.int32))
+    state, gauges = jax.lax.scan(body, state, jnp.asarray(window_idxs, jnp.int32))
+    if collect_gauges:
+        return state, gauges
     return state

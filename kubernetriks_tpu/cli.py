@@ -1,10 +1,16 @@
 """CLI entry point (reference: src/main.rs).
 
-Usage: python -m kubernetriks_tpu.cli --config-file <yaml> [--gauge-csv <path>]
+Usage: python -m kubernetriks_tpu.cli --config-file <yaml>
+           [--backend scalar|batched] [--clusters N] [--gauge-csv <path>]
 
 Loads the config, selects the trace source (alibaba XOR generic, asserted like
 the reference at main.rs:62-65), builds the simulation, runs until all pods
 finish, and prints metrics.
+
+--backend batched runs the vectorized JAX path: N identical clusters stepped
+in lockstep on the accelerator. Alibaba traces with the native C++ feeder
+available go CSV -> dense arrays -> compile_from_arrays without ever
+materializing per-event Python objects (the object-free fast path).
 """
 
 from __future__ import annotations
@@ -85,9 +91,100 @@ def build_traces(config: SimulationConfig):
     return cluster, workload
 
 
+def build_batched_simulation(
+    config: SimulationConfig, n_clusters: int, max_pods_per_cycle: int = 0
+):
+    """Build a BatchedSimulation from the config's trace source.
+
+    Alibaba + native feeder: CSVs parse natively into dense arrays and
+    compile via compile_from_arrays — no per-event Python objects on the
+    multi-million-row pod axis. Otherwise: the object-based trace path.
+    """
+    from kubernetriks_tpu.batched.engine import (
+        BatchedSimulation,
+        build_batched_from_traces,
+    )
+    from kubernetriks_tpu.batched.trace_compile import compile_from_arrays
+    from kubernetriks_tpu.trace import feeder
+
+    # 0 = auto: bound each scheduling cycle's work at 256 pods (the scalar
+    # path drains the queue unboundedly, reference scheduler.rs:261; the
+    # batched path defers overflow to the next cycle — SURVEY §7 "bounded
+    # lax.scan microcycles"). Exact-drain runs pass the pod count explicitly.
+    # The bound applies identically on every trace/build path so a config
+    # simulates the same regardless of native-feeder availability (the engine
+    # clamps the slice to the pod-slot count when it is smaller).
+    kwargs = {"max_pods_per_cycle": max_pods_per_cycle or 256}
+
+    trace_config = config.trace_config
+    alibaba = trace_config.alibaba_cluster_trace_v2017 if trace_config else None
+    if alibaba is not None and feeder.native_available():
+        workload_arrays = feeder.load_workload_arrays(
+            alibaba.batch_instance_trace_path, alibaba.batch_task_trace_path
+        )
+        cluster_arrays = (
+            feeder.load_cluster_arrays(alibaba.machine_events_trace_path)
+            if alibaba.machine_events_trace_path
+            else None
+        )
+        compiled = compile_from_arrays(cluster_arrays, workload_arrays, config)
+        return BatchedSimulation(config, [compiled] * n_clusters, **kwargs)
+    cluster_trace, workload_trace = build_traces(config)
+    return build_batched_from_traces(
+        config,
+        cluster_trace.convert_to_simulator_events(),
+        workload_trace.convert_to_simulator_events(),
+        n_clusters=n_clusters,
+        **kwargs,
+    )
+
+
+def run_batched(config: SimulationConfig, args) -> int:
+    import json
+    import time
+
+    sim = build_batched_simulation(config, args.clusters, args.max_pods_per_cycle)
+    logging.getLogger(__name__).info(
+        "batched run: %d clusters x %d node slots x %d pod slots (pallas=%s)",
+        sim.n_clusters, sim.n_nodes, sim.n_pods, sim.use_pallas,
+    )
+    sim.collect_gauges = bool(args.gauge_csv)
+    t0 = time.perf_counter()
+    sim.run_to_completion()
+    elapsed = time.perf_counter() - t0
+    if args.gauge_csv:
+        sim.write_gauge_csv(args.gauge_csv)
+    summary = sim.metrics_summary()
+    decisions = summary["counters"]["scheduling_decisions"]
+    logging.getLogger(__name__).info(
+        "Processed %d scheduling decisions in %.2fs (%.0f decisions/s)",
+        decisions, elapsed, decisions / max(elapsed, 1e-9),
+    )
+    print(json.dumps(summary, indent=2, default=float))
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description="kubernetriks-tpu simulator")
     parser.add_argument("--config-file", required=True, help="Path to YAML config")
+    parser.add_argument(
+        "--backend",
+        choices=("scalar", "batched"),
+        default="scalar",
+        help="scalar event-loop oracle or the vectorized JAX path",
+    )
+    parser.add_argument(
+        "--clusters",
+        type=int,
+        default=1,
+        help="batched backend: number of identical clusters to step in lockstep",
+    )
+    parser.add_argument(
+        "--max-pods-per-cycle",
+        type=int,
+        default=0,
+        help="batched backend: per-cycle scheduling work bound (0 = auto)",
+    )
     parser.add_argument(
         "--gauge-csv",
         default=None,
@@ -97,6 +194,9 @@ def main(argv=None) -> int:
 
     config = SimulationConfig.from_file(args.config_file)
     setup_logging(config)
+
+    if args.backend == "batched":
+        return run_batched(config, args)
 
     cluster_trace, workload_trace = build_traces(config)
     sim = KubernetriksSimulation(config, gauge_csv_path=args.gauge_csv)

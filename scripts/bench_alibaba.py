@@ -1,0 +1,97 @@
+"""Alibaba v2017 replay benchmark (BASELINE.md tracked config: Alibaba replay
+~1k nodes + cluster autoscaler).
+
+Synthesizes a reference-scale trace (1,313 machines x 64 cores, ~53k batch
+tasks over one simulated day, 10% machine failures — shape per
+reference experiments/{modify_traces,alibaba_demo}.ipynb), runs it through
+the native C++ feeder -> compile_from_arrays -> BatchedSimulation with the
+cluster autoscaler enabled, and prints one JSON line with simulated-event
+throughput.
+
+Usage: python scripts/bench_alibaba.py [n_clusters]
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+
+def main(n_clusters: int = 1) -> None:
+    from kubernetriks_tpu.cli import build_batched_simulation
+    from kubernetriks_tpu.config import SimulationConfig
+    from kubernetriks_tpu.trace.synthetic_alibaba import write_synthetic_trace_dir
+
+    with tempfile.TemporaryDirectory() as td:
+        machines, tasks, instances = write_synthetic_trace_dir(
+            td, error_fraction=0.1, seed=3
+        )
+        config = SimulationConfig.from_yaml(
+            f"""
+sim_name: alibaba_replay_bench
+seed: 1
+scheduling_cycle_interval: 10.0
+as_to_ps_network_delay: 0.050
+ps_to_sched_network_delay: 0.089
+sched_to_as_network_delay: 0.023
+as_to_node_network_delay: 0.152
+as_to_ca_network_delay: 0.67
+as_to_hpa_network_delay: 0.50
+trace_config:
+  alibaba_cluster_trace_v2017:
+    machine_events_trace_path: {machines}
+    batch_task_trace_path: {tasks}
+    batch_instance_trace_path: {instances}
+cluster_autoscaler:
+  enabled: true
+  scan_interval: 10.0
+  max_node_count: 200
+  node_groups:
+  - node_template:
+      metadata:
+        name: replay_ca_node
+      status:
+        capacity:
+          cpu: 64000
+          ram: 94489280512
+"""
+        )
+        build_t0 = time.perf_counter()
+        sim = build_batched_simulation(config, n_clusters=n_clusters)
+        build_s = time.perf_counter() - build_t0
+
+        t0 = time.perf_counter()
+        sim.run_to_completion(max_time=1e6)
+        jax.block_until_ready(sim.state.time)
+        elapsed = time.perf_counter() - t0
+
+        summary = sim.metrics_summary()
+        # Simulated trace events (node lifecycle + pod creations) plus
+        # scheduling decisions processed, the scalar throughput analog
+        # (reference: src/simulator.rs:363-368).
+        events = n_clusters * sim.n_events + summary["counters"]["scheduling_decisions"]
+        print(
+            json.dumps(
+                {
+                    "metric": (
+                        f"alibaba-v2017 synthetic replay, {n_clusters}x1313 nodes "
+                        "x ~107k pods, 1 simulated day, cluster-autoscaler on"
+                    ),
+                    "value": round(events / elapsed),
+                    "unit": "events/s",
+                    "replay_wall_clock_s": round(elapsed, 1),
+                    "build_s": round(build_s, 1),
+                    "pods_succeeded": summary["counters"]["pods_succeeded"],
+                    "scaled_up_nodes": summary["counters"]["total_scaled_up_nodes"],
+                }
+            )
+        )
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 1)

@@ -1,0 +1,114 @@
+"""End-to-end Alibaba replay on the batched path (VERDICT round-1 item 4):
+synthesized reference-format CSVs drive the native C++ feeder ->
+compile_from_arrays -> BatchedSimulation, with and without the cluster
+autoscaler, and the replay's terminal counters match the scalar oracle
+(flagship workload reference:
+src/trace/alibaba_cluster_trace_v2017/workload.rs:48-147,
+experiments/alibaba_demo.ipynb).
+"""
+
+import numpy as np
+import pytest
+
+from kubernetriks_tpu.cli import build_batched_simulation
+from kubernetriks_tpu.config import SimulationConfig
+from kubernetriks_tpu.sim.callbacks import RunUntilAllPodsAreFinishedCallbacks
+from kubernetriks_tpu.sim.simulator import KubernetriksSimulation
+from kubernetriks_tpu.test_util import DEFAULT_TEST_CONFIG_YAML
+from kubernetriks_tpu.trace.alibaba import (
+    AlibabaClusterTraceV2017,
+    AlibabaWorkloadTraceV2017,
+)
+from kubernetriks_tpu.trace.synthetic_alibaba import write_synthetic_trace_dir
+
+
+def _alibaba_config(machines, tasks, instances, extra="") -> SimulationConfig:
+    return SimulationConfig.from_yaml(
+        DEFAULT_TEST_CONFIG_YAML
+        + f"""
+trace_config:
+  alibaba_cluster_trace_v2017:
+    machine_events_trace_path: {machines}
+    batch_task_trace_path: {tasks}
+    batch_instance_trace_path: {instances}
+"""
+        + extra
+    )
+
+
+def test_alibaba_replay_batched_matches_scalar(tmp_path):
+    """Pure replay (no autoscalers): the batched path — built through the
+    CLI's native-feeder + compile_from_arrays fast path — must reproduce the
+    scalar oracle's terminal counters and duration stats."""
+    machines, tasks, instances = write_synthetic_trace_dir(
+        str(tmp_path), n_machines=100, n_tasks=700, horizon=4000.0, seed=7
+    )
+    config = _alibaba_config(machines, tasks, instances)
+
+    scalar = KubernetriksSimulation(config)
+    scalar.initialize(
+        AlibabaClusterTraceV2017.from_file(machines),
+        AlibabaWorkloadTraceV2017.from_files(instances, tasks),
+    )
+    scalar.run_with_callbacks(RunUntilAllPodsAreFinishedCallbacks())
+    sm = scalar.metrics_collector.accumulated_metrics
+
+    batched = build_batched_simulation(config, n_clusters=1)
+    batched.run_to_completion()
+    bm = batched.metrics_summary()
+
+    assert sm.pods_succeeded > 500
+    assert bm["counters"]["pods_succeeded"] == sm.pods_succeeded
+    assert bm["counters"]["terminated_pods"] == sm.internal.terminated_pods
+    assert bm["counters"]["processed_nodes"] == 100
+    best = bm["timings"]["pod_duration"]
+    assert best["min"] == pytest.approx(sm.pod_duration_stats.min(), rel=1e-5)
+    assert best["max"] == pytest.approx(sm.pod_duration_stats.max(), rel=1e-5)
+    assert best["mean"] == pytest.approx(sm.pod_duration_stats.mean(), rel=1e-4)
+
+
+def test_alibaba_replay_batched_with_cluster_autoscaler(tmp_path):
+    """Replay on an undersized cluster with machine failures and the CA
+    enabled: unscheduled pods trigger scale-ups, failed machines trigger
+    reschedules, and every pod still terminates."""
+    machines, tasks, instances = write_synthetic_trace_dir(
+        str(tmp_path),
+        n_machines=6,
+        n_tasks=150,
+        horizon=3000.0,
+        error_fraction=0.3,
+        seed=11,
+    )
+    config = _alibaba_config(
+        machines,
+        tasks,
+        instances,
+        extra="""
+cluster_autoscaler:
+  enabled: true
+  scan_interval: 10.0
+  max_node_count: 64
+  node_groups:
+  - node_template:
+      metadata:
+        name: alibaba_ca_node
+      status:
+        capacity:
+          cpu: 64000
+          ram: 94489280512
+""",
+    )
+
+    batched = build_batched_simulation(config, n_clusters=2)
+    batched.run_to_completion(max_time=1e6)
+    bm = batched.metrics_summary()
+
+    n_pods = batched.n_pods
+    assert bm["counters"]["total_scaled_up_nodes"] > 0
+    # Every instance terminates (succeeded; none are removed in this trace).
+    assert bm["counters"]["pods_succeeded"] == 2 * n_pods
+    assert bm["counters"]["terminated_pods"] == 2 * n_pods
+    # Homogeneous batch: both clusters behaved identically.
+    assert batched.cluster_metrics(0) == batched.cluster_metrics(1)
+    # Machine failures actually happened (removals + CA churn).
+    assert np.asarray(batched.state.nodes.alive).sum() < 2 * batched.n_nodes

@@ -253,3 +253,42 @@ def test_batched_hpa_ring_survives_many_load_cycles():
     assert min(late) == 2, samples
     counters = sim.metrics_summary()["counters"]
     assert counters["total_scaled_up_pods"] > 14 * N_CLUSTERS  # > reserve
+
+
+def test_batched_gauge_time_series(tmp_path):
+    """Per-window gauge collection (batched analog of the scalar 5 s gauge
+    CSV cycle, reference: src/metrics/collector.rs:216-228): node/pod counts
+    and utilizations track the known HPA scenario, and the CSV dump follows
+    the scalar 8-column schema."""
+    import csv
+
+    from kubernetriks_tpu.metrics.collector import GAUGE_CSV_COLUMNS
+    from kubernetriks_tpu.test_util import default_test_simulation_config
+
+    config = default_test_simulation_config()
+    config.horizontal_pod_autoscaler.enabled = True
+    sim = _build(config, CLUSTER_TRACE, WORKLOAD_TRACE)
+    sim.collect_gauges = True
+    sim.step_until_time(700.0)
+
+    times, samples = sim.gauge_series()
+    assert times.shape[0] == samples.shape[0] == 71  # windows 0..700
+    assert samples.shape[1:] == (N_CLUSTERS, 7)
+    # Nodes appear at t=5 -> every window from 1 on sees them alive.
+    assert (samples[1:, :, 0] == samples[1, 0, 0]).all()
+    assert samples[0, 0, 0] == 0
+    # While replicas run, cluster cpu utilization is positive and <= 1.
+    mid = samples[20, 0]
+    assert 0.0 < mid[5] <= 1.0
+    assert 0.0 <= mid[3] <= 1.0
+    # Pod counts track the HPA trajectory (group created t=59.5, initial 5
+    # replicas running shortly after).
+    assert samples[10, 0, 1] >= 5
+
+    out = tmp_path / "gauges.csv"
+    sim.write_gauge_csv(str(out))
+    with open(out) as f:
+        rows = list(csv.reader(f))
+    assert rows[0] == GAUGE_CSV_COLUMNS
+    assert len(rows) == 72
+    assert float(rows[2][0]) == 10.0  # timestamp column in seconds

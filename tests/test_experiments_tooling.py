@@ -1,0 +1,71 @@
+"""Offline tooling (experiments/): trace preprocessing filters and gauge
+plotting — script ports of the reference notebooks
+(experiments/{modify_traces,alibaba_demo}.ipynb)."""
+
+import csv
+import importlib.util
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(ROOT, "experiments", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_add_only_and_fit_only_filters(tmp_path):
+    mt = _load("modify_traces")
+    machines = tmp_path / "machine_events.csv"
+    machines.write_text(
+        "0,0,add,,64,0.7\n"
+        "0,1,add,,16,0.1\n"
+        "500,1,softerror,,,\n"
+    )
+    add_only = tmp_path / "add_only.csv"
+    assert mt.filter_add_only(str(machines), str(add_only)) == 2
+
+    tasks = tmp_path / "batch_task.csv"
+    tasks.write_text(
+        # fits the 64-core machine (32 cores, mem 0.5)
+        "10,100,1,1,1,Terminated,3200,0.5\n"
+        # too many cores (80 > 64)
+        "10,100,1,2,1,Terminated,8000,0.1\n"
+        # cpu fits the small machine but memory only fits the big one -> keep
+        "10,100,1,3,1,Terminated,1000,0.6\n"
+        # memory fits nothing
+        "10,100,1,4,1,Terminated,1000,0.9\n"
+        # missing resources -> dropped
+        "10,100,1,5,1,Terminated,,\n"
+    )
+    fit_only = tmp_path / "fit_only.csv"
+    assert mt.filter_fit_only(str(add_only), str(tasks), str(fit_only)) == 2
+    kept = [row for row in csv.reader(open(fit_only))]
+    assert [r[3] for r in kept] == ["1", "3"]
+
+    stats = mt.analyze(str(fit_only))
+    assert stats["tasks"] == 2 and stats["instances"] == 2
+
+
+def test_plot_gauges_renders_png(tmp_path):
+    pg = _load("plot_gauges")
+    gauge_csv = tmp_path / "gauges.csv"
+    with open(gauge_csv, "w", newline="") as f:
+        writer = csv.writer(f)
+        writer.writerow(
+            ["timestamp", "current_nodes", "current_pods",
+             "pods_in_scheduling_queues", "node_average_cpu_utilization",
+             "node_average_ram_utilization", "cluster_total_cpu_utilization",
+             "cluster_total_ram_utilization"]
+        )
+        for t in range(0, 200, 5):
+            writer.writerow([t, 4, t % 7, t % 3, 0.5, 0.25, 0.4, 0.2])
+    out = tmp_path / "out.png"
+    pg.plot(str(gauge_csv), str(out))
+    assert out.exists() and out.stat().st_size > 10000
