@@ -4,8 +4,8 @@ experiments/modify_traces.ipynb + trace_analysis.ipynb).
 Subcommands:
   add-only   machine_events.csv -> add-events-only cluster trace
              (modify_traces.ipynb cell 2: drops softerror/harderror rows)
-  fit-only   batch_task.csv filtered to tasks with cpus <= --max-cpus that fit
-             on at least one machine of the add-only cluster trace
+  fit-only   batch_task.csv filtered to tasks with cpus <= --max-cores that
+             fit on at least one machine of the add-only cluster trace
              (modify_traces.ipynb cell 5); columns pass through unchanged
   analyze    row/instance counts and basic stats for a workload
              (trace_analysis.ipynb)

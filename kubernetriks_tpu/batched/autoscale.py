@@ -25,9 +25,17 @@ identity of scaled-down members may differ):
   (kube_horizontal_pod_autoscaler.rs:197-205 pops a BTreeSet). Utilization is
   count-based, so trajectories are unaffected.
 - CA decisions read state at the window boundary instead of at the simulated
-  storage-snapshot time (a sub-window skew), and re-arm on a fixed cadence
-  (the scalar path re-arms with delay 0 after an overrun cycle,
-  cluster_autoscaler.rs:256-262).
+  storage-snapshot time (a sub-window skew), and re-arm on a fixed cadence.
+  The scalar path re-arms with delay 0 when the info round-trip
+  (2 x as_to_ca + processing) exceeds scan_interval
+  (cluster_autoscaler.rs:256-262), i.e. it degrades to back-to-back cycles;
+  the batched path ticks at every due window, which IS the back-to-back
+  cadence at window granularity (a cycle can never run more than once per
+  window on either path, since decisions only change at window boundaries
+  here). With the default delays (round-trip 1.34 s << 10 s scan interval)
+  the branch never triggers, so the fixed cadence is exact; under overrun
+  configs both paths converge to one cycle per window and differ only in
+  sub-window effect timing, which the pending-effect arrays already carry.
 - Scale-up considers at most K_up cache pods and scale-down at most K_sd pods
   per candidate node per cycle; overflow is deferred to the next cycle
   (scale-up) or conservatively skipped (scale-down).
@@ -475,9 +483,18 @@ def _ca_scale_down(
     )
     col_k = jnp.arange(K_sd, dtype=jnp.int32)[None, :]
 
-    def outer(carry, xs):
+    # Only CA slots that were ever allocated (cursor-bounded per group) can
+    # hold a node; iterate just those. Before the first scale-up this loop
+    # runs ZERO iterations — the common case on healthy clusters.
+    s_used = jnp.max(
+        jnp.where(auto.ca_cursor > 0, st.ng_ca_start + auto.ca_cursor, 0)
+    ).astype(jnp.int32)
+    s_used = jnp.minimum(s_used, jnp.int32(S))
+
+    def outer(carry, s):
         valloc_cpu, valloc_ram = carry
-        slot, group = xs  # (C,) global node slot / owning group of this CA slot
+        # (C,) global node slot of CA slot s.
+        slot = jax.lax.dynamic_index_in_dim(st.ca_slots, s, 1, keepdims=False)
         slot_ok = (slot >= 0) & branch
         slotc = jnp.clip(slot, 0, N - 1)
         alive_here = nodes.alive[rows1, slotc] & slot_ok
@@ -543,14 +560,24 @@ def _ca_scale_down(
         # (reference :141-156); commits persist across later candidates.
         valloc_cpu = jnp.where(success[:, None], vcpu, save_cpu)
         valloc_ram = jnp.where(success[:, None], vram, save_ram)
-        return (valloc_cpu, valloc_ram), success
+        return valloc_cpu, valloc_ram, success
 
-    (_, _), removed_t = jax.lax.scan(
-        outer,
-        (nodes.alloc_cpu, nodes.alloc_ram),
-        (st.ca_slots.T, st.ca_slot_group.T),
+    def loop_body(carry):
+        s, valloc_cpu, valloc_ram, removed = carry
+        valloc_cpu, valloc_ram, success = outer((valloc_cpu, valloc_ram), s)
+        removed = removed.at[:, s].set(success)
+        return (s + jnp.int32(1), valloc_cpu, valloc_ram, removed)
+
+    _, _, _, removed = jax.lax.while_loop(
+        lambda carry: carry[0] < s_used,
+        loop_body,
+        (
+            jnp.int32(0),
+            nodes.alloc_cpu,
+            nodes.alloc_ram,
+            jnp.zeros((C, S), bool),
+        ),
     )
-    removed = removed_t.T  # (C, S)
     group_c = jnp.where(removed, st.ca_slot_group, Gn)
     removed_per_group = (
         jnp.zeros((C, Gn + 1), jnp.int32)
@@ -585,8 +612,22 @@ def ca_pass(
     up_branch = due & any_unsched
     down_branch = due & ~any_unsched
 
-    planned, planned_per_group = _ca_scale_up(state, auto, st, up_branch, K_up)
-    removed, removed_per_group = _ca_scale_down(state, auto, st, down_branch, K_sd)
+    # Branch around the whole pass bodies: most windows have an empty
+    # unscheduled cache (no scale-up work) and scale-down's pod grouping
+    # ((C, P) sort) only matters once CA nodes exist. The predicates reduce
+    # to replicated scalars, so the conds hold under a C-sharded mesh.
+    S = st.ca_slots.shape[1]
+    Gn = st.ng_ca_start.shape[1]
+    planned, planned_per_group = jax.lax.cond(
+        up_branch.any(),
+        lambda: _ca_scale_up(state, auto, st, up_branch, K_up),
+        lambda: (jnp.zeros((C, S), bool), jnp.zeros((C, Gn), jnp.int32)),
+    )
+    removed, removed_per_group = jax.lax.cond(
+        down_branch.any() & (auto.ca_cursor.sum() > 0),
+        lambda: _ca_scale_down(state, auto, st, down_branch, K_sd),
+        lambda: (jnp.zeros((C, S), bool), jnp.zeros((C, Gn), jnp.int32)),
+    )
 
     # Planned slots come alive at their effect time; removals likewise. The
     # effect-time value is one (C,) pair — scatter a boolean touch mask (fast

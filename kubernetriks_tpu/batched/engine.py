@@ -366,6 +366,12 @@ class BatchedSimulation:
         self.collect_gauges = False
         self._gauge_windows: list = []
         self._gauge_samples: list = []
+        # Profiling hooks: set profile_dir to capture a jax.profiler trace of
+        # every step_until_time dispatch; set log_throughput for a per-chunk
+        # decisions/s + cluster-windows/s log line (TPU analog of the scalar
+        # events/s log, reference: src/simulator.rs:363-368).
+        self.profile_dir: Optional[str] = None
+        self.log_throughput = False
 
         self.mesh = mesh
         if mesh is not None:
@@ -436,10 +442,9 @@ class BatchedSimulation:
         count = int(math.floor(until_time / interval)) - first + 1
         return first + np.arange(max(count, 0), dtype=np.int32)
 
-    def step_until_time(self, until_time: float) -> None:
-        idxs = self.window_idxs(until_time)
-        if len(idxs) == 0:
-            return
+    def _dispatch_windows(self, idxs: np.ndarray) -> None:
+        """Run one chunk of windows and fold the results into self.state
+        (+ gauge accumulation); the single run_windows call site."""
         out = run_windows(
             self.state,
             self.slab,
@@ -462,6 +467,49 @@ class BatchedSimulation:
         else:
             self.state = out
         self.next_window_idx = int(idxs[-1]) + 1
+
+    def step_until_time(self, until_time: float) -> None:
+        idxs = self.window_idxs(until_time)
+        if len(idxs) == 0:
+            return
+        if not (self.profile_dir or self.log_throughput):
+            self._dispatch_windows(idxs)
+            return
+
+        # Instrumented path: optional jax.profiler capture + a per-chunk
+        # decisions/s log line (TPU analog of the scalar events/s log,
+        # reference: src/simulator.rs:363-368).
+        import contextlib
+        import logging
+        import time
+
+        ctx = (
+            jax.profiler.trace(self.profile_dir)
+            if self.profile_dir
+            else contextlib.nullcontext()
+        )
+        before = (
+            int(np.asarray(self.state.metrics.scheduling_decisions).sum())
+            if self.log_throughput
+            else 0
+        )
+        t0 = time.perf_counter()
+        with ctx:
+            self._dispatch_windows(idxs)
+            jax.block_until_ready(self.state.time)
+        elapsed = time.perf_counter() - t0
+        if self.log_throughput:
+            decisions = (
+                int(np.asarray(self.state.metrics.scheduling_decisions).sum()) - before
+            )
+            cluster_windows = len(idxs) * self.n_clusters
+            logging.getLogger(__name__).info(
+                "chunk of %d windows in %.3fs: %.0f decisions/s, "
+                "%.0f cluster-windows/s",
+                len(idxs), elapsed,
+                decisions / max(elapsed, 1e-9),
+                cluster_windows / max(elapsed, 1e-9),
+            )
 
     def step_window(self) -> None:
         """Advance a single scheduling cycle (useful for tests)."""

@@ -163,6 +163,8 @@ def policy_cycle(
         "max_pods_per_cycle",
         "greedy",
         "conditional_move",
+        "max_ca_pods_per_cycle",
+        "max_pods_per_scale_down",
     ),
 )
 def rollout(
@@ -177,9 +179,14 @@ def rollout(
     max_pods_per_cycle: int,
     greedy: bool = False,
     conditional_move: bool = False,
+    autoscale_statics=None,
+    max_ca_pods_per_cycle: int = 64,
+    max_pods_per_scale_down: int = 8,
 ) -> Tuple[ClusterBatchState, Transition]:
     """Scan scheduling windows (int32 indices) under the policy; transitions
-    stacked (W, K, C, ...)."""
+    stacked (W, K, C, ...). With autoscale_statics, the HPA/CA passes run
+    after each policy cycle exactly as on the kube-scheduler path, so the
+    policy trains against autoscaler-driven dynamics."""
 
     def body(carry, w):
         st, rng = carry
@@ -192,6 +199,16 @@ def rollout(
             st, w_arr, consts, max_pods_per_cycle, policy_apply, params, sub,
             greedy=greedy, conditional_move=conditional_move,
         )
+        if autoscale_statics is not None:
+            from kubernetriks_tpu.batched.autoscale import ca_pass, hpa_pass
+
+            auto = st.auto
+            st, auto = hpa_pass(st, auto, autoscale_statics, w_arr, consts)
+            st, auto = ca_pass(
+                st, auto, autoscale_statics, w_arr, consts,
+                max_ca_pods_per_cycle, max_pods_per_scale_down,
+            )
+            st = st._replace(auto=auto)
         return (st, rng), transition
 
     (state, _), transitions = jax.lax.scan(

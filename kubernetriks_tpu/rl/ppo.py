@@ -151,10 +151,6 @@ class PPOTrainer:
         hidden: int = 64,
         seed: int = 0,
     ) -> None:
-        assert sim.autoscale_statics is None, (
-            "PPOTrainer rollouts do not yet run the HPA/CA passes; train "
-            "against a simulation with autoscaling disabled"
-        )
         self.sim = sim
         self.config = config
         self.windows = np.arange(windows_per_rollout, dtype=np.int32)
@@ -181,6 +177,9 @@ class PPOTrainer:
             self.sim.max_pods_per_cycle,
             greedy=greedy,
             conditional_move=self.sim.conditional_move,
+            autoscale_statics=self.sim.autoscale_statics,
+            max_ca_pods_per_cycle=self.sim.max_ca_pods_per_cycle,
+            max_pods_per_scale_down=self.sim.max_pods_per_scale_down,
         )
         # (W, K, C, ...) -> (W*K, C, ...) decision-ordered sequence.
         flat = jax.tree.map(

@@ -205,8 +205,16 @@ Handle* feeder_parse_workload(const char* instance_path,
     if (row.fields.size() < 6) {
       return Fail(h, "batch_task row has fewer than 6 fields: " + line);
     }
-    int64_t tid;
-    if (!ParseI64(row.fields[3], &tid, &err, "batch_task.task_id"))
+    // Field-validation parity with the Python parser (trace/alibaba.py
+    // BatchTask.from_row): the required integer columns must parse even
+    // though the simulation never reads them, so malformed traces are
+    // rejected identically whichever parser handled them.
+    int64_t tid, ignored;
+    if (!ParseI64(row.fields[0], &ignored, &err, "batch_task.task_create_time") ||
+        !ParseI64(row.fields[1], &ignored, &err, "batch_task.task_end_time") ||
+        !ParseI64(row.fields[2], &ignored, &err, "batch_task.job_id") ||
+        !ParseI64(row.fields[3], &tid, &err, "batch_task.task_id") ||
+        !ParseI64(row.fields[4], &ignored, &err, "batch_task.number_of_instances"))
       return Fail(h, err);
     TaskInfo info;
     if (row.fields.size() > 6 &&
@@ -233,10 +241,17 @@ Handle* feeder_parse_workload(const char* instance_path,
       return Fail(h, "batch_instance row has fewer than 8 fields: " + line);
     }
     OptI64 start, end, jid, tid;
+    int64_t seq_ignored;
     if (!ParseOptI64(row.fields[0], &start, &err, "batch_instance.start_ts") ||
         !ParseOptI64(row.fields[1], &end, &err, "batch_instance.end_ts") ||
         !ParseOptI64(row.fields[2], &jid, &err, "batch_instance.job_id") ||
-        !ParseOptI64(row.fields[3], &tid, &err, "batch_instance.task_id"))
+        !ParseOptI64(row.fields[3], &tid, &err, "batch_instance.task_id") ||
+        // Required integer columns the simulation never reads — validated
+        // for parity with the Python parser (BatchInstance.from_row).
+        !ParseI64(row.fields[6], &seq_ignored, &err,
+                  "batch_instance.sequence_number") ||
+        !ParseI64(row.fields[7], &seq_ignored, &err,
+                  "batch_instance.total_sequence_number"))
       return Fail(h, err);
 
     // Validity filter, in the reference's order (workload.rs:56-120).

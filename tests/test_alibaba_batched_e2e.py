@@ -71,13 +71,22 @@ def test_alibaba_replay_batched_with_cluster_autoscaler(tmp_path):
     """Replay on an undersized cluster with machine failures and the CA
     enabled: unscheduled pods trigger scale-ups, failed machines trigger
     reschedules, and every pod still terminates."""
-    machines, tasks, instances = write_synthetic_trace_dir(
-        str(tmp_path),
-        n_machines=6,
-        n_tasks=150,
-        horizon=3000.0,
-        error_fraction=0.3,
-        seed=11,
+    from kubernetriks_tpu.trace.synthetic_alibaba import (
+        write_batch_workload,
+        write_machine_events,
+    )
+
+    machines = str(tmp_path / "machine_events.csv")
+    tasks = str(tmp_path / "batch_task.csv")
+    instances = str(tmp_path / "batch_instance.csv")
+    write_machine_events(
+        machines, n_machines=6, error_fraction=0.3, horizon=3000.0, seed=11
+    )
+    # Heavy tasks (16-64 cores) against six machines: guaranteed contention
+    # so the CA has unscheduled pods to act on.
+    write_batch_workload(
+        tasks, instances, n_tasks=150, horizon=3000.0,
+        cpu_santicores_range=(1600, 6400), heavy_fraction=0.0, seed=12,
     )
     config = _alibaba_config(
         machines,

@@ -54,3 +54,31 @@ def test_sharded_run_matches_unsharded(mesh):
         rtol=1e-6,
     )
     assert sharded.metrics_summary()["counters"]["pods_succeeded"] == 16 * len(pod_names)
+
+
+def test_profiling_hooks(tmp_path, caplog):
+    """profile_dir captures a jax.profiler trace; log_throughput emits the
+    per-chunk decisions/s line (TPU analog of the scalar events/s log,
+    reference: src/simulator.rs:363-368)."""
+    import logging
+    import os
+
+    from kubernetriks_tpu.test_util import default_test_simulation_config
+
+    config = default_test_simulation_config()
+    workload_yaml, _ = make_workload()
+    sim = build_batched_from_traces(
+        config,
+        GenericClusterTrace.from_yaml(CLUSTER_YAML).convert_to_simulator_events(),
+        GenericWorkloadTrace.from_yaml(workload_yaml).convert_to_simulator_events(),
+        n_clusters=4,
+    )
+    sim.profile_dir = str(tmp_path / "trace")
+    sim.log_throughput = True
+    with caplog.at_level(logging.INFO, logger="kubernetriks_tpu.batched.engine"):
+        sim.step_until_time(100.0)
+    assert any("decisions/s" in rec.message for rec in caplog.records)
+    dumped = []
+    for root, _, files in os.walk(tmp_path / "trace"):
+        dumped.extend(files)
+    assert dumped, "profiler trace directory is empty"

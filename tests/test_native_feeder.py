@@ -287,3 +287,30 @@ def test_same_tick_create_remove_with_asymmetric_shifts(tmp_path):
     np.testing.assert_array_equal(fast.ev_time, slow.ev_time)
     np.testing.assert_array_equal(fast.ev_kind, slow.ev_kind)
     np.testing.assert_array_equal(fast.ev_slot, slow.ev_slot)
+
+
+def test_native_rejects_malformed_required_fields(tmp_path):
+    """Field-validation parity (ADVICE r1): the native parser must reject the
+    same malformed rows the Python parser raises on, even for columns the
+    simulation never reads."""
+    import pytest
+
+    from kubernetriks_tpu.trace import feeder
+
+    if not feeder.native_available():
+        pytest.skip("no native toolchain")
+
+    tasks = tmp_path / "batch_task.csv"
+    instances = tmp_path / "batch_instance.csv"
+
+    # Garbage in batch_task.number_of_instances (field 4).
+    tasks.write_text("10,100,1,7,garbage,Terminated,100,0.5\n")
+    instances.write_text("10,100,1,7,0,Terminated,0,1\n")
+    with pytest.raises(ValueError, match="number_of_instances"):
+        feeder.load_workload_arrays(str(instances), str(tasks))
+
+    # Garbage in batch_instance.sequence_number (field 6).
+    tasks.write_text("10,100,1,7,1,Terminated,100,0.5\n")
+    instances.write_text("10,100,1,7,0,Terminated,oops,1\n")
+    with pytest.raises(ValueError, match="sequence_number"):
+        feeder.load_workload_arrays(str(instances), str(tasks))

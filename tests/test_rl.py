@@ -106,3 +106,77 @@ def test_ppo_training_runs_and_is_finite():
         not np.allclose(np.asarray(a), np.asarray(b))
         for a, b in zip(leaves_before, leaves_after)
     )
+
+
+def make_autoscaled_sim(n_clusters=4):
+    """Undersized cluster + CA and an HPA pod group: the policy trains
+    against autoscaler-driven dynamics (scaled-up nodes appearing, group
+    replicas churning)."""
+    config = SimulationConfig.from_yaml(
+        """
+sim_name: rl_autoscaled
+seed: 1
+scheduling_cycle_interval: 10.0
+as_to_ps_network_delay: 0.050
+ps_to_sched_network_delay: 0.010
+sched_to_as_network_delay: 0.020
+as_to_node_network_delay: 0.150
+as_to_ca_network_delay: 0.30
+as_to_hpa_network_delay: 0.40
+cluster_autoscaler:
+  enabled: true
+  scan_interval: 10.0
+  max_node_count: 6
+  node_groups:
+  - node_template:
+      metadata:
+        name: ca_node
+      status:
+        capacity:
+          cpu: 16000
+          ram: 34359738368
+"""
+    )
+    cluster = UniformClusterTrace(2, cpu=8000, ram=16 * 1024**3)
+    workload = PoissonWorkloadTrace(
+        rate_per_second=0.5,
+        horizon=200.0,
+        seed=7,
+        cpu=6000,
+        ram=12 * 1024**3,
+        duration_range=(20.0, 60.0),
+    )
+    return build_batched_from_traces(
+        config,
+        cluster.convert_to_simulator_events(),
+        workload.convert_to_simulator_events(),
+        n_clusters=n_clusters,
+        max_pods_per_cycle=8,
+    )
+
+
+def test_ppo_trains_against_autoscalers():
+    """VERDICT round-1 item 5: the HPA/CA passes run inside the rollout. The
+    undersized cluster forces parking; the CA scales nodes up mid-rollout and
+    the policy sees (and places onto) the new nodes."""
+    sim = make_autoscaled_sim()
+    assert sim.autoscale_statics is not None
+    trainer = PPOTrainer(
+        sim,
+        windows_per_rollout=16,
+        config=PPOConfig(epochs_per_iteration=2, learning_rate=1e-3),
+    )
+    final_state, flat = trainer.collect()
+    # The CA acted during the rollout.
+    scaled_up = int(np.asarray(final_state.metrics.scaled_up_nodes).sum())
+    assert scaled_up > 0
+    # Decisions happened on CA-provisioned node slots (slots >= trace nodes).
+    action = np.asarray(flat.action)
+    valid = np.asarray(flat.valid)
+    obs = np.asarray(flat.obs)
+    placed = valid & (obs[..., 1] > 0).any(axis=-1)
+    assert (action[placed] >= 2).any(), "no placement on a scaled-up node"
+    # A full training iteration is finite.
+    result = trainer.train_iteration()
+    assert np.isfinite(result["policy_loss"])
+    assert result["placements"] > 0
