@@ -13,6 +13,7 @@ reduction at readout is the only communication).
 from __future__ import annotations
 
 import math
+import os
 from typing import Dict, Optional, Sequence
 
 import jax
@@ -628,6 +629,53 @@ class BatchedSimulation:
         auto = self.state.auto
         assert auto is not None, "autoscaling is not enabled"
         return np.asarray(auto.ca_count[cluster])
+
+    # --- checkpoint / resume ------------------------------------------------
+    # The whole simulation state is one pytree of arrays, so checkpointing is
+    # a direct orbax save (SURVEY §5.4: absent in the reference — runs are
+    # seed+config+trace — but cheap here and useful for long RL training).
+
+    def _ckpt_payload(self):
+        return {
+            "state": self.state,
+            "next_window_idx": jnp.asarray(self.next_window_idx, jnp.int32),
+        }
+
+    def save_checkpoint(self, path: str) -> None:
+        """Persist the device state + window cursor to an orbax checkpoint
+        directory (overwrites), and the accumulated gauge series — whose
+        length is run-dependent, unlike the fixed-shape state pytree — to a
+        numpy sidecar next to it."""
+        from kubernetriks_tpu.checkpoint import ckpt_save
+
+        ckpt_save(path, self._ckpt_payload())
+        if self._gauge_windows:
+            np.savez(
+                os.path.abspath(path) + ".gauges.npz",
+                windows=np.concatenate(self._gauge_windows).astype(np.int32),
+                samples=np.concatenate(self._gauge_samples, axis=0).astype(
+                    np.float32
+                ),
+            )
+
+    def load_checkpoint(self, path: str) -> None:
+        """Restore state saved by save_checkpoint into this simulation (which
+        must have been built from the same config/traces — the current state
+        pytree provides the restore structure). Restored arrays land
+        unsharded; re-apply device placement for mesh runs if needed."""
+        from kubernetriks_tpu.checkpoint import ckpt_restore
+
+        restored = ckpt_restore(path, self._ckpt_payload())
+        self.state = restored["state"]
+        self.next_window_idx = int(restored["next_window_idx"])
+        sidecar = os.path.abspath(path) + ".gauges.npz"
+        if os.path.exists(sidecar):
+            data = np.load(sidecar)
+            self._gauge_windows = [data["windows"]]
+            self._gauge_samples = [data["samples"]]
+        else:
+            self._gauge_windows = []
+            self._gauge_samples = []
 
     def gauge_series(self):
         """(times (W,), samples (W, C, 7)) accumulated gauge time-series;

@@ -1,0 +1,28 @@
+"""Shared orbax checkpoint helpers (SURVEY §5.4: checkpointing is absent in
+the reference — a run is seed+config+trace — but every stateful object here
+is a pytree of arrays, so persistence is one save/restore pair)."""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def ckpt_save(path: str, payload) -> None:
+    """Save a pytree of arrays to an orbax checkpoint directory (overwrites)."""
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.abspath(path), payload, force=True)
+    ckptr.wait_until_finished()
+
+
+def ckpt_restore(path: str, template):
+    """Restore a pytree saved by ckpt_save; `template` (a live pytree of the
+    same structure) provides the shapes/dtypes."""
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.StandardCheckpointer()
+    abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
+    return ckptr.restore(os.path.abspath(path), abstract)

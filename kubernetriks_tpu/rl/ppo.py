@@ -163,6 +163,29 @@ class PPOTrainer:
         self.opt_state = self.optimizer.init(self.params)
         self.initial_state = sim.state
 
+    def save_checkpoint(self, path: str) -> None:
+        """Persist policy params, optimizer state and the rollout RNG (the
+        simulator side is re-derivable from config+traces; checkpoint it
+        separately via BatchedSimulation.save_checkpoint if mid-rollout
+        state matters)."""
+        from kubernetriks_tpu.checkpoint import ckpt_save
+
+        ckpt_save(
+            path,
+            {"params": self.params, "opt_state": self.opt_state, "rng": self.rng},
+        )
+
+    def load_checkpoint(self, path: str) -> None:
+        from kubernetriks_tpu.checkpoint import ckpt_restore
+
+        restored = ckpt_restore(
+            path,
+            {"params": self.params, "opt_state": self.opt_state, "rng": self.rng},
+        )
+        self.params = restored["params"]
+        self.opt_state = restored["opt_state"]
+        self.rng = restored["rng"]
+
     def collect(self, greedy: bool = False):
         self.rng, sub = jax.random.split(self.rng)
         final_state, transitions = rollout(

@@ -240,14 +240,17 @@ Handle* feeder_parse_workload(const char* instance_path,
     if (row.fields.size() < 8) {
       return Fail(h, "batch_instance row has fewer than 8 fields: " + line);
     }
-    OptI64 start, end, jid, tid;
+    OptI64 start, end, jid, tid, mid_ignored;
     int64_t seq_ignored;
     if (!ParseOptI64(row.fields[0], &start, &err, "batch_instance.start_ts") ||
         !ParseOptI64(row.fields[1], &end, &err, "batch_instance.end_ts") ||
         !ParseOptI64(row.fields[2], &jid, &err, "batch_instance.job_id") ||
         !ParseOptI64(row.fields[3], &tid, &err, "batch_instance.task_id") ||
-        // Required integer columns the simulation never reads — validated
-        // for parity with the Python parser (BatchInstance.from_row).
+        // Columns the simulation never reads — validated for parity with the
+        // Python parser (BatchInstance.from_row: machine_id is optional-int,
+        // sequence numbers are required-int).
+        !ParseOptI64(row.fields[4], &mid_ignored, &err,
+                     "batch_instance.machine_id") ||
         !ParseI64(row.fields[6], &seq_ignored, &err,
                   "batch_instance.sequence_number") ||
         !ParseI64(row.fields[7], &seq_ignored, &err,

@@ -423,3 +423,61 @@ def test_larger_batch_replicates_cluster_zero():
     for c in range(1, 8):
         assert batched.cluster_metrics(c) == base
     assert base["pods_succeeded"] == len(pod_names)
+
+
+def test_checkpoint_resume_bit_identical(tmp_path):
+    """save_checkpoint mid-run + load_checkpoint into a fresh build resumes
+    bit-identically: the full state is one pytree (SURVEY §5.4)."""
+    import jax
+
+    config = default_test_simulation_config()
+    workload_yaml, _ = make_workload()
+
+    straight = run_batched(config, CLUSTER_YAML, workload_yaml, 2000.0)
+
+    half = run_batched(config, CLUSTER_YAML, workload_yaml, 990.0)
+    half.save_checkpoint(str(tmp_path / "ckpt"))
+
+    resumed = build_batched_from_traces(
+        config,
+        GenericClusterTrace.from_yaml(CLUSTER_YAML).convert_to_simulator_events(),
+        GenericWorkloadTrace.from_yaml(workload_yaml).convert_to_simulator_events(),
+        n_clusters=1,
+    )
+    resumed.load_checkpoint(str(tmp_path / "ckpt"))
+    assert resumed.next_window == 1000.0
+    resumed.step_until_time(2000.0)
+
+    flat_a, _ = jax.tree_util.tree_flatten_with_path(straight.state)
+    flat_b, _ = jax.tree_util.tree_flatten_with_path(resumed.state)
+    for (path, a), (_, b) in zip(flat_a, flat_b):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b), err_msg=jax.tree_util.keystr(path)
+        )
+
+
+def test_checkpoint_preserves_gauge_series(tmp_path):
+    config = default_test_simulation_config()
+    workload_yaml, _ = make_workload()
+    sim = build_batched_from_traces(
+        config,
+        GenericClusterTrace.from_yaml(CLUSTER_YAML).convert_to_simulator_events(),
+        GenericWorkloadTrace.from_yaml(workload_yaml).convert_to_simulator_events(),
+        n_clusters=1,
+    )
+    sim.collect_gauges = True
+    sim.step_until_time(490.0)
+    sim.save_checkpoint(str(tmp_path / "g_ckpt"))
+
+    resumed = build_batched_from_traces(
+        config,
+        GenericClusterTrace.from_yaml(CLUSTER_YAML).convert_to_simulator_events(),
+        GenericWorkloadTrace.from_yaml(workload_yaml).convert_to_simulator_events(),
+        n_clusters=1,
+    )
+    resumed.collect_gauges = True
+    resumed.load_checkpoint(str(tmp_path / "g_ckpt"))
+    resumed.step_until_time(700.0)
+    times, samples = resumed.gauge_series()
+    assert times[0] == 0.0 and times[-1] == 700.0  # no pre-checkpoint hole
+    assert samples.shape[0] == len(times) == 71

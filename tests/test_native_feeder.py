@@ -314,3 +314,18 @@ def test_native_rejects_malformed_required_fields(tmp_path):
     instances.write_text("10,100,1,7,0,Terminated,oops,1\n")
     with pytest.raises(ValueError, match="sequence_number"):
         feeder.load_workload_arrays(str(instances), str(tasks))
+
+
+def test_native_rejects_malformed_machine_id(tmp_path):
+    import pytest
+
+    from kubernetriks_tpu.trace import feeder
+
+    if not feeder.native_available():
+        pytest.skip("no native toolchain")
+    tasks = tmp_path / "batch_task.csv"
+    instances = tmp_path / "batch_instance.csv"
+    tasks.write_text("10,100,1,7,1,Terminated,100,0.5\n")
+    instances.write_text("10,100,1,7,garbage,Terminated,0,1\n")
+    with pytest.raises(ValueError, match="machine_id"):
+        feeder.load_workload_arrays(str(instances), str(tasks))

@@ -180,3 +180,18 @@ def test_ppo_trains_against_autoscalers():
     result = trainer.train_iteration()
     assert np.isfinite(result["policy_loss"])
     assert result["placements"] > 0
+
+
+def test_ppo_checkpoint_roundtrip(tmp_path):
+    sim = make_sim()
+    trainer = PPOTrainer(sim, windows_per_rollout=4)
+    trainer.train_iteration()
+    trainer.save_checkpoint(str(tmp_path / "rl_ckpt"))
+
+    fresh = PPOTrainer(make_sim(), windows_per_rollout=4, seed=999)
+    fresh.load_checkpoint(str(tmp_path / "rl_ckpt"))
+    for a, b in zip(jax.tree.leaves(trainer.params), jax.tree.leaves(fresh.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # Resumed training continues finitely.
+    out = fresh.train_iteration()
+    assert np.isfinite(out["policy_loss"])
