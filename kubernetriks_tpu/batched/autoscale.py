@@ -178,6 +178,23 @@ def hpa_pass(
     """One masked HPA cycle at window W for every due cluster
     (scalar equivalent: horizontal_pod_autoscaler.py run cycle +
     kube_horizontal_pod_autoscaler.py formula)."""
+    due_any = t_le(
+        auto.hpa_next, TPair(win=W, off=jnp.zeros_like(auto.hpa_next.off))
+    ).any()
+    return jax.lax.cond(
+        due_any,
+        lambda: _hpa_pass_body(state, auto, st, W, consts),
+        lambda: (state, auto),
+    )
+
+
+def _hpa_pass_body(
+    state: ClusterBatchState,
+    auto: AutoscaleState,
+    st: AutoscaleStatics,
+    W: jnp.ndarray,
+    consts: StepConstants,
+) -> Tuple[ClusterBatchState, AutoscaleState]:
     pods, metrics = state.pods, state.metrics
     C, P = pods.phase.shape
     Gp = st.pg_slot_start.shape[1]
@@ -624,7 +641,9 @@ def ca_pass(
         lambda: (jnp.zeros((C, S), bool), jnp.zeros((C, Gn), jnp.int32)),
     )
     removed, removed_per_group = jax.lax.cond(
-        down_branch.any() & (auto.ca_cursor.sum() > 0),
+        # ca_count (live CA nodes) rather than ca_cursor (ever allocated):
+        # once everything scaled back down there is nothing to remove.
+        down_branch.any() & (auto.ca_count.sum() > 0),
         lambda: _ca_scale_down(state, auto, st, down_branch, K_sd),
         lambda: (jnp.zeros((C, S), bool), jnp.zeros((C, Gn), jnp.int32)),
     )

@@ -246,6 +246,7 @@ class BatchedSimulation:
         max_pods_per_scale_down: int = 8,
         use_pallas: Optional[bool] = None,
         pallas_interpret: bool = False,
+        pod_window: Optional[int] = None,
     ) -> None:
         self.config = config
         self._use_pallas_requested = use_pallas
@@ -268,6 +269,48 @@ class BatchedSimulation:
             pod_req_ram,
             pod_duration,
         ) = pad_and_batch(compiled_traces)
+
+        # Sliding pod window (SURVEY §5.8 host/device streaming, pod axis):
+        # the device pod arrays cover only [pod_base, pod_base + pod_window)
+        # of the trace's global pod slots; as old pods terminate the window
+        # shifts forward, refilled from the host payload. Per-window cost is
+        # then bounded by max concurrency, not trace length, so arbitrarily
+        # long traces stream through fixed-size device state.
+        # 0 / negative mirror the CLI's "disabled" sentinel: full-resident.
+        if pod_window is not None and pod_window <= 0:
+            pod_window = None
+        self.pod_window = pod_window
+        self._pod_base = 0
+        self._full_pods = None
+        if pod_window is not None:
+            assert mesh is None, "pod_window is not supported with a mesh yet"
+            assert not any(c.pod_groups for c in compiled_traces), (
+                "pod_window cannot slide over HPA pod groups (their reserved "
+                "slot rings are position-fixed)"
+            )
+            P_full = pod_req_cpu.shape[1]
+            pod_window = min(pod_window, P_full)
+            self.pod_window = pod_window
+            # Window index of each global pod slot's create event (slots are
+            # assigned in event order, so this is per-row nondecreasing) —
+            # the O(1) capacity lookup for the dispatch loop.
+            ev_win_np, _ = from_f64_np(ev_time, config.scheduling_cycle_interval)
+            create_win = np.full((C, P_full), np.iinfo(np.int32).max, np.int32)
+            rows_np = np.arange(C)[:, None]
+            is_cp = ev_kind == 3  # EV_CREATE_POD
+            create_win[
+                np.broadcast_to(rows_np, ev_kind.shape)[is_cp],
+                ev_slot[is_cp],
+            ] = ev_win_np[is_cp]
+            self._pod_create_win = create_win
+            self._full_pods = {
+                "req_cpu": pod_req_cpu,
+                "req_ram": pod_req_ram,
+                "duration": pod_duration,
+            }
+            pod_req_cpu = pod_req_cpu[:, :pod_window]
+            pod_req_ram = pod_req_ram[:, :pod_window]
+            pod_duration = pod_duration[:, :pod_window]
 
         # Autoscaler tables (HPA pod groups from the trace, CA node groups from
         # the config); the CA's reserved node slots are appended after the
@@ -347,9 +390,15 @@ class BatchedSimulation:
             interval=config.scheduling_cycle_interval,
         )
         if self.autoscale_statics is not None:
-            self.state = self.state._replace(
-                auto=init_autoscale_state(self.autoscale_statics)
-            )
+            auto = init_autoscale_state(self.autoscale_statics)
+            # With the HPA off (or no pod groups in the trace), park its tick
+            # at +inf so hpa_pass's due-cond never fires — CA-only runs skip
+            # the whole (C, P) HPA body every window.
+            if not (hpa_on and any(c.pod_groups for c in compiled_traces)):
+                from kubernetriks_tpu.batched.timerep import t_inf
+
+                auto = auto._replace(hpa_next=t_inf((C,)))
+            self.state = self.state._replace(auto=auto)
         ev_win, ev_off = from_f64_np(ev_time, config.scheduling_cycle_interval)
         self.slab = TraceSlab(
             win=jnp.asarray(ev_win),
@@ -473,6 +522,115 @@ class BatchedSimulation:
         idxs = self.window_idxs(until_time)
         if len(idxs) == 0:
             return
+        if self.pod_window is None:
+            self._step_idxs(idxs)
+            return
+        # Sliding-window dispatch: run sub-spans up to the last window whose
+        # pod creations still fit the device window, shifting past terminal
+        # pods between spans. Spans are cut into fixed 32-window chunks plus
+        # single-window steps so only two program shapes ever compile,
+        # whatever span lengths the capacity bound produces.
+        CHUNK = 32
+        target = int(idxs[-1])
+        while self.next_window_idx <= target:
+            sub = min(target, self._pod_capacity_window())
+            while self.next_window_idx + CHUNK - 1 <= sub:
+                self._step_idxs(
+                    np.arange(
+                        self.next_window_idx,
+                        self.next_window_idx + CHUNK,
+                        dtype=np.int32,
+                    )
+                )
+            while self.next_window_idx <= sub:
+                # Single-window dispatch through _step_idxs keeps the
+                # profiling/gauge instrumentation on the remainder windows
+                # while still compiling only two program shapes.
+                self._step_idxs(
+                    np.asarray([self.next_window_idx], np.int32)
+                )
+            if sub >= target:
+                return
+            if not self._advance_pod_window():
+                raise RuntimeError(
+                    f"pod_window={self.pod_window} is too small: window "
+                    f"{sub + 1} needs pod slots beyond the device window and "
+                    "no leading pod is terminal yet"
+                )
+
+    def _pod_capacity_window(self) -> int:
+        """Largest window index dispatchable before a pod creation would land
+        beyond the device window (slots are created in event order, so the
+        first overflow create's window bounds every cluster)."""
+        L = self._pod_base + self.pod_window
+        if L >= self._full_pods["req_cpu"].shape[1]:
+            return 1 << 30
+        return int(self._pod_create_win[:, L].min())
+
+    def _advance_pod_window(self) -> bool:
+        """Shift the device pod window past the leading run of terminal pods
+        (uniform shift across clusters), refilling the tail from the host
+        payload. Returns False if no shift is possible."""
+        from kubernetriks_tpu.batched.state import (
+            PHASE_FAILED,
+            PHASE_REMOVED,
+            PHASE_SUCCEEDED,
+        )
+        from kubernetriks_tpu.batched.state import duration_pair_np
+        from kubernetriks_tpu.batched.timerep import TPair, t_inf, t_zeros
+
+        phases = np.asarray(self.state.pods.phase)
+        terminal = (
+            (phases == PHASE_SUCCEEDED)
+            | (phases == PHASE_REMOVED)
+            | (phases == PHASE_FAILED)
+        )
+        nonterm = ~terminal
+        first_live = np.where(
+            nonterm.any(axis=1), nonterm.argmax(axis=1), phases.shape[1]
+        )
+        s = int(first_live.min())
+        if s <= 0:
+            return False
+
+        C, A = phases.shape
+        lo = self._pod_base + A
+        full = self._full_pods
+
+        def payload(arr, fill):
+            seg = arr[:, lo : lo + s]
+            if seg.shape[1] < s:
+                pad = np.full((C, s - seg.shape[1]), fill, arr.dtype)
+                seg = np.concatenate([seg, pad], axis=1)
+            return seg
+
+        # The refill slots are pristine pod slots — built by the SAME
+        # constructor init_state uses, so windowed and full-resident runs
+        # can never drift on fresh-slot defaults.
+        from kubernetriks_tpu.batched.state import fresh_pod_arrays
+
+        refill = fresh_pod_arrays(
+            C,
+            s,
+            payload(full["req_cpu"], 0),
+            payload(full["req_ram"], 0),
+            duration_pair_np(
+                payload(full["duration"], -1.0),
+                self.config.scheduling_cycle_interval,
+            ),
+        )
+        new_pods = jax.tree.map(
+            lambda a, b: jnp.concatenate([a[:, s:], b], axis=1),
+            self.state.pods,
+            refill,
+        )
+        self.state = self.state._replace(
+            pods=new_pods, pod_base=self.state.pod_base + jnp.int32(s)
+        )
+        self._pod_base += s
+        return True
+
+    def _step_idxs(self, idxs: np.ndarray) -> None:
         if not (self.profile_dir or self.log_throughput):
             self._dispatch_windows(idxs)
             return
@@ -546,9 +704,11 @@ class BatchedSimulation:
         last_event_time = float(finite.max()) if finite.size else 0.0
         while True:
             self.step_until_time(self.next_window + chunk * interval)
-            # Never conclude before the trace is fully applied: EMPTY slots may
-            # still be waiting on future CreatePod events.
-            if self.next_window <= last_event_time:
+            # Never conclude before the trace is fully applied: EMPTY slots
+            # may still be waiting on future CreatePod events. An event in
+            # window w is only applied when window w+1 steps, so the run must
+            # have advanced strictly past last_event_time + interval.
+            if self.next_window <= last_event_time + interval:
                 continue
             phases = np.asarray(self.state.pods.phase)
             service = np.asarray(self.state.pods.duration.win) < 0
@@ -649,14 +809,19 @@ class BatchedSimulation:
         from kubernetriks_tpu.checkpoint import ckpt_save
 
         ckpt_save(path, self._ckpt_payload())
+        sidecar = os.path.abspath(path) + ".gauges.npz"
         if self._gauge_windows:
             np.savez(
-                os.path.abspath(path) + ".gauges.npz",
+                sidecar,
                 windows=np.concatenate(self._gauge_windows).astype(np.int32),
                 samples=np.concatenate(self._gauge_samples, axis=0).astype(
                     np.float32
                 ),
             )
+        elif os.path.exists(sidecar):
+            # Never let a previous save's gauge series shadow this run's
+            # (gauge-less) state on restore.
+            os.remove(sidecar)
 
     def load_checkpoint(self, path: str) -> None:
         """Restore state saved by save_checkpoint into this simulation (which
@@ -668,6 +833,7 @@ class BatchedSimulation:
         restored = ckpt_restore(path, self._ckpt_payload())
         self.state = restored["state"]
         self.next_window_idx = int(restored["next_window_idx"])
+        self._pod_base = int(np.asarray(self.state.pod_base)[0])
         sidecar = os.path.abspath(path) + ".gauges.npz"
         if os.path.exists(sidecar):
             data = np.load(sidecar)
@@ -708,7 +874,9 @@ class BatchedSimulation:
                 )
 
     def pod_view(self, cluster: int) -> Dict[str, Dict]:
-        """Name-keyed pod states for equivalence tests against the scalar path."""
+        """Name-keyed pod states for equivalence tests against the scalar
+        path. With a sliding pod window, only the currently-resident slots
+        appear (shifted-out pods are terminal and already counted)."""
         phases = np.asarray(self.state.pods.phase[cluster])
         nodes = np.asarray(self.state.pods.node[cluster])
         start_pair = self.state.pods.start_time
@@ -721,8 +889,11 @@ class BatchedSimulation:
         names = self.pod_names[cluster]
         node_names = self.node_names[cluster]
         out = {}
-        for slot, name in enumerate(names):
-            out[name] = {
+        for slot in range(phases.shape[0]):
+            g = self._pod_base + slot
+            if g >= len(names):
+                break
+            out[names[g]] = {
                 "phase": int(phases[slot]),
                 "node": node_names[nodes[slot]] if nodes[slot] >= 0 else None,
                 "start_time": float(starts[slot]),

@@ -154,6 +154,9 @@ class ClusterBatchState(NamedTuple):
     time: jnp.ndarray  # (C,) int32 last completed window index
     queue_seq_counter: jnp.ndarray  # (C,) int32 next queue sequence number
     event_cursor: jnp.ndarray  # (C,) int32 next unapplied trace event
+    # First GLOBAL pod slot covered by the device pod arrays (sliding pod
+    # window; 0 and never advanced when the window is the whole trace).
+    pod_base: jnp.ndarray  # (C,) int32
     last_flush_win: jnp.ndarray  # (C,) int32 last unschedulable-leftover flush window
     requeue_signal: jnp.ndarray  # (C,) bool: node-add/pod-finish since last cycle
     # Conditional-move accounting (enable_unscheduled_pods_conditional_move,
@@ -217,6 +220,44 @@ def make_step_constants(config) -> StepConstants:
     )
 
 
+def duration_pair_np(pod_duration: np.ndarray, interval: float) -> TPair:
+    """Host float64 durations -> device TPair; <0 marks a long-running
+    service (win = -1 sentinel)."""
+    dur = np.asarray(pod_duration, np.float64)
+    service = dur < 0
+    dwin, doff = from_f64_np(np.where(service, 0.0, dur), interval)
+    return TPair(
+        win=jnp.asarray(np.where(service, -1, dwin), jnp.int32),
+        off=jnp.asarray(np.where(service, 0.0, doff), jnp.float32),
+    )
+
+
+def fresh_pod_arrays(
+    C: int,
+    P: int,
+    req_cpu,
+    req_ram,
+    duration: TPair,
+) -> PodArrays:
+    """Pod-slot arrays in their pristine (EMPTY, never-created) state — the
+    single source of fresh-slot defaults, shared by init_state and the
+    sliding pod window's refill."""
+    return PodArrays(
+        phase=jnp.zeros((C, P), jnp.int32),
+        req_cpu=jnp.asarray(req_cpu, jnp.int32),
+        req_ram=jnp.asarray(req_ram, jnp.int32),
+        duration=duration,
+        queue_ts=t_zeros((C, P)),
+        queue_seq=jnp.zeros((C, P), jnp.int32),
+        initial_attempt_ts=t_zeros((C, P)),
+        attempts=jnp.zeros((C, P), jnp.int32),
+        node=jnp.full((C, P), -1, jnp.int32),
+        start_time=t_zeros((C, P)),
+        finish_time=t_inf((C, P)),
+        removal_time=t_inf((C, P)),
+    )
+
+
 def init_state(
     n_clusters: int,
     n_nodes: int,
@@ -232,13 +273,7 @@ def init_state(
     EMPTY/dead; trace events bring them to life). pod_duration: float64
     seconds, <0 marks a long-running service."""
     C, N, P = n_clusters, n_nodes, n_pods
-    dur = np.asarray(pod_duration, np.float64)
-    service = dur < 0
-    dwin, doff = from_f64_np(np.where(service, 0.0, dur), interval)
-    duration = TPair(
-        win=jnp.asarray(np.where(service, -1, dwin), jnp.int32),
-        off=jnp.asarray(np.where(service, 0.0, doff), jnp.float32),
-    )
+    duration = duration_pair_np(pod_duration, interval)
     nodes = NodeArrays(
         alive=jnp.zeros((C, N), bool),
         cap_cpu=jnp.asarray(node_cap_cpu, jnp.int32),
@@ -248,20 +283,7 @@ def init_state(
         create_time=t_inf((C, N)),
         remove_time=t_inf((C, N)),
     )
-    pods = PodArrays(
-        phase=jnp.zeros((C, P), jnp.int32),
-        req_cpu=jnp.asarray(pod_req_cpu, jnp.int32),
-        req_ram=jnp.asarray(pod_req_ram, jnp.int32),
-        duration=duration,
-        queue_ts=t_zeros((C, P)),
-        queue_seq=jnp.zeros((C, P), jnp.int32),
-        initial_attempt_ts=t_zeros((C, P)),
-        attempts=jnp.zeros((C, P), jnp.int32),
-        node=jnp.full((C, P), -1, jnp.int32),
-        start_time=t_zeros((C, P)),
-        finish_time=t_inf((C, P)),
-        removal_time=t_inf((C, P)),
-    )
+    pods = fresh_pod_arrays(C, P, pod_req_cpu, pod_req_ram, duration)
     metrics = MetricArrays(
         pods_succeeded=jnp.zeros((C,), jnp.int32),
         pods_removed=jnp.zeros((C,), jnp.int32),
@@ -280,6 +302,7 @@ def init_state(
         time=jnp.zeros((C,), jnp.int32),
         queue_seq_counter=jnp.zeros((C,), jnp.int32),
         event_cursor=jnp.zeros((C,), jnp.int32),
+        pod_base=jnp.zeros((C,), jnp.int32),
         last_flush_win=jnp.zeros((C,), jnp.int32),
         requeue_signal=jnp.zeros((C,), bool),
         wake_node_signal=jnp.zeros((C,), bool),

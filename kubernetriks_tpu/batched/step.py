@@ -170,8 +170,15 @@ def _apply_window_events(
         ev_win = slab.win[rows, offs_c]
         ev_off = slab.off[rows, offs_c]
         ev_k = slab.kind[rows, offs_c]
-        ev_s = slab.slot[rows, offs_c]
+        ev_s_raw = slab.slot[rows, offs_c]
         valid = (offs < E_total) & (ev_win < W[:, None])
+        # Pod event slots are GLOBAL; the device pod arrays cover
+        # [pod_base, pod_base + P) (sliding pod window). Out-of-window slots
+        # (already-shifted-out, necessarily terminal pods — e.g. a RemovePod
+        # after its pod finished and scrolled away) drop at the scatters.
+        is_pod_ev = (ev_k == EV_CREATE_POD) | (ev_k == EV_REMOVE_POD)
+        ev_s = jnp.where(is_pod_ev, ev_s_raw - state.pod_base[:, None], ev_s_raw)
+        ev_s = jnp.where(is_pod_ev & (ev_s < 0), jnp.int32(1 << 29), ev_s)
         # Event time in f32 seconds relative to base (== ev_off when the
         # event is in this window, which consecutive stepping guarantees).
         ev_rel = (ev_win - base[:, None]).astype(jnp.float32) * interval + ev_off

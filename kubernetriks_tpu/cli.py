@@ -92,7 +92,10 @@ def build_traces(config: SimulationConfig):
 
 
 def build_batched_simulation(
-    config: SimulationConfig, n_clusters: int, max_pods_per_cycle: int = 0
+    config: SimulationConfig,
+    n_clusters: int,
+    max_pods_per_cycle: int = 0,
+    pod_window: int = 0,
 ):
     """Build a BatchedSimulation from the config's trace source.
 
@@ -115,6 +118,8 @@ def build_batched_simulation(
     # simulates the same regardless of native-feeder availability (the engine
     # clamps the slice to the pod-slot count when it is smaller).
     kwargs = {"max_pods_per_cycle": max_pods_per_cycle or 256}
+    if pod_window:
+        kwargs["pod_window"] = pod_window
 
     trace_config = config.trace_config
     alibaba = trace_config.alibaba_cluster_trace_v2017 if trace_config else None
@@ -143,7 +148,9 @@ def run_batched(config: SimulationConfig, args) -> int:
     import json
     import time
 
-    sim = build_batched_simulation(config, args.clusters, args.max_pods_per_cycle)
+    sim = build_batched_simulation(
+        config, args.clusters, args.max_pods_per_cycle, args.pod_window
+    )
     logging.getLogger(__name__).info(
         "batched run: %d clusters x %d node slots x %d pod slots (pallas=%s)",
         sim.n_clusters, sim.n_nodes, sim.n_pods, sim.use_pallas,
@@ -184,6 +191,13 @@ def main(argv=None) -> int:
         type=int,
         default=0,
         help="batched backend: per-cycle scheduling work bound (0 = auto)",
+    )
+    parser.add_argument(
+        "--pod-window",
+        type=int,
+        default=0,
+        help="batched backend: sliding pod-slot window size (0 = whole trace "
+        "resident; set to ~2x peak pod concurrency to stream long traces)",
     )
     parser.add_argument(
         "--gauge-csv",

@@ -8,7 +8,7 @@ the native C++ feeder -> compile_from_arrays -> BatchedSimulation with the
 cluster autoscaler enabled, and prints one JSON line with simulated-event
 throughput.
 
-Usage: python scripts/bench_alibaba.py [n_clusters]
+Usage: python scripts/bench_alibaba.py [n_clusters] [pod_window]
 """
 
 import json
@@ -22,7 +22,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 
 
-def main(n_clusters: int = 1) -> None:
+def main(n_clusters: int = 1, pod_window: int = 0) -> None:
     from kubernetriks_tpu.cli import build_batched_simulation
     from kubernetriks_tpu.config import SimulationConfig
     from kubernetriks_tpu.trace.synthetic_alibaba import write_synthetic_trace_dir
@@ -62,7 +62,9 @@ cluster_autoscaler:
 """
         )
         build_t0 = time.perf_counter()
-        sim = build_batched_simulation(config, n_clusters=n_clusters)
+        sim = build_batched_simulation(
+            config, n_clusters=n_clusters, pod_window=pod_window
+        )
         build_s = time.perf_counter() - build_t0
 
         t0 = time.perf_counter()
@@ -81,6 +83,7 @@ cluster_autoscaler:
                     "metric": (
                         f"alibaba-v2017 synthetic replay, {n_clusters}x1313 nodes "
                         "x ~107k pods, 1 simulated day, cluster-autoscaler on"
+                        + (f", pod_window={pod_window}" if pod_window else "")
                     ),
                     "value": round(events / elapsed),
                     "unit": "events/s",
@@ -94,4 +97,7 @@ cluster_autoscaler:
 
 
 if __name__ == "__main__":
-    main(int(sys.argv[1]) if len(sys.argv) > 1 else 1)
+    main(
+        int(sys.argv[1]) if len(sys.argv) > 1 else 1,
+        int(sys.argv[2]) if len(sys.argv) > 2 else 0,
+    )
