@@ -400,12 +400,7 @@ class BatchedSimulation:
                 auto = auto._replace(hpa_next=t_inf((C,)))
             self.state = self.state._replace(auto=auto)
         ev_win, ev_off = from_f64_np(ev_time, config.scheduling_cycle_interval)
-        self.slab = TraceSlab(
-            win=jnp.asarray(ev_win),
-            off=jnp.asarray(ev_off),
-            kind=jnp.asarray(ev_kind),
-            slot=jnp.asarray(ev_slot),
-        )
+        self.slab = TraceSlab.build(ev_win, ev_off, ev_kind, ev_slot)
         self._ev_time_np = ev_time  # host copy (f64) for completion checks
         self.node_names = [c.node_names + extra_names for c in compiled_traces]
         self.pod_names = [c.pod_names for c in compiled_traces]
@@ -577,7 +572,6 @@ class BatchedSimulation:
             PHASE_SUCCEEDED,
         )
         from kubernetriks_tpu.batched.state import duration_pair_np
-        from kubernetriks_tpu.batched.timerep import TPair, t_inf, t_zeros
 
         phases = np.asarray(self.state.pods.phase)
         terminal = (
@@ -672,6 +666,12 @@ class BatchedSimulation:
 
     def step_window(self) -> None:
         """Advance a single scheduling cycle (useful for tests)."""
+        if self.pod_window is not None:
+            assert self.next_window_idx <= self._pod_capacity_window(), (
+                "step_window would apply a pod creation beyond the sliding "
+                "pod window; use step_until_time (which shifts the window) "
+                "or a larger pod_window"
+            )
         self.state = window_step(
             self.state,
             self.slab,

@@ -177,12 +177,29 @@ class ClusterBatchState(NamedTuple):
 
 class TraceSlab(NamedTuple):
     """(C, E) compiled trace events, time-sorted per cluster, padded with
-    EV_NONE/time=+inf (win=INF_WIN)."""
+    EV_NONE/time=+inf (win=INF_WIN).
+
+    Columns are stored PACKED — (C, E, 4) int32 [win, off-bits, kind, slot] —
+    so the hot event loop gathers ONE (C, chunk, 4) slice instead of four
+    separate (C, chunk) gathers (gather cost is per-index, not per-byte, on
+    TPU). `win` is also kept as its own array for the cheap cursor peek; the
+    other columns exist only inside `packed` (the slab is the one component
+    that still scales with trace length, so no duplication)."""
 
     win: jnp.ndarray  # int32 window index of the event's effect time
-    off: jnp.ndarray  # float32 offset within the window
-    kind: jnp.ndarray  # int32
-    slot: jnp.ndarray  # int32 (node slot for node events, pod slot for pod events)
+    packed: jnp.ndarray  # (C, E, 4) int32 [win, off-bits, kind, slot]
+
+    @staticmethod
+    def build(win, off, kind, slot) -> "TraceSlab":
+        win = jnp.asarray(win, jnp.int32)
+        off = jnp.asarray(off, jnp.float32)
+        kind = jnp.asarray(kind, jnp.int32)
+        slot = jnp.asarray(slot, jnp.int32)
+        packed = jnp.stack(
+            [win, jax.lax.bitcast_convert_type(off, jnp.int32), kind, slot],
+            axis=-1,
+        )
+        return TraceSlab(win=win, packed=packed)
 
 
 class StepConstants(NamedTuple):

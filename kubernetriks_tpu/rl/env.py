@@ -22,8 +22,9 @@ import jax.numpy as jnp
 from kubernetriks_tpu.batched.state import ClusterBatchState, StepConstants, TraceSlab
 from kubernetriks_tpu.batched.step import (
     _apply_window_events,
-    apply_decision,
     commit_cycle,
+    cycle_timing,
+    decision_metrics,
     prepare_cycle,
 )
 
@@ -87,10 +88,14 @@ def policy_cycle(
 
     alive_count = alive.sum(axis=1, dtype=jnp.int32).astype(jnp.float32)
     pod_sched_time = jnp.float32(consts.time_per_node) * alive_count
+    # Timing mechanics shared with the kube paths (batched/step.py).
+    pod_queue_time_k, start_s_k, park_s_k = cycle_timing(
+        cc.valid, cc.waited, pod_sched_time, consts
+    )
 
     def body(carry, xs):
-        alloc_cpu, alloc_ram, cycle_dur, metrics, rng = carry
-        valid, req_cpu, req_ram, waited = xs
+        alloc_cpu, alloc_ram, rng = carry
+        valid, req_cpu, req_ram, pod_queue_time = xs
 
         obs = featurize(
             alive, alloc_cpu, alloc_ram, state.nodes.cap_cpu, state.nodes.cap_ram,
@@ -114,13 +119,11 @@ def policy_cycle(
         log_probs = jax.nn.log_softmax(safe_logits, axis=-1)
         log_prob = log_probs[rows1, action]
 
-        # Shared decision mechanics (resource reservation, start/park offsets,
-        # metrics) — single-sourced with the kube cycle in batched/step.py.
-        (alloc_cpu, alloc_ram, metrics, assign, park, start_s, park_s,
-         cycle_dur_post, pod_queue_time) = apply_decision(
-            alloc_cpu, alloc_ram, metrics, valid, any_fit, action,
-            req_cpu, req_ram, waited, cycle_dur, pod_sched_time, consts,
-        )
+        assign = valid & any_fit
+        park = valid & ~any_fit
+        action_c = jnp.clip(action, 0, None)
+        alloc_cpu = alloc_cpu.at[rows1, action_c].add(jnp.where(assign, -req_cpu, 0))
+        alloc_ram = alloc_ram.at[rows1, action_c].add(jnp.where(assign, -req_ram, 0))
 
         # Reward: +1 per placement, -1 per unschedulable park, minus a queue
         # time penalty so the policy learns not to strand future pods.
@@ -137,20 +140,22 @@ def policy_cycle(
             reward=reward,
             valid=valid,
         )
-        outs = (assign, park, action, start_s, park_s, transition)
-        return (alloc_cpu, alloc_ram, cycle_dur_post, metrics, rng), outs
+        outs = (assign, park, action, transition)
+        return (alloc_cpu, alloc_ram, rng), outs
 
-    xs = (cc.valid.T, cc.req_cpu.T, cc.req_ram.T, cc.waited.T)
-    (alloc_cpu, alloc_ram, _, metrics, _), outs = jax.lax.scan(
+    xs = (cc.valid.T, cc.req_cpu.T, cc.req_ram.T, pod_queue_time_k.T)
+    (alloc_cpu, alloc_ram, _), outs = jax.lax.scan(
         body,
-        (state.nodes.alloc_cpu, state.nodes.alloc_ram,
-         jnp.zeros((C,), jnp.float32), state.metrics, rng),
+        (state.nodes.alloc_cpu, state.nodes.alloc_ram, rng),
         xs,
     )
-    assign_k, park_k, action_k, start_s_k, park_s_k, transitions = outs
+    assign_k, park_k, action_k, transitions = outs
+    metrics = decision_metrics(
+        state.metrics, assign_k.T, pod_queue_time_k, pod_sched_time
+    )
     state = commit_cycle(
         state, cc, W, consts, alloc_cpu, alloc_ram, metrics,
-        assign_k.T, park_k.T, action_k.T, start_s_k.T, park_s_k.T,
+        assign_k.T, park_k.T, action_k.T, start_s_k, park_s_k,
     )
     return state, transitions  # transitions stacked over K on axis 0
 

@@ -140,9 +140,19 @@ def test_full_sim_pallas_matches_scan():
     flat_a, tree_a = jax.tree_util.tree_flatten_with_path(sim_scan.state)
     flat_b, _ = jax.tree_util.tree_flatten_with_path(sim_pallas.state)
     for (path, a), (_, b) in zip(flat_a, flat_b):
-        np.testing.assert_array_equal(
-            np.asarray(a), np.asarray(b), err_msg=jax.tree_util.keystr(path)
-        )
+        key = jax.tree_util.keystr(path)
+        if ".metrics." in key and np.asarray(a).dtype == np.float32:
+            # Metric estimator accumulators fold each cycle with a masked
+            # (C, K) reduction whose tiling XLA chooses per program — the
+            # scan and Pallas programs fuse differently, so these sums can
+            # differ by an ulp. All simulation state stays exactly equal.
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-6, err_msg=key
+            )
+        else:
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b), err_msg=key
+            )
 
     summary = sim_pallas.metrics_summary()
     assert summary["counters"]["scheduling_decisions"] > 50
