@@ -180,13 +180,11 @@ class TraceSlab(NamedTuple):
     EV_NONE/time=+inf (win=INF_WIN).
 
     Columns are stored PACKED — (C, E, 4) int32 [win, off-bits, kind, slot] —
-    so the hot event loop gathers ONE (C, chunk, 4) slice instead of four
-    separate (C, chunk) gathers (gather cost is per-index, not per-byte, on
-    TPU). `win` is also kept as its own array for the cheap cursor peek; the
-    other columns exist only inside `packed` (the slab is the one component
-    that still scales with trace length, so no duplication)."""
+    and ONLY packed: the hot event loop gathers one (C, chunk, 4) slice
+    instead of four separate (C, chunk) gathers (gather cost is per-index,
+    not per-byte, on TPU), and the slab — the one component that still
+    scales with trace length — carries no duplicate device memory."""
 
-    win: jnp.ndarray  # int32 window index of the event's effect time
     packed: jnp.ndarray  # (C, E, 4) int32 [win, off-bits, kind, slot]
 
     @staticmethod
@@ -199,7 +197,7 @@ class TraceSlab(NamedTuple):
             [win, jax.lax.bitcast_convert_type(off, jnp.int32), kind, slot],
             axis=-1,
         )
-        return TraceSlab(win=win, packed=packed)
+        return TraceSlab(packed=packed)
 
 
 class StepConstants(NamedTuple):

@@ -126,7 +126,7 @@ def _apply_window_events(
     pods, nodes, metrics = state.pods, state.nodes, state.metrics
     C, P = pods.phase.shape
     N = nodes.alive.shape[1]
-    E_total = slab.win.shape[1]
+    E_total = slab.packed.shape[1]
     E = max_events_per_window
     interval = jnp.float32(consts.scheduling_interval)
     rows1 = jnp.arange(C, dtype=jnp.int32)
@@ -142,7 +142,7 @@ def _apply_window_events(
     # gather/scatter. Due events are a sorted prefix of the slab, so a chunk
     # boundary never skips one.
     def chunk_due(cursor):
-        nxt = slab.win[rows1, jnp.clip(cursor, 0, E_total - 1)]
+        nxt = slab.packed[rows1, jnp.clip(cursor, 0, E_total - 1), 0]
         return (cursor < E_total) & (nxt < W)
 
     def chunk_cond(carry):
@@ -301,7 +301,7 @@ def _apply_window_events(
     # chunks, looping for the rare overflow window (integer adds commute, so
     # the ordering is irrelevant).
     freed = finishes | removed_running
-    F = min(P, 128)  # freed-compaction chunk width (independent of E)
+    F = min(P, 32)  # freed-compaction chunk width (independent of E)
 
     def free_cond(carry):
         return carry[0].any()

@@ -72,7 +72,7 @@ cluster_autoscaler:
         build_s = time.perf_counter() - build_t0
 
         t0 = time.perf_counter()
-        sim.run_to_completion(max_time=1e6)
+        sim.run_to_completion(max_time=days * 86400.0 * 20.0)
         jax.block_until_ready(sim.state.time)
         elapsed = time.perf_counter() - t0
 
