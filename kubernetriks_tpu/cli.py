@@ -28,13 +28,22 @@ from kubernetriks_tpu.trace.interface import EmptyTrace
 
 
 def setup_logging(config: SimulationConfig) -> None:
-    """Level from KUBERNETRIKS_LOG (RUST_LOG equivalent), optional file sink
+    """Level from KUBERNETRIKS_LOG (RUST_LOG equivalent), optional rotating
+    file sink — 50 files x 100 MiB like the reference's FileRotate
     (reference: main.rs:33-50)."""
+    from logging.handlers import RotatingFileHandler
+
     level = os.environ.get("KUBERNETRIKS_LOG", "INFO").upper()
     handlers = [logging.StreamHandler()]
     if config.logs_filepath:
         os.makedirs(os.path.dirname(config.logs_filepath) or ".", exist_ok=True)
-        handlers.append(logging.FileHandler(config.logs_filepath))
+        handlers.append(
+            RotatingFileHandler(
+                config.logs_filepath,
+                maxBytes=100 * 1024 * 1024,
+                backupCount=50,
+            )
+        )
     logging.basicConfig(
         level=getattr(logging, level, logging.INFO),
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",

@@ -67,10 +67,28 @@ def test_alibaba_replay_batched_matches_scalar(tmp_path):
     assert best["mean"] == pytest.approx(sm.pod_duration_stats.mean(), rel=1e-4)
 
 
-def test_alibaba_replay_batched_with_cluster_autoscaler(tmp_path):
-    """Replay on an undersized cluster with machine failures and the CA
-    enabled: unscheduled pods trigger scale-ups, failed machines trigger
-    reschedules, and every pod still terminates."""
+
+CA_EXTRA_YAML = """
+cluster_autoscaler:
+  enabled: true
+  scan_interval: 10.0
+  max_node_count: {max_nodes}
+  node_groups:
+  - node_template:
+      metadata:
+        name: {node_name}
+      status:
+        capacity:
+          cpu: 64000
+          ram: 94489280512
+"""
+
+
+def _contended_ca_setup(
+    tmp_path, n_machines, n_tasks, error_fraction, seed, max_nodes, node_name
+):
+    """Synthesize an undersized cluster (heavy 16-64 core tasks vs few
+    machines, so the CA has unscheduled pods to act on) and its CA config."""
     from kubernetriks_tpu.trace.synthetic_alibaba import (
         write_batch_workload,
         write_machine_events,
@@ -80,32 +98,27 @@ def test_alibaba_replay_batched_with_cluster_autoscaler(tmp_path):
     tasks = str(tmp_path / "batch_task.csv")
     instances = str(tmp_path / "batch_instance.csv")
     write_machine_events(
-        machines, n_machines=6, error_fraction=0.3, horizon=3000.0, seed=11
+        machines, n_machines=n_machines, error_fraction=error_fraction,
+        horizon=3000.0, seed=seed,
     )
-    # Heavy tasks (16-64 cores) against six machines: guaranteed contention
-    # so the CA has unscheduled pods to act on.
     write_batch_workload(
-        tasks, instances, n_tasks=150, horizon=3000.0,
-        cpu_santicores_range=(1600, 6400), heavy_fraction=0.0, seed=12,
+        tasks, instances, n_tasks=n_tasks, horizon=3000.0,
+        cpu_santicores_range=(1600, 6400), heavy_fraction=0.0, seed=seed + 1,
     )
     config = _alibaba_config(
-        machines,
-        tasks,
-        instances,
-        extra="""
-cluster_autoscaler:
-  enabled: true
-  scan_interval: 10.0
-  max_node_count: 64
-  node_groups:
-  - node_template:
-      metadata:
-        name: alibaba_ca_node
-      status:
-        capacity:
-          cpu: 64000
-          ram: 94489280512
-""",
+        machines, tasks, instances,
+        extra=CA_EXTRA_YAML.format(max_nodes=max_nodes, node_name=node_name),
+    )
+    return config, machines, tasks, instances
+
+
+def test_alibaba_replay_batched_with_cluster_autoscaler(tmp_path):
+    """Replay on an undersized cluster with machine failures and the CA
+    enabled: unscheduled pods trigger scale-ups, failed machines trigger
+    reschedules, and every pod still terminates."""
+    config, *_ = _contended_ca_setup(
+        tmp_path, n_machines=6, n_tasks=150, error_fraction=0.3, seed=11,
+        max_nodes=64, node_name="alibaba_ca_node",
     )
 
     batched = build_batched_simulation(config, n_clusters=2)
@@ -154,4 +167,37 @@ def test_sliding_pod_window_matches_full(tmp_path):
 
     assert wm["counters"] == fm["counters"]
     for key in ("pod_duration", "pod_queue_time", "pod_schedule_time"):
-        assert wm["timings"][key] == _pytest.approx(fm["timings"][key], rel=1e-6)
+        assert wm["timings"][key] == pytest.approx(fm["timings"][key], rel=1e-6)
+
+
+def test_sliding_pod_window_with_autoscaler_and_failures(tmp_path):
+    """Sliding window composed with the CA and machine failures: parked pods
+    (which block the shift until terminal), scale-ups into reserved slots,
+    and reschedules off failed nodes must all match the full-resident run."""
+    from kubernetriks_tpu.batched.engine import BatchedSimulation
+    from kubernetriks_tpu.batched.trace_compile import compile_from_arrays
+    from kubernetriks_tpu.trace import feeder
+
+    config, machines, tasks, instances = _contended_ca_setup(
+        tmp_path, n_machines=8, n_tasks=160, error_fraction=0.25, seed=31,
+        max_nodes=32, node_name="win_ca_node",
+    )
+    wa = feeder.load_workload_arrays(instances, tasks)
+    ca = feeder.load_cluster_arrays(machines)
+    compiled = compile_from_arrays(ca, wa, config)
+
+    full = BatchedSimulation(config, [compiled], max_pods_per_cycle=64)
+    full.run_to_completion(max_time=1e6)
+    fm = full.metrics_summary()
+    assert fm["counters"]["total_scaled_up_nodes"] > 0
+
+    windowed = BatchedSimulation(
+        config, [compiled], max_pods_per_cycle=64, pod_window=192
+    )
+    windowed.run_to_completion(max_time=1e6)
+    wm = windowed.metrics_summary()
+    assert windowed._pod_base > 0
+
+    assert wm["counters"] == fm["counters"]
+    for key in ("pod_duration", "pod_queue_time", "pod_schedule_time"):
+        assert wm["timings"][key] == pytest.approx(fm["timings"][key], rel=1e-6)
