@@ -34,16 +34,19 @@ def setup_logging(config: SimulationConfig) -> None:
     from logging.handlers import RotatingFileHandler
 
     level = os.environ.get("KUBERNETRIKS_LOG", "INFO").upper()
-    handlers = [logging.StreamHandler()]
     if config.logs_filepath:
+        # The reference logs EXCLUSIVELY to the rotating file when a path is
+        # configured (main.rs:40-47) — no console duplicate.
         os.makedirs(os.path.dirname(config.logs_filepath) or ".", exist_ok=True)
-        handlers.append(
+        handlers = [
             RotatingFileHandler(
                 config.logs_filepath,
                 maxBytes=100 * 1024 * 1024,
                 backupCount=50,
             )
-        )
+        ]
+    else:
+        handlers = [logging.StreamHandler()]
     logging.basicConfig(
         level=getattr(logging, level, logging.INFO),
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",

@@ -136,68 +136,61 @@ def test_alibaba_replay_batched_with_cluster_autoscaler(tmp_path):
     assert np.asarray(batched.state.nodes.alive).sum() < 2 * batched.n_nodes
 
 
-def test_sliding_pod_window_matches_full(tmp_path):
-    """pod_window streams the trace through a small device window: terminal
-    counters and duration stats must match the full-resident run exactly."""
-    import pytest as _pytest
-
+def _assert_windowed_matches_full(config, machines, tasks, instances,
+                                  pod_window, n_clusters=1):
+    """Run the same compiled trace full-resident and through a sliding pod
+    window; the window must actually slide and every terminal counter and
+    timing stat must match."""
     from kubernetriks_tpu.batched.engine import BatchedSimulation
     from kubernetriks_tpu.batched.trace_compile import compile_from_arrays
     from kubernetriks_tpu.trace import feeder
 
-    machines, tasks, instances = write_synthetic_trace_dir(
-        str(tmp_path), n_machines=60, n_tasks=500, horizon=4000.0, seed=21
-    )
-    config = _alibaba_config(machines, tasks, instances)
     wa = feeder.load_workload_arrays(instances, tasks)
     ca = feeder.load_cluster_arrays(machines)
     compiled = compile_from_arrays(ca, wa, config)
 
-    full = BatchedSimulation(config, [compiled] * 2, max_pods_per_cycle=64)
-    full.run_to_completion()
+    full = BatchedSimulation(
+        config, [compiled] * n_clusters, max_pods_per_cycle=64
+    )
+    full.run_to_completion(max_time=1e6)
     fm = full.metrics_summary()
 
     windowed = BatchedSimulation(
-        config, [compiled] * 2, max_pods_per_cycle=64, pod_window=384
+        config, [compiled] * n_clusters, max_pods_per_cycle=64,
+        pod_window=pod_window,
     )
-    assert windowed.n_pods == 384 < full.n_pods
-    windowed.run_to_completion()
+    assert windowed.n_pods == pod_window < full.n_pods
+    windowed.run_to_completion(max_time=1e6)
     wm = windowed.metrics_summary()
     assert windowed._pod_base > 0  # the window actually slid
 
     assert wm["counters"] == fm["counters"]
     for key in ("pod_duration", "pod_queue_time", "pod_schedule_time"):
         assert wm["timings"][key] == pytest.approx(fm["timings"][key], rel=1e-6)
+    return fm
+
+
+def test_sliding_pod_window_matches_full(tmp_path):
+    """pod_window streams the trace through a small device window: terminal
+    counters and duration stats must match the full-resident run exactly."""
+    machines, tasks, instances = write_synthetic_trace_dir(
+        str(tmp_path), n_machines=60, n_tasks=500, horizon=4000.0, seed=21
+    )
+    config = _alibaba_config(machines, tasks, instances)
+    _assert_windowed_matches_full(
+        config, machines, tasks, instances, pod_window=384, n_clusters=2
+    )
 
 
 def test_sliding_pod_window_with_autoscaler_and_failures(tmp_path):
     """Sliding window composed with the CA and machine failures: parked pods
     (which block the shift until terminal), scale-ups into reserved slots,
     and reschedules off failed nodes must all match the full-resident run."""
-    from kubernetriks_tpu.batched.engine import BatchedSimulation
-    from kubernetriks_tpu.batched.trace_compile import compile_from_arrays
-    from kubernetriks_tpu.trace import feeder
-
     config, machines, tasks, instances = _contended_ca_setup(
         tmp_path, n_machines=8, n_tasks=160, error_fraction=0.25, seed=31,
         max_nodes=32, node_name="win_ca_node",
     )
-    wa = feeder.load_workload_arrays(instances, tasks)
-    ca = feeder.load_cluster_arrays(machines)
-    compiled = compile_from_arrays(ca, wa, config)
-
-    full = BatchedSimulation(config, [compiled], max_pods_per_cycle=64)
-    full.run_to_completion(max_time=1e6)
-    fm = full.metrics_summary()
-    assert fm["counters"]["total_scaled_up_nodes"] > 0
-
-    windowed = BatchedSimulation(
-        config, [compiled], max_pods_per_cycle=64, pod_window=192
+    fm = _assert_windowed_matches_full(
+        config, machines, tasks, instances, pod_window=192
     )
-    windowed.run_to_completion(max_time=1e6)
-    wm = windowed.metrics_summary()
-    assert windowed._pod_base > 0
-
-    assert wm["counters"] == fm["counters"]
-    for key in ("pod_duration", "pod_queue_time", "pod_schedule_time"):
-        assert wm["timings"][key] == pytest.approx(fm["timings"][key], rel=1e-6)
+    assert fm["counters"]["total_scaled_up_nodes"] > 0

@@ -182,3 +182,33 @@ def test_random_trace_cross_path_equivalence(seed, conditional_move):
         assert best["min"] == pytest.approx(scalar_est.min(), rel=1e-4, abs=1e-3), (key, seed)
         assert best["max"] == pytest.approx(scalar_est.max(), rel=1e-4, abs=1e-3), (key, seed)
         assert best["mean"] == pytest.approx(scalar_est.mean(), rel=1e-4, abs=1e-3), (key, seed)
+
+
+def test_batched_path_determinism():
+    """The determinism north star applied to the batched path: two
+    identically-built runs over the same generated traces produce
+    bit-identical final state pytrees (reference analog:
+    tests/test_determinism.rs applied per backend)."""
+    import jax
+
+    config = default_test_simulation_config()
+
+    def run():
+        cluster_trace, workload_trace = generate_traces(909)
+        sim = build_batched_from_traces(
+            config,
+            cluster_trace.convert_to_simulator_events(),
+            workload_trace.convert_to_simulator_events(),
+            n_clusters=4,
+        )
+        sim.step_until_time(END_TIME)
+        return sim
+
+    a, b = run(), run()
+    assert a.metrics_summary()["counters"]["pods_succeeded"] > 0
+    flat_a, _ = jax.tree_util.tree_flatten_with_path(a.state)
+    flat_b, _ = jax.tree_util.tree_flatten_with_path(b.state)
+    for (path, x), (_, y) in zip(flat_a, flat_b):
+        np.testing.assert_array_equal(
+            np.asarray(x), np.asarray(y), err_msg=jax.tree_util.keystr(path)
+        )
