@@ -16,7 +16,7 @@ src/trace/alibaba_cluster_trace_v2017/{cluster,workload}.rs row structs).
 Usage:
   python experiments/modify_traces.py add-only machine_events.csv server_event_add_only.csv
   python experiments/modify_traces.py fit-only server_event_add_only.csv batch_task.csv batch_task_fit_only.csv
-  python experiments/modify_traces.py analyze batch_task_fit_only.csv
+  python experiments/modify_traces.py analyze batch_task_fit_only.csv [batch_instance.csv]
 """
 
 from __future__ import annotations
@@ -91,11 +91,23 @@ def filter_fit_only(
     return kept
 
 
-def analyze(batch_task_path: str) -> dict:
-    """Task/instance counts and cpu/mem stats (trace_analysis.ipynb)."""
+def analyze(batch_task_path: str, batch_instance_path: str | None = None) -> dict:
+    """Task/instance counts and cpu/mem stats (trace_analysis.ipynb).
+
+    With a batch_instance CSV, also reproduces the notebook's instance-side
+    checks: total instance rows (cell 3 compares this against the sum of the
+    tasks' number_of_instances column) plus two validity counts — the
+    notebook's non-strict predicate (cell 5: non-empty start/end/task_id,
+    end >= start >= 0) and the predicate the simulator actually loads with
+    (start > 0, end > 0, start < end, AND task_id joins a batch_task row
+    with non-empty cpu/mem — kubernetriks_tpu/trace/alibaba.py, mirroring
+    workload.rs:56-120). The join matters when analyzing a filtered task
+    file (fit-only) against the full instance trace: unjoined instances are
+    dropped at load."""
     tasks = 0
     instances = 0
     cpus, mems = [], []
+    joinable_task_ids = set()
     with open(batch_task_path) as f:
         for row in csv.reader(f):
             if not row:
@@ -106,6 +118,7 @@ def analyze(batch_task_path: str) -> dict:
             if len(row) > 7 and row[6] != "" and row[7] != "":
                 cpus.append(float(row[6]))
                 mems.append(float(row[7]))
+                joinable_task_ids.add(row[3])
     stats = {
         "tasks": tasks,
         "instances": instances,
@@ -114,6 +127,26 @@ def analyze(batch_task_path: str) -> dict:
         "mem_mean": float(np.mean(mems)) if mems else None,
         "mem_p75": float(np.quantile(mems, 0.75)) if mems else None,
     }
+    if batch_instance_path is not None:
+        rows = 0
+        valid_notebook = 0
+        valid_simulator = 0
+        with open(batch_instance_path) as f:
+            for row in csv.reader(f):
+                if not row:
+                    continue
+                rows += 1
+                if len(row) < 4 or row[0] == "" or row[1] == "" or row[3] == "":
+                    continue
+                start, end = float(row[0]), float(row[1])
+                if end >= start >= 0:
+                    valid_notebook += 1
+                if 0 < start < end and row[3] in joinable_task_ids:
+                    valid_simulator += 1
+        stats["instance_rows"] = rows
+        stats["instance_rows_valid"] = valid_notebook
+        stats["instance_rows_loadable"] = valid_simulator
+        stats["instances_match_tasks"] = rows == instances
     return stats
 
 
@@ -131,6 +164,7 @@ def main(argv=None) -> int:
     p2.add_argument("--cpu-unit-divisor", type=float, default=100.0)
     p3 = sub.add_parser("analyze")
     p3.add_argument("batch_task")
+    p3.add_argument("batch_instance", nargs="?", default=None)
     args = parser.parse_args(argv)
 
     if args.cmd == "add-only":
@@ -146,7 +180,7 @@ def main(argv=None) -> int:
         )
         print(f"wrote {kept} fitting tasks -> {args.out}")
     else:
-        print(analyze(args.batch_task))
+        print(analyze(args.batch_task, args.batch_instance))
     return 0
 
 

@@ -330,3 +330,29 @@ def init_state(
         pods=pods,
         metrics=metrics,
     )
+
+
+def compare_states(a: ClusterBatchState, b: ClusterBatchState) -> list:
+    """Compare two final state pytrees under the documented parity policy:
+    all simulation state exactly equal; float32 metric estimator accumulators
+    to rtol 1e-6 (their masked (C, K) cycle folds are tiled per program by
+    XLA, so differently-fused programs — scan vs Pallas, resident vs sliding
+    window — can differ by an ulp; see docs/PARITY.md). Returns the keystr
+    paths of mismatching leaves (empty list = parity).
+
+    The single comparison predicate shared by the suite's interpret-mode
+    Pallas tests and scripts/check_tpu_parity.py's on-hardware check.
+    """
+    flat_a, _ = jax.tree_util.tree_flatten_with_path(a)
+    flat_b, _ = jax.tree_util.tree_flatten_with_path(b)
+    bad = []
+    for (path, x), (_, y) in zip(flat_a, flat_b):
+        key = jax.tree_util.keystr(path)
+        xa, ya = np.asarray(x), np.asarray(y)
+        if ".metrics." in key and xa.dtype == np.float32:
+            ok = bool(np.allclose(xa, ya, rtol=1e-6))
+        else:
+            ok = bool(xa.shape == ya.shape and (xa == ya).all())
+        if not ok:
+            bad.append(key)
+    return bad

@@ -14,7 +14,6 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
-import numpy as np
 
 
 def main() -> int:
@@ -55,22 +54,14 @@ def main() -> int:
     jax.block_until_ready(scan_sim.state.time)
     jax.block_until_ready(pallas_sim.state.time)
 
-    flat_a, _ = jax.tree_util.tree_flatten_with_path(scan_sim.state)
-    flat_b, _ = jax.tree_util.tree_flatten_with_path(pallas_sim.state)
-    bad = 0
-    for (path, x), (_, y) in zip(flat_a, flat_b):
-        key = jax.tree_util.keystr(path)
-        xa, ya = np.asarray(x), np.asarray(y)
-        if ".metrics." in key and xa.dtype == np.float32:
-            ok = np.allclose(xa, ya, rtol=1e-6)
-        else:
-            ok = bool((xa == ya).all())
-        if not ok:
-            bad += 1
-            print(f"MISMATCH at {key}")
+    from kubernetriks_tpu.batched.state import compare_states
+
+    bad = compare_states(scan_sim.state, pallas_sim.state)
+    for key in bad:
+        print(f"MISMATCH at {key}")
     decisions = scan_sim.metrics_summary()["counters"]["scheduling_decisions"]
     if bad:
-        print(f"FAIL: {bad} mismatching leaves over {decisions} decisions")
+        print(f"FAIL: {len(bad)} mismatching leaves over {decisions} decisions")
         return 1
     print(
         f"OK: Mosaic kernel == scan path over {decisions} decisions "

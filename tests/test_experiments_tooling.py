@@ -52,6 +52,23 @@ def test_add_only_and_fit_only_filters(tmp_path):
     stats = mt.analyze(str(fit_only))
     assert stats["tasks"] == 2 and stats["instances"] == 2
 
+    # Instance-side analysis (trace_analysis.ipynb cells 3/5): row count vs
+    # task instance sum, and the validity-filter count.
+    instances_csv = tmp_path / "batch_instance.csv"
+    instances_csv.write_text(
+        "10,100,1,1,m1,Terminated,1,1,,,,\n"   # valid under both predicates
+        "20,15,1,3,m1,Terminated,1,3,,,,\n"    # end < start -> invalid
+        ",100,1,3,m1,Terminated,1,1,,,,\n"     # missing start -> invalid
+        "0,50,1,1,m1,Terminated,2,2,,,,\n"     # start==0: notebook-valid, simulator drops
+        "30,30,1,3,m1,Terminated,2,3,,,,\n"    # zero duration: notebook-valid, simulator drops
+        "40,90,1,9,m1,Terminated,1,1,,,,\n"    # task 9 not in the fit-only task file -> no join
+    )
+    stats = mt.analyze(str(fit_only), str(instances_csv))
+    assert stats["instance_rows"] == 6
+    assert stats["instance_rows_valid"] == 4
+    assert stats["instance_rows_loadable"] == 1
+    assert stats["instances_match_tasks"] is False
+
 
 def test_plot_gauges_renders_png(tmp_path):
     pg = _load("plot_gauges")
