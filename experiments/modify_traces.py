@@ -118,7 +118,12 @@ def analyze(batch_task_path: str, batch_instance_path: str | None = None) -> dic
             if len(row) > 7 and row[6] != "" and row[7] != "":
                 cpus.append(float(row[6]))
                 mems.append(float(row[7]))
-                joinable_task_ids.add(row[3])
+                # The simulator joins task_id as an integer, so "007" and
+                # "7" are the same task; mirror that here.
+                try:
+                    joinable_task_ids.add(int(row[3]))
+                except ValueError:
+                    pass
     stats = {
         "tasks": tasks,
         "instances": instances,
@@ -141,7 +146,11 @@ def analyze(batch_task_path: str, batch_instance_path: str | None = None) -> dic
                 start, end = float(row[0]), float(row[1])
                 if end >= start >= 0:
                     valid_notebook += 1
-                if 0 < start < end and row[3] in joinable_task_ids:
+                try:
+                    task_id = int(row[3])
+                except ValueError:
+                    task_id = None
+                if 0 < start < end and task_id in joinable_task_ids:
                     valid_simulator += 1
         stats["instance_rows"] = rows
         stats["instance_rows_valid"] = valid_notebook

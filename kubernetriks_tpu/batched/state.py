@@ -349,10 +349,13 @@ def compare_states(a: ClusterBatchState, b: ClusterBatchState) -> list:
     for (path, x), (_, y) in zip(flat_a, flat_b):
         key = jax.tree_util.keystr(path)
         xa, ya = np.asarray(x), np.asarray(y)
-        if ".metrics." in key and xa.dtype == np.float32:
-            ok = bool(np.allclose(xa, ya, rtol=1e-6))
+        if xa.shape != ya.shape:
+            ok = False
+        elif ".metrics." in key and xa.dtype == np.float32:
+            # atol=0: a should-be-zero accumulator must BE zero.
+            ok = bool(np.allclose(xa, ya, rtol=1e-6, atol=0.0))
         else:
-            ok = bool(xa.shape == ya.shape and (xa == ya).all())
+            ok = bool((xa == ya).all())
         if not ok:
             bad.append(key)
     return bad

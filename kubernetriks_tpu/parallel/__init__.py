@@ -1,0 +1,13 @@
+"""Explicit model-parallelism primitives for the RL policy head.
+
+The simulator itself needs only data parallelism (the cluster batch axis
+shards with no collectives in the step — batched/engine.py). The policy
+network is where TP/SP become real: parallel/ring.py provides ring attention
+(sequence parallelism over the node axis, K/V blocks rotated over the mesh
+via ppermute), and rl/attention_policy.py combines it with megatron-style
+tensor parallelism of the FFN hidden dimension on a (data, seq, model) mesh.
+"""
+
+from kubernetriks_tpu.parallel.ring import full_attention, ring_attention
+
+__all__ = ["full_attention", "ring_attention"]

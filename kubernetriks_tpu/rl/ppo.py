@@ -150,6 +150,7 @@ class PPOTrainer:
         config: PPOConfig = PPOConfig(),
         hidden: int = 64,
         seed: int = 0,
+        policy_kind: str = "mlp",
     ) -> None:
         self.sim = sim
         self.config = config
@@ -157,8 +158,21 @@ class PPOTrainer:
         rng = jax.random.PRNGKey(seed)
         self.rng, init_rng = jax.random.split(rng)
         n_nodes = sim.state.nodes.alive.shape[1]
-        self.policy, self.params = init_policy(init_rng, n_nodes, hidden=hidden)
-        self.policy_apply = self.policy.apply
+        if policy_kind == "attention":
+            from kubernetriks_tpu.rl.attention_policy import (
+                attention_policy_apply,
+                init_attention_policy,
+            )
+
+            self.policy = None
+            self.params = init_attention_policy(init_rng, hidden=hidden)
+            self.policy_apply = attention_policy_apply
+        else:
+            assert policy_kind == "mlp", policy_kind
+            self.policy, self.params = init_policy(
+                init_rng, n_nodes, hidden=hidden
+            )
+            self.policy_apply = self.policy.apply
         self.optimizer = optax.adam(config.learning_rate)
         self.opt_state = self.optimizer.init(self.params)
         self.initial_state = sim.state
