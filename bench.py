@@ -18,6 +18,7 @@ import sys
 import time
 
 import jax
+import numpy as np
 
 BASELINE_DECISIONS_PER_SEC_PER_CHIP = 1_000_000 / 8
 
@@ -51,22 +52,25 @@ def main() -> None:
         max_pods_per_cycle=64,
     )
 
+    def decisions_now() -> int:
+        # Device->host fetch of the (C,) decisions counter: a REAL sync
+        # point. jax.block_until_ready alone intermittently returns early on
+        # the tunneled TPU platform, which would leak device work past the
+        # clock stop and inflate the result.
+        return int(np.asarray(sim.state.metrics.scheduling_decisions).sum())
+
     # Warm-up: 0..190 is 20 windows — the exact chunk shape the timed loop
     # dispatches, so no compilation happens inside the measured region.
     sim.step_until_time(190.0)
-    jax.block_until_ready(sim.state.time)
-    decisions_before = sim.metrics_summary()["counters"]["scheduling_decisions"]
+    decisions_before = decisions_now()
 
     t0 = time.perf_counter()
     end = 390.0
     while end <= 1200.0:
         sim.step_until_time(end)  # 20-window chunks
         end += 200.0
-    jax.block_until_ready(sim.state.time)
+    decisions = decisions_now() - decisions_before
     elapsed = time.perf_counter() - t0
-
-    summary = sim.metrics_summary()
-    decisions = summary["counters"]["scheduling_decisions"] - decisions_before
     decisions_per_sec = decisions / elapsed
 
     print(
