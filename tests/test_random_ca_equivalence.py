@@ -1,0 +1,136 @@
+"""Randomized cluster-autoscaler cross-path equivalence: for generated
+workloads that force scale-up (pods bigger than the base node) and scale-down
+(everything finishes), the batched CA must match the scalar oracle on every
+timing-insensitive invariant (algorithm fidelity reference:
+src/autoscalers/cluster_autoscaler/kube_cluster_autoscaler.rs:55-307).
+
+Exact node-count trajectories are NOT asserted: batched CA decisions read
+state at window boundaries while the scalar CA's scan interleaves mid-window
+(docs/PARITY.md "documented behavioral deviations"), which legitimately
+shifts individual scale events by a window and can split one scale-up
+differently. What must agree regardless of that skew:
+- every pod succeeds in both paths (scheduling outcome fidelity),
+- the PEAK node count (the bin-packed capacity the demand requires),
+- full scale-down back to the base node once the workload drains,
+- scale-up == scale-down within each path, and the totals across paths
+  within 1 (a boundary-straddling unscheduled set may provision one extra
+  interim node)."""
+
+import numpy as np
+import pytest
+
+from kubernetriks_tpu.batched.engine import build_batched_from_traces
+from kubernetriks_tpu.sim.simulator import KubernetriksSimulation
+from kubernetriks_tpu.test_util import default_test_simulation_config
+from kubernetriks_tpu.trace.generic import GenericClusterTrace, GenericWorkloadTrace
+
+CA_CONFIG_SUFFIX = """
+cluster_autoscaler:
+  enabled: true
+  autoscaler_type: kube_cluster_autoscaler
+  scan_interval: 10.0
+  max_node_count: 12
+  node_groups:
+  - node_template:
+      metadata:
+        name: autoscaler_node
+      status:
+        capacity:
+          cpu: 16000
+          ram: 34359738368
+"""
+
+CLUSTER_TRACE = """
+events:
+- timestamp: 2.0
+  event_type:
+    !CreateNode
+      node:
+        metadata: {name: base_node}
+        status: {capacity: {cpu: 8000, ram: 17179869184}}
+"""
+
+
+def make_workload(seed: int) -> str:
+    """Random pods: some fit the 8000-mcpu base node, some only the CA's
+    16000-mcpu template, with staggered arrivals and finite durations so the
+    run ends with a full scale-down."""
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(6, 14))
+    events = []
+    for i in range(n):
+        cpu = int(rng.choice([2000, 4000, 6000, 12000]))
+        # Front-loaded arrivals: no late demand after scale-down begins, so
+        # both paths end with one clean up-then-down cycle.
+        ts = round(float(rng.uniform(3.0, 40.0)), 1)
+        duration = round(float(rng.uniform(20.0, 80.0)), 1)
+        events.append(
+            f"""
+- timestamp: {ts}
+  event_type:
+    !CreatePod
+      pod:
+        metadata:
+          name: pod_{i:03d}
+        spec:
+          resources:
+            requests:
+              cpu: {cpu}
+              ram: {cpu * 1048576}
+            limits:
+              cpu: {cpu}
+              ram: {cpu * 1048576}
+          running_duration: {duration}
+"""
+        )
+    return "events:" + "".join(events)
+
+
+@pytest.mark.parametrize("seed", [7, 23, 57])
+def test_random_ca_trajectory_matches_scalar(seed):
+    config = default_test_simulation_config(CA_CONFIG_SUFFIX)
+    workload = make_workload(seed)
+
+    scalar = KubernetriksSimulation(config)
+    scalar.initialize(
+        GenericClusterTrace.from_yaml(CLUSTER_TRACE),
+        GenericWorkloadTrace.from_yaml(workload),
+    )
+    batched = build_batched_from_traces(
+        config,
+        GenericClusterTrace.from_yaml(CLUSTER_TRACE).convert_to_simulator_events(),
+        GenericWorkloadTrace.from_yaml(workload).convert_to_simulator_events(),
+        n_clusters=1,
+    )
+
+    traj_scalar, traj_batched = [], []
+    # Sample mid-window (boundary + 5 s): both paths' CA effects for the
+    # boundary's scan have landed by then (delays are sub-second).
+    for t in np.arange(15.0, 400.0, 10.0):
+        scalar.step_until_time(float(t))
+        batched.step_until_time(float(t))
+        traj_scalar.append(scalar.api_server.node_count())
+        traj_batched.append(int(np.asarray(batched.state.nodes.alive).sum()))
+
+    # Non-trivial scenario: the CA actually scaled up and fully back down,
+    # identically in both paths.
+    assert max(traj_scalar) > 1, traj_scalar
+    assert max(traj_batched) == max(traj_scalar), (
+        f"seed {seed}: peak batched {max(traj_batched)} != "
+        f"scalar {max(traj_scalar)}\nbatched {traj_batched}\nscalar {traj_scalar}"
+    )
+    assert traj_scalar[-1] == 1 and traj_batched[-1] == 1, (
+        traj_scalar,
+        traj_batched,
+    )
+
+    s = scalar.metrics_collector.accumulated_metrics
+    b = batched.metrics_summary()["counters"]
+    assert b["pods_succeeded"] == s.pods_succeeded
+    # Each path returns to the base node: up == down internally.
+    assert s.total_scaled_up_nodes == s.total_scaled_down_nodes
+    assert b["total_scaled_up_nodes"] == b["total_scaled_down_nodes"]
+    assert abs(b["total_scaled_up_nodes"] - s.total_scaled_up_nodes) <= 1, (
+        f"seed {seed}: scaled_up batched {b['total_scaled_up_nodes']} vs "
+        f"scalar {s.total_scaled_up_nodes}"
+    )
