@@ -25,6 +25,11 @@ from kubernetriks_tpu.batched.autoscale import (
     AutoscaleStatics,
     init_autoscale_state,
 )
+from kubernetriks_tpu.parallel.multihost import (
+    is_cross_process,
+    put_global,
+    to_host,
+)
 from kubernetriks_tpu.batched.state import (
     DEFAULT_RAM_UNIT,
     PHASE_QUEUED,
@@ -423,13 +428,21 @@ class BatchedSimulation:
 
         self.mesh = mesh
         if mesh is not None:
+            # Cross-process meshes (multi-host over DCN) can't device_put a
+            # host-local array onto non-addressable devices; every process
+            # holds the same compiled trace and contributes its shards.
+            put = put_global if is_cross_process(mesh) else jax.device_put
             sharding = NamedSharding(mesh, PartitionSpec(batch_axis))
-            self.state = jax.device_put(self.state, self._state_shardings(sharding, self.state))
-            self.slab = jax.device_put(
-                self.slab, NamedSharding(mesh, PartitionSpec(batch_axis, None))
+            self.state = put(self.state, self._state_shardings(sharding, self.state))
+            self.slab = put(
+                self.slab,
+                jax.tree.map(
+                    lambda _: NamedSharding(mesh, PartitionSpec(batch_axis, None)),
+                    self.slab,
+                ),
             )
             if self.autoscale_statics is not None:
-                self.autoscale_statics = jax.device_put(
+                self.autoscale_statics = put(
                     self.autoscale_statics,
                     self._state_shardings(sharding, self.autoscale_statics),
                 )
@@ -511,7 +524,7 @@ class BatchedSimulation:
         if self.collect_gauges:
             self.state, gauges = out
             self._gauge_windows.append(np.asarray(idxs))
-            self._gauge_samples.append(np.asarray(gauges))
+            self._gauge_samples.append(to_host(gauges))
         else:
             self.state = out
         self.next_window_idx = int(idxs[-1]) + 1
@@ -645,7 +658,7 @@ class BatchedSimulation:
             else contextlib.nullcontext()
         )
         before = (
-            int(np.asarray(self.state.metrics.scheduling_decisions).sum())
+            int(to_host(self.state.metrics.scheduling_decisions).sum())
             if self.log_throughput
             else 0
         )
@@ -656,7 +669,7 @@ class BatchedSimulation:
         elapsed = time.perf_counter() - t0
         if self.log_throughput:
             decisions = (
-                int(np.asarray(self.state.metrics.scheduling_decisions).sum()) - before
+                int(to_host(self.state.metrics.scheduling_decisions).sum()) - before
             )
             cluster_windows = len(idxs) * self.n_clusters
             logging.getLogger(__name__).info(
@@ -695,7 +708,7 @@ class BatchedSimulation:
             self._gauge_windows.append(
                 np.asarray([self.next_window_idx], np.int32)
             )
-            self._gauge_samples.append(np.asarray(gauge_snapshot(self.state))[None])
+            self._gauge_samples.append(to_host(gauge_snapshot(self.state))[None])
         self.next_window_idx += 1
 
     def run_to_completion(self, max_time: float = 1e7) -> None:
@@ -713,8 +726,8 @@ class BatchedSimulation:
             # have advanced strictly past last_event_time + interval.
             if self.next_window <= last_event_time + interval:
                 continue
-            phases = np.asarray(self.state.pods.phase)
-            service = np.asarray(self.state.pods.duration.win) < 0
+            phases = to_host(self.state.pods.phase)
+            service = to_host(self.state.pods.duration.win) < 0
             # Finite-duration pods not yet terminal?
             live = (
                 ((phases == PHASE_QUEUED) | (phases == PHASE_UNSCHEDULABLE))
@@ -731,8 +744,11 @@ class BatchedSimulation:
     # --- readout ------------------------------------------------------------
 
     def metrics_summary(self) -> Dict:
-        """Cross-cluster reduction into the scalar printer's shape."""
-        m = self.state.metrics
+        """Cross-cluster reduction into the scalar printer's shape. On a
+        cross-process mesh the metric arrays allgather over DCN first."""
+        from kubernetriks_tpu.parallel.multihost import to_host
+
+        m = jax.tree.map(to_host, self.state.metrics)
 
         def est(e):
             count = np.asarray(e.count, np.int64)
@@ -782,8 +798,8 @@ class BatchedSimulation:
         len(PodGroupInfo.created_pods))."""
         auto = self.state.auto
         assert auto is not None, "autoscaling is not enabled"
-        head = np.asarray(auto.hpa_head[cluster])
-        tail = np.asarray(auto.hpa_tail[cluster])
+        head = to_host(auto.hpa_head)[cluster]
+        tail = to_host(auto.hpa_tail)[cluster]
         names = self.pod_group_names[cluster]
         return {name: int(tail[i] - head[i]) for i, name in enumerate(names)}
 
@@ -791,7 +807,7 @@ class BatchedSimulation:
         """Current cluster-autoscaler node count per node group."""
         auto = self.state.auto
         assert auto is not None, "autoscaling is not enabled"
-        return np.asarray(auto.ca_count[cluster])
+        return to_host(auto.ca_count)[cluster]
 
     # --- checkpoint / resume ------------------------------------------------
     # The whole simulation state is one pytree of arrays, so checkpointing is
@@ -880,8 +896,8 @@ class BatchedSimulation:
         """Name-keyed pod states for equivalence tests against the scalar
         path. With a sliding pod window, only the currently-resident slots
         appear (shifted-out pods are terminal and already counted)."""
-        phases = np.asarray(self.state.pods.phase[cluster])
-        nodes = np.asarray(self.state.pods.node[cluster])
+        phases = to_host(self.state.pods.phase)[cluster]
+        nodes = to_host(self.state.pods.node)[cluster]
         start_pair = self.state.pods.start_time
         starts = to_f64(
             type(start_pair)(

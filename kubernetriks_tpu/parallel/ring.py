@@ -23,8 +23,11 @@ import jax
 import jax.numpy as jnp
 
 # Finite "minus infinity" for masked scores: keeps exp()/max() NaN-free even
-# for fully-masked blocks (exp(-1e30) underflows cleanly to 0.0).
-_NEG = jnp.float32(-1e30)
+# for fully-masked blocks (exp(-1e30) underflows cleanly to 0.0). A plain
+# Python float: materializing a jnp scalar at import time would initialize
+# the XLA backend, breaking jax.distributed.initialize-before-first-device-op
+# (parallel/multihost.py).
+_NEG = -1e30
 
 
 def _accumulate_block(q, k, v, kv_mask, o, m, l, scale):
