@@ -538,27 +538,27 @@ class BatchedSimulation:
             return
         # Sliding-window dispatch: run sub-spans up to the last window whose
         # pod creations still fit the device window, shifting past terminal
-        # pods between spans. Spans are cut into fixed 32-window chunks plus
-        # single-window steps so only two program shapes ever compile,
-        # whatever span lengths the capacity bound produces.
-        CHUNK = 32
+        # pods between spans. Spans are cut greedily along a geometric chunk
+        # ladder so only len(LADDER) program shapes ever compile while long
+        # spans ride big chunks — ~3x fewer dispatches than fixed 32-window
+        # chunks (per-dispatch overhead is ~20 ms through the tunneled TPU
+        # runtime; replay wall-clock itself is bound by per-window compute,
+        # so this trims the dispatch tax, it does not change the asymptote).
+        LADDER = (128, 32, 8, 1)
         target = int(idxs[-1])
         while self.next_window_idx <= target:
             sub = min(target, self._pod_capacity_window())
-            while self.next_window_idx + CHUNK - 1 <= sub:
+            while self.next_window_idx <= sub:
+                span = sub - self.next_window_idx + 1
+                chunk = next(c for c in LADDER if c <= span)
+                # _step_idxs keeps the profiling/gauge instrumentation on
+                # every dispatch size.
                 self._step_idxs(
                     np.arange(
                         self.next_window_idx,
-                        self.next_window_idx + CHUNK,
+                        self.next_window_idx + chunk,
                         dtype=np.int32,
                     )
-                )
-            while self.next_window_idx <= sub:
-                # Single-window dispatch through _step_idxs keeps the
-                # profiling/gauge instrumentation on the remainder windows
-                # while still compiling only two program shapes.
-                self._step_idxs(
-                    np.asarray([self.next_window_idx], np.int32)
                 )
             if sub >= target:
                 return
