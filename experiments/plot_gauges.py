@@ -32,7 +32,28 @@ def load_gauges(path: str):
     return header, data
 
 
-def plot(path: str, out: str, stride: int = 1) -> None:
+def expected_utilization(t, pods, segments, anchor: float = 0.0):
+    """Piecewise-constant pod-group load curve -> expected per-pod
+    utilization min(1, total_load / pod_count), cyclic and anchored at the
+    group's creation time (the model of core/resource_usage.py PodGroup;
+    reference: src/core/resource_usage/pod_group.rs:16-101). This is the
+    overlay the reference's alibaba_demo.ipynb cell 5 draws over the gauge
+    utilization series."""
+    durations = np.asarray([float(s["duration"]) for s in segments])
+    loads = np.asarray([float(s["total_load"]) for s in segments])
+    cycle = durations.sum()
+    edges = np.cumsum(durations)
+    phase = np.mod(np.asarray(t, np.float64) - anchor, cycle)
+    idx = np.searchsorted(edges, phase, side="right")
+    idx = np.minimum(idx, len(loads) - 1)
+    total_load = loads[idx]
+    pods_safe = np.maximum(np.asarray(pods, np.float64), 1.0)
+    out = np.minimum(1.0, total_load / pods_safe)
+    return np.where(np.asarray(t, np.float64) >= anchor, out, 0.0)
+
+
+def plot(path: str, out: str, stride: int = 1, load_curve: str | None = None,
+         curve_anchor: float = 0.0) -> None:
     header, data = load_gauges(path)
     col = {name: i for i, name in enumerate(header)}
     data = data[::stride]
@@ -55,6 +76,15 @@ def plot(path: str, out: str, stride: int = 1) -> None:
                label=f"CPU mean {cpu.mean():.3f}")
     ax.axhline(float(ram.mean()), linestyle=":", alpha=0.6,
                label=f"RAM mean {ram.mean():.3f}")
+    if load_curve:
+        import yaml
+
+        segments = yaml.safe_load(load_curve)
+        expected = expected_utilization(
+            t, data[:, col["current_pods"]], segments, curve_anchor
+        )
+        ax.plot(t, expected, linestyle="--", alpha=0.8,
+                label="expected (load curve / pods)")
     ax.set_title("Cluster utilization")
     ax.legend(fontsize=8)
     for row in axes:
@@ -71,8 +101,17 @@ def main(argv=None) -> int:
     parser.add_argument("gauge_csv")
     parser.add_argument("out", nargs="?", default="gauge_metrics.png")
     parser.add_argument("--stride", type=int, default=1)
+    parser.add_argument(
+        "--load-curve",
+        default=None,
+        help="YAML list of {duration, total_load} segments; overlays the "
+        "pod-group model's expected utilization on the utilization panel "
+        "(alibaba_demo.ipynb cell 5)",
+    )
+    parser.add_argument("--curve-anchor", type=float, default=0.0,
+                        help="pod-group creation time the cyclic curve anchors to")
     args = parser.parse_args(argv)
-    plot(args.gauge_csv, args.out, args.stride)
+    plot(args.gauge_csv, args.out, args.stride, args.load_curve, args.curve_anchor)
     return 0
 
 

@@ -86,3 +86,19 @@ def test_plot_gauges_renders_png(tmp_path):
     out = tmp_path / "out.png"
     pg.plot(str(gauge_csv), str(out))
     assert out.exists() and out.stat().st_size > 10000
+
+    # Load-curve overlay (alibaba_demo.ipynb cell 5): piecewise-cyclic
+    # expected utilization, clamped at 1, anchored at group creation.
+    import numpy as np
+
+    expected = pg.expected_utilization(
+        np.array([0.0, 50.0, 110.0, 170.0]),
+        np.array([2.0, 2.0, 4.0, 0.0]),
+        [{"duration": 60.0, "total_load": 1.0},
+         {"duration": 60.0, "total_load": 6.0}],
+    )
+    np.testing.assert_allclose(expected, [0.5, 0.5, 1.0, 1.0])
+    out2 = tmp_path / "out_overlay.png"
+    pg.plot(str(gauge_csv), str(out2),
+            load_curve="[{duration: 60.0, total_load: 3.0}]")
+    assert out2.exists() and out2.stat().st_size > 10000
