@@ -86,9 +86,25 @@ def make_workload(seed: int) -> str:
     return "events:" + "".join(events)
 
 
-@pytest.mark.parametrize("seed", [7, 23, 57])
-def test_random_ca_trajectory_matches_scalar(seed):
-    config = default_test_simulation_config(CA_CONFIG_SUFFIX)
+# conditional_move cases run the same scenario under the conditional wake
+# policy. There the scalar CA can CHURN (scale-down removes a busy node whose
+# pods "can be moved", the reschedule re-fills the unscheduled cache, the next
+# scan scales back up — faithful reference feedback, e.g. seed 57 thrashes 20
+# scale-ups for 6 pods), and churn amplifies the documented sub-window timing
+# skew into divergent interim trajectories. For those cases only the
+# churn-insensitive invariants are asserted; the policy itself is pinned by
+# the scenario goldens in test_batched_autoscalers.py.
+@pytest.mark.parametrize(
+    "seed,conditional_move",
+    [(7, False), (23, False), (57, False), (23, True), (57, True)],
+)
+def test_random_ca_trajectory_matches_scalar(seed, conditional_move):
+    suffix = CA_CONFIG_SUFFIX + (
+        "enable_unscheduled_pods_conditional_move: true\n"
+        if conditional_move
+        else ""
+    )
+    config = default_test_simulation_config(suffix)
     workload = make_workload(seed)
 
     scalar = KubernetriksSimulation(config)
@@ -105,32 +121,36 @@ def test_random_ca_trajectory_matches_scalar(seed):
 
     traj_scalar, traj_batched = [], []
     # Sample mid-window (boundary + 5 s): both paths' CA effects for the
-    # boundary's scan have landed by then (delays are sub-second).
-    for t in np.arange(15.0, 400.0, 10.0):
+    # boundary's scan have landed by then (delays are sub-second). The
+    # horizon leaves room for churny runs to settle back to the base node.
+    for t in np.arange(15.0, 800.0, 10.0):
         scalar.step_until_time(float(t))
         batched.step_until_time(float(t))
         traj_scalar.append(scalar.api_server.node_count())
         traj_batched.append(int(np.asarray(batched.state.nodes.alive).sum()))
 
-    # Non-trivial scenario: the CA actually scaled up and fully back down,
-    # identically in both paths.
+    # Churn-insensitive invariants (always): the CA acted, everything
+    # finished, and both paths scaled fully back down to the base node.
     assert max(traj_scalar) > 1, traj_scalar
-    assert max(traj_batched) == max(traj_scalar), (
-        f"seed {seed}: peak batched {max(traj_batched)} != "
-        f"scalar {max(traj_scalar)}\nbatched {traj_batched}\nscalar {traj_scalar}"
-    )
     assert traj_scalar[-1] == 1 and traj_batched[-1] == 1, (
         traj_scalar,
         traj_batched,
     )
-
     s = scalar.metrics_collector.accumulated_metrics
     b = batched.metrics_summary()["counters"]
     assert b["pods_succeeded"] == s.pods_succeeded
     # Each path returns to the base node: up == down internally.
     assert s.total_scaled_up_nodes == s.total_scaled_down_nodes
     assert b["total_scaled_up_nodes"] == b["total_scaled_down_nodes"]
-    assert abs(b["total_scaled_up_nodes"] - s.total_scaled_up_nodes) <= 1, (
-        f"seed {seed}: scaled_up batched {b['total_scaled_up_nodes']} vs "
-        f"scalar {s.total_scaled_up_nodes}"
-    )
+
+    if not conditional_move:
+        # Non-churn scenarios additionally pin the bin-packed capacity.
+        assert max(traj_batched) == max(traj_scalar), (
+            f"seed {seed}: peak batched {max(traj_batched)} != "
+            f"scalar {max(traj_scalar)}\nbatched {traj_batched}\n"
+            f"scalar {traj_scalar}"
+        )
+        assert abs(b["total_scaled_up_nodes"] - s.total_scaled_up_nodes) <= 1, (
+            f"seed {seed}: scaled_up batched {b['total_scaled_up_nodes']} vs "
+            f"scalar {s.total_scaled_up_nodes}"
+        )
