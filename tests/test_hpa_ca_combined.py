@@ -1,0 +1,125 @@
+"""Combined HPA + CA cross-path golden: the horizontal autoscaler scales a
+pod group beyond the base node's capacity, the parked replicas drive a
+cluster-autoscaler scale-up, the load drop walks both back down — and the
+batched path matches the scalar oracle EXACTLY (replica counts, node counts,
+and all autoscaler counters at every 60 s boundary, through two full load
+cycles). This is the full control-loop stack of the reference
+(horizontal_pod_autoscaler.rs + cluster_autoscaler.rs + the unscheduled-pods
+cache of persistent_storage.rs:137-168) interacting in one run."""
+
+import numpy as np
+
+from kubernetriks_tpu.batched.engine import build_batched_from_traces
+from kubernetriks_tpu.sim.simulator import KubernetriksSimulation
+from kubernetriks_tpu.test_util import default_test_simulation_config
+from kubernetriks_tpu.trace.generic import GenericClusterTrace, GenericWorkloadTrace
+
+CONFIG_SUFFIX = """
+horizontal_pod_autoscaler:
+  enabled: true
+cluster_autoscaler:
+  enabled: true
+  autoscaler_type: kube_cluster_autoscaler
+  scan_interval: 10.0
+  max_node_count: 10
+  node_groups:
+  - node_template:
+      metadata:
+        name: ca_node
+      status:
+        capacity:
+          cpu: 8000
+          ram: 17179869184
+"""
+
+CLUSTER_TRACE = """
+events:
+- timestamp: 2.0
+  event_type:
+    !CreateNode
+      node:
+        metadata: {name: base}
+        status: {capacity: {cpu: 8000, ram: 17179869184}}
+"""
+
+# Load curve: idle -> burst (desired 9 > the base node's 4-pod capacity,
+# parking replicas until the CA adds nodes) -> idle (HPA scales to 1, CA
+# drains its nodes), cycling.
+WORKLOAD_TRACE = """
+events:
+- timestamp: 59.5
+  event_type:
+    !CreatePodGroup
+      pod_group:
+        name: grp
+        initial_pod_count: 2
+        max_pod_count: 10
+        pod_template:
+          metadata:
+            name: grp
+          spec:
+            resources:
+              requests: {cpu: 2000, ram: 2147483648}
+              limits: {cpu: 2000, ram: 2147483648}
+        target_resources_usage:
+          cpu_utilization: 0.5
+        resources_usage_model_config:
+          cpu_config:
+            model_name: pod_group
+            config: |
+              - duration: 300.0
+                total_load: 1.0
+              - duration: 300.0
+                total_load: 4.5
+              - duration: 600.0
+                total_load: 0.5
+"""
+
+
+def test_hpa_drives_ca_and_both_paths_agree_exactly():
+    config = default_test_simulation_config(CONFIG_SUFFIX)
+
+    scalar = KubernetriksSimulation(config)
+    scalar.initialize(
+        GenericClusterTrace.from_yaml(CLUSTER_TRACE),
+        GenericWorkloadTrace.from_yaml(WORKLOAD_TRACE),
+    )
+    batched = build_batched_from_traces(
+        config,
+        GenericClusterTrace.from_yaml(CLUSTER_TRACE).convert_to_simulator_events(),
+        GenericWorkloadTrace.from_yaml(WORKLOAD_TRACE).convert_to_simulator_events(),
+        n_clusters=1,
+    )
+
+    expected = {  # (replicas, nodes) at sampled boundaries (probed golden)
+        301.0: (2, 1),
+        421.0: (8, 1),   # burst: HPA upscales before the CA reacts
+        481.0: (9, 2),   # parked replicas pull in CA nodes
+        541.0: (9, 3),   # peak: 9 x 2000 mcpu across 3 x 8000 nodes
+        661.0: (1, 3),   # load drop: HPA scales in first
+        721.0: (1, 1),   # CA drains its idle nodes
+        1201.0: (1, 1),
+        1681.0: (9, 2),  # second cycle reproduces the first
+        1741.0: (9, 3),
+    }
+    for t in np.arange(61.0, 1800.0, 60.0):
+        scalar.step_until_time(float(t))
+        batched.step_until_time(float(t))
+        s_rep = len(scalar.horizontal_pod_autoscaler.pod_groups["grp"].created_pods)
+        b_rep = batched.hpa_replicas(0)["grp"]
+        s_nodes = scalar.api_server.node_count()
+        b_nodes = int(np.asarray(batched.state.nodes.alive).sum())
+        assert (b_rep, b_nodes) == (s_rep, s_nodes), (
+            f"t={t}: batched (replicas, nodes) ({b_rep}, {b_nodes}) != "
+            f"scalar ({s_rep}, {s_nodes})"
+        )
+        if float(t) in expected:
+            assert (s_rep, s_nodes) == expected[float(t)], (
+                f"t={t}: scalar {(s_rep, s_nodes)} != golden {expected[float(t)]}"
+            )
+
+    s = scalar.metrics_collector.accumulated_metrics
+    b = batched.metrics_summary()["counters"]
+    assert b["total_scaled_up_nodes"] == s.total_scaled_up_nodes == 4
+    assert b["total_scaled_up_pods"] == s.total_scaled_up_pods == 15
+    assert b["total_scaled_down_pods"] == s.total_scaled_down_pods == 8
