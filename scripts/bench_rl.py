@@ -8,7 +8,13 @@ Phase 2: 10 training iterations at a smaller batch on a contended workload;
 reports the mean-reward trajectory to demonstrate learning.
 
 Prints one JSON line per phase.
-Usage: python scripts/bench_rl.py [n_clusters] [--skip-learning]
+Usage: python scripts/bench_rl.py [n_clusters] [--skip-learning] [--attention]
+
+--attention benches the attention policy head (rl/attention_policy.py)
+instead of the MLP. Its PPO update is a much larger XLA program (self-
+attention backward over the (T*C, N) batch); on the tunneled dev TPU the
+remote AOT compile helper rejects it above ~2048 clusters — run 2048 there
+(measured 2.4 s/iteration); direct-attached chips take the full batch.
 """
 
 import json
@@ -93,13 +99,14 @@ def build_binpack(n_clusters, seed=13):
     )
 
 
-def main(n_clusters=8192, skip_learning=False) -> None:
+def main(n_clusters=8192, skip_learning=False, policy_kind="mlp") -> None:
     from kubernetriks_tpu.rl.ppo import PPOConfig, PPOTrainer
 
     # --- phase 1: one iteration at scale ------------------------------------
     sim = build(n_clusters)
     trainer = PPOTrainer(
-        sim, windows_per_rollout=16, config=PPOConfig(epochs_per_iteration=4)
+        sim, windows_per_rollout=16, config=PPOConfig(epochs_per_iteration=4),
+        policy_kind=policy_kind,
     )
     warm = trainer.train_iteration()  # compile
     t0 = time.perf_counter()
@@ -108,7 +115,7 @@ def main(n_clusters=8192, skip_learning=False) -> None:
     print(
         json.dumps(
             {
-                "metric": f"PPO iteration, {n_clusters}x8-node clusters, 16 windows x 8 decisions",
+                "metric": f"PPO iteration ({policy_kind} policy), {n_clusters}x8-node clusters, 16 windows x 8 decisions",
                 "value": round(elapsed, 2),
                 "unit": "s/iteration",
                 "decisions_per_s": round(result["decisions"] / elapsed),
@@ -154,4 +161,8 @@ def main(n_clusters=8192, skip_learning=False) -> None:
 
 if __name__ == "__main__":
     n = int(sys.argv[1]) if len(sys.argv) > 1 and sys.argv[1].isdigit() else 8192
-    main(n, skip_learning="--skip-learning" in sys.argv)
+    main(
+        n,
+        skip_learning="--skip-learning" in sys.argv,
+        policy_kind="attention" if "--attention" in sys.argv else "mlp",
+    )
