@@ -212,6 +212,100 @@ def test_pod_removal_while_running_matches():
     assert bm["counters"]["pods_succeeded"] == 0
 
 
+def test_node_removed_same_tick_as_assignment_matches():
+    """Same-tick race: node removal coincides with the scheduling cycle's
+    assignment; the pending-removal guard drops the assignment in the scalar
+    path (reference: tests/test_pods.rs:366-398, api_server.rs:163-193) and
+    the batched removal-time resolution must agree — nothing ever runs."""
+    config = default_test_simulation_config()
+    cluster = (
+        CLUSTER_YAML
+        + """
+- timestamp: 50
+  event_type:
+    !RemoveNode
+      node_name: node_00
+- timestamp: 50
+  event_type:
+    !RemoveNode
+      node_name: node_01
+- timestamp: 250
+  event_type:
+    !RemoveNode
+      node_name: node_02
+"""
+    )
+    # Queued at t=49.x, assigned in the t=50 cycle — the same tick the first
+    # removals land; the late node_02 (created t=200) is removed at t=250,
+    # racing the rescheduled assignment the same way.
+    workload = "events:" + pod_yaml("pod_00", 2000, 4 * GiB, 100.0, 49)
+    scalar = run_scalar(config, cluster, workload, 1000.0)
+    batched = run_batched(config, cluster, workload, 1000.0)
+
+    assert scalar.metrics_collector.accumulated_metrics.pods_succeeded == 0
+    bm = batched.metrics_summary()["counters"]
+    assert bm["pods_succeeded"] == 0
+    # The pod survives, parked/queued with no nodes, in both paths.
+    assert scalar.persistent_storage.get_pod("pod_00") is not None
+    assert batched.pod_view(0)["pod_00"]["phase"] != PHASE_SUCCEEDED
+    assert scalar.api_server.node_count() == 0
+
+
+def test_pod_removed_before_scheduling_matches():
+    """RemovePod while the pod is still parked: dropped from queues, never
+    counted as a node-side removal, and the CA's unscheduled cache forgets
+    it (reference: tests/test_pods.rs:401-449)."""
+    config = default_test_simulation_config()
+    # Too big for every node: parks unschedulable, then removed at t=50.
+    workload = (
+        "events:"
+        + pod_yaml("pod_00", 99000, 99 * GiB, 500.0, 10)
+        + """
+- timestamp: 50
+  event_type:
+    !RemovePod
+      pod_name: pod_00
+"""
+    )
+    scalar = run_scalar(config, CLUSTER_YAML, workload, 1000.0)
+    batched = run_batched(config, CLUSTER_YAML, workload, 1000.0)
+
+    assert scalar.persistent_storage.get_pod("pod_00") is None
+    assert "pod_00" not in scalar.persistent_storage.unscheduled_pods_cache
+    assert scalar.metrics_collector.accumulated_metrics.pods_removed == 0
+    bm = batched.metrics_summary()["counters"]
+    assert bm["pods_removed"] == 0
+    assert bm["pods_succeeded"] == 0
+    from kubernetriks_tpu.batched.state import PHASE_REMOVED
+
+    assert batched.pod_view(0)["pod_00"]["phase"] == PHASE_REMOVED
+
+
+def test_pod_removed_after_finish_matches():
+    """RemovePod landing after the pod already finished: tolerated, counted
+    as succeeded not removed, in both paths (reference:
+    tests/test_pods.rs:597-637, node_component.rs:298-332)."""
+    config = default_test_simulation_config()
+    workload = (
+        "events:"
+        + pod_yaml("pod_00", 2000, 4 * GiB, 50.0, 10)
+        + """
+- timestamp: 500
+  event_type:
+    !RemovePod
+      pod_name: pod_00
+"""
+    )
+    scalar = run_scalar(config, CLUSTER_YAML, workload, 1000.0)
+    batched = run_batched(config, CLUSTER_YAML, workload, 1000.0)
+
+    s = scalar.metrics_collector.accumulated_metrics
+    assert (s.pods_removed, s.pods_succeeded) == (0, 1)
+    bm = batched.metrics_summary()["counters"]
+    assert (bm["pods_removed"], bm["pods_succeeded"]) == (0, 1)
+    assert batched.pod_view(0)["pod_00"]["phase"] == PHASE_SUCCEEDED
+
+
 def test_large_timestamp_equivalence_f64():
     """Fidelity at Alibaba-scale timestamps: the same scenario shifted to
     t ~ 1e6 s must still match the scalar f64 oracle with the reference's
