@@ -11,9 +11,10 @@ answer to the precision problem that float64 solves on CPU:
   64-bit tax (measured ~2x whole-step cost on v5e).
 - The pair splits time into an EXACT integer scheduling-window index (the
   only discrete decision the simulation makes: which window an event lands
-  in) and a bounded offset whose float32 ulp is interval * 2^-24 ≈ 1e-6 s at
-  the default 10 s interval — three orders of magnitude below the smallest
-  modeled delay, and independent of absolute simulation time.
+  in) and a bounded offset carried to within one float32 ulp at `interval`
+  (interval * 2^-23 ≈ 1e-6 s at the default 10 s interval) — three orders of
+  magnitude below the smallest modeled delay, and independent of absolute
+  simulation time.
 
 All pair ops are elementwise 32-bit; comparisons are lexicographic. Offsets
 never store +inf: infinity ("no pending effect") is win >= INF_WIN with
@@ -109,8 +110,8 @@ def to_f64(a: TPair, interval: float) -> np.ndarray:
 def from_f64_np(t: np.ndarray, interval: float):
     """Host-side split of absolute float64 seconds into (win, off) numpy
     arrays. +inf maps to (INF_WIN, 0). The split is computed in float64, so
-    win is exact and off carries only the final float32 rounding
-    (≤ interval * 2^-25)."""
+    win is exact and off carries only the final float32 rounding plus the
+    boundary clamp below (≤ one float32 ulp at `interval`, interval * 2^-23)."""
     t = np.asarray(t, np.float64)
     finite = np.isfinite(t)
     win = np.where(finite, np.floor(t / interval), INF_WIN).astype(np.int64)
@@ -119,4 +120,14 @@ def from_f64_np(t: np.ndarray, interval: float):
     over = finite & (off >= interval)
     win = np.where(over, win + 1, win)
     off = np.where(over, off - interval, off)
-    return win.astype(np.int32), off.astype(np.float32)
+    off32 = off.astype(np.float32)
+    # The float32 cast can round an offset just below the boundary UP to
+    # exactly `interval`. Clamp to the largest float32 below it rather than
+    # carrying: a carry would move the time into the next window, and window
+    # classification must stay exact (it decides which step applies the
+    # event, matching the scalar oracle); the clamp error is at most one
+    # float32 ulp at `interval` (interval * 2^-23, the docstring's bound).
+    off32 = np.minimum(
+        off32, np.nextafter(np.float32(interval), np.float32(0.0))
+    ).astype(np.float32)
+    return win.astype(np.int32), off32

@@ -1,0 +1,119 @@
+"""Direct property tests of the window-indexed 32-bit time representation
+(batched/timerep.py) — the foundation the batched path's precision claims
+rest on. The invariants, checked over randomized values up to Alibaba-scale
+timestamps (~7e5 s) and beyond:
+
+- from_f64 -> to_f64 roundtrips within interval * 2^-24 at ANY magnitude
+  (float32 absolute seconds lose the sub-0.1 s delays above ~1e5 s).
+- pair ordering (t_lt / t_le / t_min) agrees with float64 ordering whenever
+  the float64 gap exceeds the offset ulp.
+- t_norm returns off ∈ [0, interval) and preserves the represented time.
+- t_add matches float64 addition to the same ulp bound.
+- infinity (win >= INF_WIN) propagates through min/compare and never
+  produces NaN.
+"""
+
+import numpy as np
+
+from kubernetriks_tpu.batched.timerep import (
+    INF_WIN,
+    TPair,
+    from_f64_np,
+    is_inf,
+    t_add,
+    t_inf,
+    t_le,
+    t_lt,
+    t_min,
+    t_norm,
+    to_f64,
+)
+
+INTERVAL = 10.0
+# One float32 ulp at `interval`: the cast rounds within half an ulp and the
+# boundary clamp within one — still three orders below the smallest modeled
+# delay (0.023 s).
+ULP = INTERVAL * 2**-23
+
+
+def _pairs(rng, n, t_max=7e5):
+    t = rng.uniform(0.0, t_max, n)
+    # Mix in exact multiples and near-boundary values (the floor guard).
+    t[: n // 8] = np.round(t[: n // 8] / INTERVAL) * INTERVAL
+    t[n // 8 : n // 4] += -t[n // 8 : n // 4] % INTERVAL - 1e-9
+    win, off = from_f64_np(t, INTERVAL)
+    return t, TPair(win=win, off=off)
+
+
+def test_roundtrip_precision_at_alibaba_scale():
+    rng = np.random.default_rng(0)
+    t, pair = _pairs(rng, 4096)
+    back = to_f64(pair, INTERVAL)
+    assert np.max(np.abs(back - t)) <= ULP
+    assert np.all(pair.off >= 0.0) and np.all(pair.off < INTERVAL)
+    # ...where float32 absolute seconds would already have lost the delays:
+    f32_err = np.abs(t.astype(np.float32).astype(np.float64) - t)
+    assert f32_err.max() > 0.01  # ~0.03-0.06 s at 7e5 s
+
+
+def test_ordering_matches_f64():
+    rng = np.random.default_rng(1)
+    t_a, a = _pairs(rng, 4096)
+    t_b, b = _pairs(rng, 4096)
+    # Only compare where f64 separation exceeds the representable ulp.
+    apart = np.abs(t_a - t_b) > 2 * ULP
+    lt = np.asarray(t_lt(a, b))
+    le = np.asarray(t_le(a, b))
+    np.testing.assert_array_equal(lt[apart], (t_a < t_b)[apart])
+    np.testing.assert_array_equal(le[apart], (t_a <= t_b)[apart])
+    # t_le is t_lt-or-equal exactly (pairwise identical components).
+    eq = (np.asarray(a.win) == np.asarray(b.win)) & (
+        np.asarray(a.off) == np.asarray(b.off)
+    )
+    np.testing.assert_array_equal(le, lt | eq)
+    m = t_min(a, b)
+    np.testing.assert_allclose(
+        np.asarray(to_f64(m, INTERVAL))[apart],
+        np.minimum(t_a, t_b)[apart],
+        atol=ULP,
+    )
+
+
+def test_add_and_norm():
+    rng = np.random.default_rng(2)
+    t_a, a = _pairs(rng, 4096)
+    # Delay-like addends: sub-second to a few windows long.
+    t_d = rng.uniform(0.0, 35.0, 4096)
+    dwin, doff = from_f64_np(t_d, INTERVAL)
+    s = t_add(a, TPair(win=dwin, off=doff), np.float32(INTERVAL))
+    off = np.asarray(s.off)
+    assert np.all(off >= 0.0) and np.all(off < INTERVAL)
+    np.testing.assert_allclose(
+        np.asarray(to_f64(s, INTERVAL)), t_a + t_d, atol=4 * ULP
+    )
+    # t_norm with an arbitrary multi-window offset lands in [0, interval)
+    # and preserves the represented time (offsets at the window boundary may
+    # legitimately round the carry up: 30 + 9.9999990 == 40.0 in float32).
+    n = t_norm(a.win, np.float32(3.0) * np.float32(INTERVAL) + a.off, np.float32(INTERVAL))
+    off_n = np.asarray(n.off)
+    assert np.all(off_n >= 0.0) and np.all(off_n < INTERVAL)
+    np.testing.assert_allclose(
+        np.asarray(to_f64(n, INTERVAL)), t_a + 3 * INTERVAL, atol=4 * ULP
+    )
+
+
+def test_infinity_semantics():
+    inf = t_inf((8,))
+    assert np.all(np.asarray(is_inf(inf)))
+    assert np.all(np.isinf(to_f64(inf, INTERVAL)))
+    rng = np.random.default_rng(3)
+    _, a = _pairs(rng, 8)
+    # Finite always sorts before +inf; min picks the finite side.
+    assert np.all(np.asarray(t_lt(a, inf)))
+    assert not np.any(np.asarray(t_lt(inf, a)))
+    m = t_min(inf, a)
+    np.testing.assert_array_equal(np.asarray(m.win), np.asarray(a.win))
+    # from_f64 of +inf maps to the canonical infinite pair, no NaN anywhere.
+    win, off = from_f64_np(np.array([np.inf, 5.0]), INTERVAL)
+    assert win[0] == INF_WIN and off[0] == 0.0
+    assert not np.any(np.isnan(off))
