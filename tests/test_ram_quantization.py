@@ -1,0 +1,100 @@
+"""RAM quantization semantics of the batched path (state.py: requests CEIL
+to RAM_UNIT, capacities FLOOR), tested on deliberately UNALIGNED byte values.
+The guarantee is one-sided: the batched path never overcommits a node
+relative to the byte-exact scalar oracle; in exchange it may conservatively
+park a pod whose byte-exact remainder would have just fit. Aligned values
+(every other test) quantize exactly and the paths agree bit-for-bit."""
+
+import numpy as np
+
+from kubernetriks_tpu.batched.engine import build_batched_from_traces
+from kubernetriks_tpu.batched.state import PHASE_RUNNING, PHASE_UNSCHEDULABLE
+from kubernetriks_tpu.sim.simulator import KubernetriksSimulation
+from kubernetriks_tpu.test_util import default_test_simulation_config
+from kubernetriks_tpu.trace.generic import GenericClusterTrace, GenericWorkloadTrace
+
+MiB = 1024 * 1024
+KiB = 1024
+
+
+def _cluster(cap_ram: int) -> str:
+    return f"""
+events:
+- timestamp: 5
+  event_type:
+    !CreateNode
+      node:
+        metadata: {{name: node_00}}
+        status: {{capacity: {{cpu: 64000, ram: {cap_ram}}}}}
+"""
+
+
+def _pod(name: str, ram: int, ts: float) -> str:
+    return f"""
+- timestamp: {ts}
+  event_type:
+    !CreatePod
+      pod:
+        metadata: {{name: {name}}}
+        spec:
+          resources:
+            requests: {{cpu: 1000, ram: {ram}}}
+            limits: {{cpu: 1000, ram: {ram}}}
+          running_duration: 50.0
+"""
+
+
+def _run_both(cap_ram, pod_rams):
+    config = default_test_simulation_config()
+    cluster = _cluster(cap_ram)
+    workload = "events:" + "".join(
+        _pod(f"pod_{i:02d}", ram, 10.0 + i) for i, ram in enumerate(pod_rams)
+    )
+    scalar = KubernetriksSimulation(config)
+    scalar.initialize(
+        GenericClusterTrace.from_yaml(cluster),
+        GenericWorkloadTrace.from_yaml(workload),
+    )
+    scalar.step_until_time(40.0)
+    batched = build_batched_from_traces(
+        config,
+        GenericClusterTrace.from_yaml(cluster).convert_to_simulator_events(),
+        GenericWorkloadTrace.from_yaml(workload).convert_to_simulator_events(),
+        n_clusters=1,
+    )
+    batched.step_until_time(40.0)
+    return scalar, batched
+
+
+def test_no_overcommit_on_unaligned_bytes():
+    """Two pods whose byte sum exceeds capacity by one byte: NEITHER path runs
+    both concurrently (the quantized path must not manufacture capacity)."""
+    cap = 4096 * MiB
+    scalar, batched = _run_both(cap, [2048 * MiB, 2048 * MiB + 1])
+    # Scalar: second pod byte-exactly exceeds the remainder.
+    assert "pod_01" in scalar.persistent_storage.unscheduled_pods_cache
+    view = batched.pod_view(0)
+    assert view["pod_00"]["phase"] == PHASE_RUNNING
+    assert view["pod_01"]["phase"] == PHASE_UNSCHEDULABLE
+
+
+def test_conservative_park_on_sub_unit_remainder():
+    """The documented one-sided deviation: capacity 4096 MiB + 512 KiB with
+    two requests of 2048 MiB + 256 KiB fits byte-exactly (scalar runs both)
+    but not in MiB quanta (ceil 2049 + 2049 > floor 4096) — the batched path
+    parks the second pod instead of overcommitting."""
+    cap = 4096 * MiB + 512 * KiB
+    req = 2048 * MiB + 256 * KiB
+    scalar, batched = _run_both(cap, [req, req])
+    assert "pod_01" not in scalar.persistent_storage.unscheduled_pods_cache
+    assert scalar.persistent_storage.get_pod("pod_01").status.assigned_node
+    view = batched.pod_view(0)
+    assert view["pod_00"]["phase"] == PHASE_RUNNING
+    assert view["pod_01"]["phase"] == PHASE_UNSCHEDULABLE
+
+    # The batched node books exactly the quantized request, no more.
+    used_units = int(
+        np.asarray(batched.state.nodes.cap_ram[0, 0])
+        - np.asarray(batched.state.nodes.alloc_ram[0, 0])
+    )
+    assert used_units == 2049  # ceil((2048 MiB + 256 KiB) / MiB)
