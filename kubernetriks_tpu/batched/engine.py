@@ -60,8 +60,12 @@ def build_autoscale_statics(
     n_trace_nodes: int,
     ram_unit: int,
     ca_slot_multiplier: int = 2,
+    pod_slot_offset: int = 0,
 ):
     """Host-side compilation of pod-group (HPA) and node-group (CA) tables.
+    pod_slot_offset: global-to-device pod-slot shift for the resident
+    pod-group segment under a sliding pod window (0 = full-resident); the
+    HPA tables live entirely in DEVICE coordinates.
 
     Returns (statics, extra_node_cap_cpu (S,), extra_node_cap_ram (S,),
     extra_node_names); the extra node slots are the CA's reserved slots,
@@ -97,7 +101,7 @@ def build_autoscale_statics(
 
     for ci, c in enumerate(compiled_traces):
         for gi, g in enumerate(c.pod_groups):
-            pg_slot_start[ci, gi] = g.slot_start
+            pg_slot_start[ci, gi] = g.slot_start - pod_slot_offset
             pg_slot_count[ci, gi] = g.slot_count
             pg_initial[ci, gi] = g.initial
             pg_max_pods[ci, gi] = g.max_pods
@@ -121,7 +125,8 @@ def build_autoscale_statics(
                 pg_ram_dur[ci, gi, ui] = dur
                 pg_ram_load[ci, gi, ui] = load
             pg_ram_const[ci, gi] = g.ram_const
-            pod_group_id[ci, g.slot_start : g.slot_start + g.slot_count] = gi
+            dev_start = g.slot_start - pod_slot_offset
+            pod_group_id[ci, dev_start : dev_start + g.slot_count] = gi
 
     # --- CA node groups -----------------------------------------------------
     ca_config = config.cluster_autoscaler
@@ -262,7 +267,41 @@ class BatchedSimulation:
         )
         self.consts = make_step_constants(config)
         self.ram_unit = ram_unit
+        compiled_traces = list(compiled_traces)
         C = len(compiled_traces)
+
+        # Sliding pod window (SURVEY §5.8 host/device streaming, pod axis):
+        # the device pod arrays cover only [pod_base, pod_base + pod_window)
+        # of the trace's PLAIN pod slots; as old pods terminate the window
+        # shifts forward, refilled from the host payload. Per-window cost is
+        # then bounded by max concurrency, not trace length, so arbitrarily
+        # long traces stream through fixed-size device state. HPA pod groups
+        # compose with the window via the segmented slot layout
+        # (trace_compile.segment_pod_slots): their reserved ring slots are
+        # renumbered past every plain pod and stay device-RESIDENT after the
+        # window segment, because group pods are long-running services that
+        # would block the window's terminal-prefix shift.
+        # 0 / negative mirror the CLI's "disabled" sentinel: full-resident.
+        if pod_window is not None and pod_window <= 0:
+            pod_window = None
+        trace_pod_bound = None
+        if any(c.pod_groups for c in compiled_traces):
+            # The segmented layout is CANONICAL whenever pod groups exist,
+            # windowed or not: slot order feeds order-sensitive passes (CA
+            # scale-down re-placement, same-window reschedule ranking), so
+            # windowed and full-resident runs must share one layout to stay
+            # equivalent.
+            from kubernetriks_tpu.batched.trace_compile import segment_pod_slots
+
+            compiled_traces, trace_pod_bound = segment_pod_slots(compiled_traces)
+            if trace_pod_bound == 0:
+                # Pure pod-group workload: nothing for the window to slide
+                # over — every slot is ring-resident; run full-resident.
+                pod_window = None
+        self.pod_window = pod_window
+        self._pod_base = 0
+        self._full_pods = None
+        self._resident_shift = 0
 
         (
             ev_time,
@@ -275,47 +314,53 @@ class BatchedSimulation:
             pod_duration,
         ) = pad_and_batch(compiled_traces)
 
-        # Sliding pod window (SURVEY §5.8 host/device streaming, pod axis):
-        # the device pod arrays cover only [pod_base, pod_base + pod_window)
-        # of the trace's global pod slots; as old pods terminate the window
-        # shifts forward, refilled from the host payload. Per-window cost is
-        # then bounded by max concurrency, not trace length, so arbitrarily
-        # long traces stream through fixed-size device state.
-        # 0 / negative mirror the CLI's "disabled" sentinel: full-resident.
-        if pod_window is not None and pod_window <= 0:
-            pod_window = None
-        self.pod_window = pod_window
-        self._pod_base = 0
-        self._full_pods = None
         if pod_window is not None:
-            assert mesh is None, "pod_window is not supported with a mesh yet"
-            assert not any(c.pod_groups for c in compiled_traces), (
-                "pod_window cannot slide over HPA pod groups (their reserved "
-                "slot rings are position-fixed)"
-            )
+            if mesh is not None:
+                assert not is_cross_process(mesh), (
+                    "pod_window requires a single-process mesh: the window "
+                    "shift reads pod phases and rebuilds the pod arrays on "
+                    "the host, which needs every shard addressable"
+                )
             P_full = pod_req_cpu.shape[1]
-            pod_window = min(pod_window, P_full)
+            # T: first resident (pod-group ring) slot; the window slides over
+            # plain slots [0, T) only.
+            T = trace_pod_bound if trace_pod_bound is not None else P_full
+            pod_window = min(pod_window, T)
             self.pod_window = pod_window
-            # Window index of each global pod slot's create event (slots are
+            self._resident_shift = T - pod_window
+            self.consts = self.consts._replace(
+                trace_pod_bound=np.int32(T),
+                resident_shift=np.int32(self._resident_shift),
+            )
+            # Window index of each plain pod slot's create event (slots are
             # assigned in event order, so this is per-row nondecreasing) —
-            # the O(1) capacity lookup for the dispatch loop.
+            # the O(1) capacity lookup for the dispatch loop. Group-slot
+            # creations (initial replicas) target the resident tail and never
+            # constrain the window.
             ev_win_np, _ = from_f64_np(ev_time, config.scheduling_cycle_interval)
-            create_win = np.full((C, P_full), np.iinfo(np.int32).max, np.int32)
+            create_win = np.full((C, T), np.iinfo(np.int32).max, np.int32)
             rows_np = np.arange(C)[:, None]
-            is_cp = ev_kind == 3  # EV_CREATE_POD
+            is_cp = (ev_kind == 3) & (ev_slot < T)  # EV_CREATE_POD, plain
             create_win[
                 np.broadcast_to(rows_np, ev_kind.shape)[is_cp],
                 ev_slot[is_cp],
             ] = ev_win_np[is_cp]
             self._pod_create_win = create_win
             self._full_pods = {
-                "req_cpu": pod_req_cpu,
-                "req_ram": pod_req_ram,
-                "duration": pod_duration,
+                "req_cpu": pod_req_cpu[:, :T],
+                "req_ram": pod_req_ram[:, :T],
+                "duration": pod_duration[:, :T],
             }
-            pod_req_cpu = pod_req_cpu[:, :pod_window]
-            pod_req_ram = pod_req_ram[:, :pod_window]
-            pod_duration = pod_duration[:, :pod_window]
+            # Device pod arrays: [window over plain slots | resident rings].
+            pod_req_cpu = np.concatenate(
+                [pod_req_cpu[:, :pod_window], pod_req_cpu[:, T:]], axis=1
+            )
+            pod_req_ram = np.concatenate(
+                [pod_req_ram[:, :pod_window], pod_req_ram[:, T:]], axis=1
+            )
+            pod_duration = np.concatenate(
+                [pod_duration[:, :pod_window], pod_duration[:, T:]], axis=1
+            )
 
         # Autoscaler tables (HPA pod groups from the trace, CA node groups from
         # the config); the CA's reserved node slots are appended after the
@@ -334,6 +379,7 @@ class BatchedSimulation:
                 n_trace_nodes=node_cap_cpu.shape[1],
                 ram_unit=ram_unit,
                 ca_slot_multiplier=ca_slot_multiplier,
+                pod_slot_offset=self._resident_shift,
             )
             self.autoscale_statics = statics
             if ca_on and extra_names:
@@ -369,20 +415,27 @@ class BatchedSimulation:
         self.max_pods_per_cycle = max(1, max_pods_per_cycle or self.n_pods)
 
         # Finalize the Pallas decision now that shapes are known. Default: on
-        # for single-device real-TPU runs whose blocks fit VMEM (overridable
-        # via the use_pallas arg or KUBERNETRIKS_PALLAS=0/1); off under a mesh
-        # — pallas_call has no GSPMD partitioning rule for the C-sharded state,
-        # so the scan path keeps multi-chip runs sharded.
+        # for real-TPU runs whose blocks fit VMEM (overridable via the
+        # use_pallas arg or KUBERNETRIKS_PALLAS=0/1). Under a mesh the kernel
+        # runs per-shard through shard_map (step.py), so the gate is the
+        # PER-SHARD cluster count, and C must divide the mesh evenly.
         from kubernetriks_tpu.ops.scheduler_kernel import default_enabled, kernel_fits
 
+        n_shards = 1 if mesh is None else mesh.size
+        if self.use_pallas and mesh is not None:
+            assert self.n_clusters % n_shards == 0, (
+                f"use_pallas under a mesh needs n_clusters ({self.n_clusters}) "
+                f"divisible by the mesh size ({n_shards}) for shard_map"
+            )
         if self._use_pallas_requested is None:
-            # n_clusters >= 64: the kernel pads the cluster batch to full
-            # 128-lane tiles, so tiny batches would waste most of each tile's
-            # VPU work; the scan path is the better default there.
+            # per-shard clusters >= 64: the kernel pads each shard's cluster
+            # batch to full 128-lane tiles, so tiny batches would waste most
+            # of each tile's VPU work; the scan path is the better default
+            # there.
             self.use_pallas = (
                 default_enabled()
-                and mesh is None
-                and self.n_clusters >= 64
+                and self.n_clusters % n_shards == 0
+                and self.n_clusters // n_shards >= 64
                 and kernel_fits(self.n_nodes, self.max_pods_per_cycle)
             )
 
@@ -427,12 +480,15 @@ class BatchedSimulation:
         self.log_throughput = False
 
         self.mesh = mesh
+        self._batch_axis = batch_axis
+        self._sharding = None
         if mesh is not None:
             # Cross-process meshes (multi-host over DCN) can't device_put a
             # host-local array onto non-addressable devices; every process
             # holds the same compiled trace and contributes its shards.
             put = put_global if is_cross_process(mesh) else jax.device_put
             sharding = NamedSharding(mesh, PartitionSpec(batch_axis))
+            self._sharding = sharding
             self.state = put(self.state, self._state_shardings(sharding, self.state))
             self.slab = put(
                 self.slab,
@@ -520,6 +576,8 @@ class BatchedSimulation:
             self.pallas_interpret,
             self.conditional_move,
             self.collect_gauges,
+            pallas_mesh=self.mesh if self.use_pallas else None,
+            pallas_axis=self._batch_axis,
         )
         if self.collect_gauges:
             self.state, gauges = out
@@ -581,7 +639,9 @@ class BatchedSimulation:
     def _advance_pod_window(self) -> bool:
         """Shift the device pod window past the leading run of terminal pods
         (uniform shift across clusters), refilling the tail from the host
-        payload. Returns False if no shift is possible."""
+        payload. Only the window segment [0, pod_window) moves; the resident
+        pod-group tail beyond it is untouched. Returns False if no shift is
+        possible."""
         from kubernetriks_tpu.batched.state import (
             PHASE_FAILED,
             PHASE_REMOVED,
@@ -589,7 +649,8 @@ class BatchedSimulation:
         )
         from kubernetriks_tpu.batched.state import duration_pair_np
 
-        phases = np.asarray(self.state.pods.phase)
+        W = self.pod_window
+        phases = to_host(self.state.pods.phase)[:, :W]
         terminal = (
             (phases == PHASE_SUCCEEDED)
             | (phases == PHASE_REMOVED)
@@ -603,8 +664,8 @@ class BatchedSimulation:
         if s <= 0:
             return False
 
-        C, A = phases.shape
-        lo = self._pod_base + A
+        C = phases.shape[0]
+        lo = self._pod_base + W
         full = self._full_pods
 
         def payload(arr, fill):
@@ -629,8 +690,15 @@ class BatchedSimulation:
                 self.config.scheduling_cycle_interval,
             ),
         )
+        if self.mesh is not None:
+            # Keep the refill columns C-sharded so the concatenation below
+            # composes shard-local slices instead of pulling the state off
+            # the mesh.
+            refill = jax.device_put(
+                refill, self._state_shardings(self._sharding, refill)
+            )
         new_pods = jax.tree.map(
-            lambda a, b: jnp.concatenate([a[:, s:], b], axis=1),
+            lambda a, b: jnp.concatenate([a[:, s:W], b, a[:, W:]], axis=1),
             self.state.pods,
             refill,
         )
@@ -701,6 +769,8 @@ class BatchedSimulation:
             self.use_pallas,
             self.pallas_interpret,
             self.conditional_move,
+            pallas_mesh=self.mesh if self.use_pallas else None,
+            pallas_axis=self._batch_axis,
         )
         if self.collect_gauges:
             from kubernetriks_tpu.batched.step import gauge_snapshot
@@ -901,17 +971,24 @@ class BatchedSimulation:
         start_pair = self.state.pods.start_time
         starts = to_f64(
             type(start_pair)(
-                win=start_pair.win[cluster], off=start_pair.off[cluster]
+                win=to_host(start_pair.win)[cluster],
+                off=to_host(start_pair.off)[cluster],
             ),
             self.config.scheduling_cycle_interval,
         )
         names = self.pod_names[cluster]
         node_names = self.node_names[cluster]
+        W = self.pod_window
         out = {}
         for slot in range(phases.shape[0]):
-            g = self._pod_base + slot
-            if g >= len(names):
-                break
+            # Device slot -> global slot: window segment shifts by pod_base,
+            # the resident pod-group tail by the fixed resident_shift.
+            if W is not None and slot >= W:
+                g = self._resident_shift + slot
+            else:
+                g = self._pod_base + slot
+            if g >= len(names) or not names[g]:
+                continue  # batch padding (or segmented-layout filler) slot
             out[names[g]] = {
                 "phase": int(phases[slot]),
                 "node": node_names[nodes[slot]] if nodes[slot] >= 0 else None,

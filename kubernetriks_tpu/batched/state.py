@@ -212,6 +212,15 @@ class StepConstants(NamedTuple):
     delta_reschedule: float  # node removal -> its pods re-enqueued
     flush_interval: float  # 30 s (reference: queue.rs:11)
     max_unschedulable_stay: float  # 300 s (reference: queue.rs:8)
+    # Segmented pod layout (sliding window + resident pod-group tail): global
+    # pod slots < trace_pod_bound are plain trace pods, mapped to device slots
+    # by subtracting the per-cluster pod_base; slots >= trace_pod_bound are
+    # resident pod-group ring slots, mapped by subtracting resident_shift.
+    # Defaults (bound = huge, shift = 0) make the mapping the identity for
+    # full-resident runs. np.int32 so the traced scalars stay 32-bit under
+    # jax_enable_x64.
+    trace_pod_bound: np.int32 = np.int32(1 << 30)
+    resident_shift: np.int32 = np.int32(0)
 
 
 def make_step_constants(config) -> StepConstants:
@@ -343,8 +352,12 @@ def compare_states(a: ClusterBatchState, b: ClusterBatchState) -> list:
     The single comparison predicate shared by the suite's interpret-mode
     Pallas tests and scripts/check_tpu_parity.py's on-hardware check.
     """
-    flat_a, _ = jax.tree_util.tree_flatten_with_path(a)
-    flat_b, _ = jax.tree_util.tree_flatten_with_path(b)
+    flat_a, tdef_a = jax.tree_util.tree_flatten_with_path(a)
+    flat_b, tdef_b = jax.tree_util.tree_flatten_with_path(b)
+    if tdef_a != tdef_b:
+        # Structurally different states (e.g. autoscaling enabled in only
+        # one) must report as a mismatch, not silently zip-truncate.
+        return [f"<tree structure: {tdef_a} != {tdef_b}>"]
     bad = []
     for (path, x), (_, y) in zip(flat_a, flat_b):
         key = jax.tree_util.keystr(path)

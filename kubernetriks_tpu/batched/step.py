@@ -160,12 +160,23 @@ def _apply_window_events(
         ev_k = pk[..., 2]
         ev_s_raw = pk[..., 3]
         valid = (offs < E_total) & (ev_win < W[:, None])
-        # Pod event slots are GLOBAL; the device pod arrays cover
-        # [pod_base, pod_base + P) (sliding pod window). Out-of-window slots
-        # (already-shifted-out, necessarily terminal pods — e.g. a RemovePod
-        # after its pod finished and scrolled away) drop at the scatters.
+        # Pod event slots are GLOBAL; the device pod arrays are segmented into
+        # a sliding window over plain trace pods (global slot <
+        # consts.trace_pod_bound, device slot = global - pod_base) and a
+        # RESIDENT tail of pod-group ring slots (device slot = global -
+        # consts.resident_shift; pod groups are long-running services, which
+        # would block the window's terminal-prefix shift forever). Both
+        # subtractions are the identity on full-resident runs. Out-of-window
+        # slots (already-shifted-out, necessarily terminal pods — e.g. a
+        # RemovePod after its pod finished and scrolled away) drop at the
+        # scatters.
         is_pod_ev = (ev_k == EV_CREATE_POD) | (ev_k == EV_REMOVE_POD)
-        ev_s = jnp.where(is_pod_ev, ev_s_raw - state.pod_base[:, None], ev_s_raw)
+        seg_shift = jnp.where(
+            ev_s_raw < consts.trace_pod_bound,
+            state.pod_base[:, None],
+            consts.resident_shift,
+        )
+        ev_s = jnp.where(is_pod_ev, ev_s_raw - seg_shift, ev_s_raw)
         ev_s = jnp.where(is_pod_ev & (ev_s < 0), jnp.int32(1 << 29), ev_s)
         # Event time in f32 seconds relative to base (== ev_off when the
         # event is in this window, which consecutive stepping guarantees).
@@ -734,6 +745,8 @@ def _run_scheduling_cycle(
     use_pallas: bool = False,
     pallas_interpret: bool = False,
     conditional_move: bool = False,
+    pallas_mesh=None,
+    pallas_axis: str = "clusters",
 ) -> ClusterBatchState:
     """One vectorized kube-scheduler cycle at window W for every cluster
     (scalar equivalent: reference scheduler.rs:246-333).
@@ -759,14 +772,30 @@ def _run_scheduling_cycle(
         # ordering exactly (see ops/scheduler_kernel.py).
         from kubernetriks_tpu.ops.scheduler_kernel import fused_schedule_cycle
 
-        assign_k, fitany_k, best_k, alloc_cpu, alloc_ram = fused_schedule_cycle(
+        core = partial(fused_schedule_cycle, interpret=pallas_interpret)
+        if pallas_mesh is not None:
+            # pallas_call has no GSPMD partitioning rule, so under a mesh the
+            # kernel runs through shard_map: every device gets its
+            # (C_shard, ...) tile — exactly the layout the kernel's
+            # 128-cluster-lane grid already consumes — and no collectives are
+            # needed (clusters are independent).
+            from jax.sharding import PartitionSpec
+
+            row = PartitionSpec(pallas_axis, None)
+            core = jax.shard_map(
+                core,
+                mesh=pallas_mesh,
+                in_specs=(row,) * 6,
+                out_specs=(row,) * 5,
+                check_vma=False,
+            )
+        assign_k, fitany_k, best_k, alloc_cpu, alloc_ram = core(
             alive,
             state.nodes.alloc_cpu,
             state.nodes.alloc_ram,
             cand_valid,
             cand_req_cpu,
             cand_req_ram,
-            interpret=pallas_interpret,
         )
         park_k = cand_valid & ~fitany_k
     else:
@@ -843,6 +872,8 @@ def _window_body(
     use_pallas: bool = False,
     pallas_interpret: bool = False,
     conditional_move: bool = False,
+    pallas_mesh=None,
+    pallas_axis: str = "clusters",
 ) -> ClusterBatchState:
     W = jnp.broadcast_to(jnp.asarray(W, jnp.int32), state.time.shape)
     state = _apply_window_events(
@@ -856,6 +887,8 @@ def _window_body(
         use_pallas,
         pallas_interpret,
         conditional_move,
+        pallas_mesh,
+        pallas_axis,
     )
     if autoscale_statics is not None:
         # Autoscaler ticks due by this window run after the scheduling cycle
@@ -929,6 +962,8 @@ _STEP_STATICS = (
     "use_pallas",
     "pallas_interpret",
     "conditional_move",
+    "pallas_mesh",
+    "pallas_axis",
 )
 
 
@@ -946,6 +981,8 @@ def window_step(
     use_pallas: bool = False,
     pallas_interpret: bool = False,
     conditional_move: bool = False,
+    pallas_mesh=None,
+    pallas_axis: str = "clusters",
 ) -> ClusterBatchState:
     """Advance every cluster through scheduling-cycle window index W."""
     return _window_body(
@@ -961,6 +998,8 @@ def window_step(
         use_pallas,
         pallas_interpret,
         conditional_move,
+        pallas_mesh,
+        pallas_axis,
     )
 
 
@@ -979,6 +1018,8 @@ def run_windows(
     pallas_interpret: bool = False,
     conditional_move: bool = False,
     collect_gauges: bool = False,
+    pallas_mesh=None,
+    pallas_axis: str = "clusters",
 ):
     """Scan a whole sequence of scheduling-cycle windows on-device (the hot
     benchmark loop: no host round-trips between cycles). window_idxs: (Wn,)
@@ -1002,6 +1043,8 @@ def run_windows(
             use_pallas,
             pallas_interpret,
             conditional_move,
+            pallas_mesh,
+            pallas_axis,
         )
         return new, (gauge_snapshot(new) if collect_gauges else None)
 

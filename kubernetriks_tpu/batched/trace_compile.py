@@ -11,6 +11,7 @@ fresh pool component the same way, reference: src/core/node_component_pool.rs).
 
 from __future__ import annotations
 
+import dataclasses
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -279,6 +280,87 @@ def compile_cluster_trace(
         pod_names=pod_names,
         pod_groups=pod_groups,
     )
+
+
+def segment_pod_slots(
+    compiled: Sequence[CompiledClusterTrace],
+) -> Tuple[List[CompiledClusterTrace], int]:
+    """Renumber pod slots into the segmented layout the sliding pod window
+    needs to coexist with HPA pod groups: plain (non-group) pods occupy
+    global slots [0, T) in their original event order, pod-group reserved
+    ring slots occupy [T, ...), where T is the batch-wide max plain-pod
+    count. Group pods are long-running services — they would block the
+    window's terminal-prefix shift forever — so the window slides only over
+    the plain segment while the ring slots stay device-resident.
+
+    Padding slots inside [plain_count, T) get empty names, zero requests and
+    service duration; they are never targeted by any event. Event ORDER (and
+    hence queue_seq assignment) is unchanged — only slot numbering moves, so
+    the only behavioral deviation is the slot-order stand-in used for
+    same-window reschedule ranking (docs/PARITY.md).
+
+    Returns (renumbered traces, T). Identity (same objects) when no trace
+    has pod groups.
+    """
+    if not any(c.pod_groups for c in compiled):
+        return list(compiled), max((c.n_pods for c in compiled), default=0)
+
+    group_masks = []
+    for c in compiled:
+        is_group = np.zeros(c.n_pods, bool)
+        for g in c.pod_groups:
+            is_group[g.slot_start : g.slot_start + g.slot_count] = True
+        group_masks.append(is_group)
+    T = max(int((~m).sum()) for m in group_masks)
+
+    out: List[CompiledClusterTrace] = []
+    for c, is_group in zip(compiled, group_masks):
+        if c.n_pods == 0:
+            # Nothing to renumber (and new_slot would be empty while node
+            # events still populate ev_slot); pad_and_batch aligns widths.
+            out.append(c)
+            continue
+        R = int(is_group.sum())
+        L = T + R
+        plain_ord = np.cumsum(~is_group) - 1
+        group_ord = np.cumsum(is_group) - 1
+        new_slot = np.where(is_group, T + group_ord, plain_ord).astype(np.int32)
+
+        req_cpu = np.zeros(L, np.int32)
+        req_ram = np.zeros(L, np.int32)
+        duration = np.full(L, -1.0, np.float64)
+        names = [""] * L
+        req_cpu[new_slot] = c.pod_req_cpu
+        req_ram[new_slot] = c.pod_req_ram
+        duration[new_slot] = c.pod_duration
+        for old, new in enumerate(new_slot):
+            names[new] = c.pod_names[old]
+
+        is_pod_ev = (c.ev_kind == EV_CREATE_POD) | (c.ev_kind == EV_REMOVE_POD)
+        ev_slot = np.where(
+            is_pod_ev, new_slot[np.clip(c.ev_slot, 0, c.n_pods - 1)], c.ev_slot
+        ).astype(np.int32)
+
+        groups = [
+            dataclasses.replace(g, slot_start=T + int(group_ord[g.slot_start]))
+            for g in c.pod_groups
+        ]
+        out.append(
+            CompiledClusterTrace(
+                ev_time=c.ev_time,
+                ev_kind=c.ev_kind,
+                ev_slot=ev_slot,
+                node_cap_cpu=c.node_cap_cpu,
+                node_cap_ram=c.node_cap_ram,
+                pod_req_cpu=req_cpu,
+                pod_req_ram=req_ram,
+                pod_duration=duration,
+                node_names=c.node_names,
+                pod_names=names,
+                pod_groups=groups,
+            )
+        )
+    return out, T
 
 
 def pad_and_batch(

@@ -35,7 +35,11 @@ def initialize_from_env(
     device op. Returns True if a multi-process runtime was initialized.
     Safe to call unconditionally: when no coordinator is configured or
     detectable (a plain single-process run), this is a no-op returning
-    False instead of surfacing jax's ValueError."""
+    False, and a repeated call after the runtime (or backend) already
+    started returns whether a multi-process runtime is active instead of
+    surfacing jax's RuntimeError."""
+    if jax.distributed.is_initialized():
+        return jax.process_count() > 1
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
@@ -46,6 +50,14 @@ def initialize_from_env(
         # jax raises when cluster autodetection finds no coordinator; that
         # IS the single-process case this helper promises to tolerate.
         return False
+    except RuntimeError as e:
+        # Tolerate ONLY the late-init case (XLA backend already started —
+        # too late to go distributed, i.e. a plain single-process run).
+        # Genuine distributed-init failures (coordinator unreachable, ...)
+        # also surface as RuntimeError subclasses and must stay loud.
+        if "must be called before" in str(e) or "already initialized" in str(e):
+            return False
+        raise
     return True
 
 

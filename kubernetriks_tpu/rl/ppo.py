@@ -30,6 +30,16 @@ class PPOConfig(NamedTuple):
     entropy_coef: float = 0.01
     learning_rate: float = 3e-4
     epochs_per_iteration: int = 4
+    # Gradient accumulation over cluster chunks of this size (0 = whole
+    # batch in one backward). The chunks ride a lax.scan, so the compiled
+    # program carries ONE chunk-sized backward regardless of C — how the
+    # attention policy's update (a much larger XLA program than the MLP's)
+    # fits the 8192-cluster tracked config through the tunneled dev-TPU
+    # compile helper. Chunk losses are combined with the FULL batch's
+    # normalization (global advantage mean/std, global valid count), so the
+    # accumulated gradient equals the monolithic one up to fp reduction
+    # order.
+    update_microbatch: int = 0
 
 
 def compute_gae(
@@ -75,7 +85,13 @@ def ppo_loss(
     advantages: jnp.ndarray,
     returns: jnp.ndarray,
     config: PPOConfig,
+    denom: Optional[jnp.ndarray] = None,
 ):
+    """Clipped PPO objective. With denom=None (the monolithic path) the
+    advantages are normalized and the loss averaged over this batch's valid
+    decisions; a microbatch caller passes the FULL batch's valid count as
+    denom and pre-normalized advantages, so summing chunk losses reproduces
+    the monolithic objective."""
     logits, values = policy_apply(params, transition.obs)  # (T, C, N), (T, C)
     fit = transition.obs[..., 1] > 0
     # Finite mask value (not -inf): -inf produces NaN gradients through the
@@ -89,12 +105,12 @@ def ppo_loss(
     )[..., 0]
 
     mask = transition.valid.astype(jnp.float32)
-    denom = jnp.maximum(mask.sum(), 1.0)
-
     adv = advantages
-    adv_mean = (adv * mask).sum() / denom
-    adv_std = jnp.sqrt(((adv - adv_mean) ** 2 * mask).sum() / denom + 1e-8)
-    adv = (adv - adv_mean) / adv_std
+    if denom is None:
+        denom = jnp.maximum(mask.sum(), 1.0)
+        adv_mean = (adv * mask).sum() / denom
+        adv_std = jnp.sqrt(((adv - adv_mean) ** 2 * mask).sum() / denom + 1e-8)
+        adv = (adv - adv_mean) / adv_std
 
     ratio = jnp.exp(action_log_prob - transition.log_prob)
     clipped = jnp.clip(ratio, 1.0 - config.clip_eps, 1.0 + config.clip_eps)
@@ -130,9 +146,80 @@ def ppo_update(
     returns,
     config: PPOConfig,
 ):
+    if config.update_microbatch:
+        return _ppo_update_accum(
+            params, opt_state, policy_apply, optimizer,
+            transition, advantages, returns, config,
+        )
     grad_fn = jax.value_and_grad(ppo_loss, has_aux=True)
     (loss, aux), grads = grad_fn(
         params, policy_apply, transition, advantages, returns, config
+    )
+    updates, opt_state = optimizer.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    return params, opt_state, loss, aux
+
+
+def _ppo_update_accum(
+    params,
+    opt_state,
+    policy_apply,
+    optimizer,
+    transition: Transition,
+    advantages,
+    returns,
+    config: PPOConfig,
+):
+    """One optimizer step whose gradient accumulates over cluster chunks via
+    lax.scan: the program holds a single chunk-sized backward, so arbitrary
+    C fits a bounded compile budget (BASELINE config 5: attention-policy PPO
+    at 8192 clusters)."""
+    C = advantages.shape[1]
+    Cc = min(config.update_microbatch, C)
+    assert C % Cc == 0, (
+        f"update_microbatch={Cc} must divide the cluster batch ({C})"
+    )
+    n_chunks = C // Cc
+
+    # Global normalization BEFORE chunking, so chunk losses summed with the
+    # global denom reproduce the monolithic objective.
+    mask = transition.valid.astype(jnp.float32)
+    denom = jnp.maximum(mask.sum(), 1.0)
+    adv_mean = (advantages * mask).sum() / denom
+    adv_std = jnp.sqrt(
+        ((advantages - adv_mean) ** 2 * mask).sum() / denom + 1e-8
+    )
+    adv = (advantages - adv_mean) / adv_std
+
+    def chunked(x):
+        # (T, C, ...) -> (n_chunks, T, Cc, ...)
+        return jnp.swapaxes(
+            x.reshape(x.shape[0], n_chunks, Cc, *x.shape[2:]), 0, 1
+        )
+
+    xs = (jax.tree.map(chunked, transition), chunked(adv), chunked(returns))
+    grad_fn = jax.value_and_grad(ppo_loss, has_aux=True)
+
+    def body(acc, x):
+        tr_c, adv_c, ret_c = x
+        (loss_c, aux_c), grads_c = grad_fn(
+            params, policy_apply, tr_c, adv_c, ret_c, config, denom
+        )
+        grads, loss, aux = acc
+        return (
+            jax.tree.map(jnp.add, grads, grads_c),
+            loss + loss_c,
+            jax.tree.map(jnp.add, aux, aux_c),
+        ), None
+
+    zero_grads = jax.tree.map(jnp.zeros_like, params)
+    zero_aux = {
+        "policy_loss": jnp.float32(0.0),
+        "value_loss": jnp.float32(0.0),
+        "entropy": jnp.float32(0.0),
+    }
+    (grads, loss, aux), _ = jax.lax.scan(
+        body, (zero_grads, jnp.float32(0.0), zero_aux), xs
     )
     updates, opt_state = optimizer.update(grads, opt_state, params)
     params = optax.apply_updates(params, updates)

