@@ -12,11 +12,12 @@ Usage: python scripts/bench_rl.py [n_clusters] [--skip-learning] [--attention]
 
 --attention benches the attention policy head (rl/attention_policy.py)
 instead of the MLP. Its PPO update is a much larger XLA program (self-
-attention backward over the (T*C, N) batch) that the tunneled dev TPU's
-remote AOT compile helper rejects above ~2048 clusters, so above that the
-update runs with gradient accumulation over 2048-cluster chunks
-(PPOConfig.update_microbatch: one chunk-sized backward in a lax.scan,
-bounded program size at any C, same gradient up to fp reduction order).
+attention backward over the (T*C, N) batch) whose padded intermediates
+exceed the tunneled dev TPU's compile/memory budget above ~2048 clusters,
+so above that the update runs with gradient accumulation over <=1024-cluster
+chunks (PPOConfig.update_microbatch: one chunk-sized backward in a lax.scan,
+bounded program size and HBM at any C, same gradient up to fp reduction
+order).
 """
 
 import json
@@ -107,11 +108,13 @@ def main(n_clusters=8192, skip_learning=False, policy_kind="mlp") -> None:
     # --- phase 1: one iteration at scale ------------------------------------
     sim = build(n_clusters)
     # Attention updates above 2048 clusters: chunk the backward (see module
-    # docstring). 2048 is the largest chunk the tunneled compile helper
-    # takes; the chunk must divide the batch, so take the largest divisor.
+    # docstring). 1024 keeps the backward's padded attention intermediates
+    # ((T, Cc, heads, dim) tiles at 8-16x lane-padding expansion) well under
+    # the v5e's 16G HBM; the chunk must divide the batch, so take the
+    # largest divisor <= 1024.
     microbatch = 0
     if policy_kind == "attention" and n_clusters > 2048:
-        microbatch = max(d for d in range(1, 2049) if n_clusters % d == 0)
+        microbatch = max(d for d in range(1, 1025) if n_clusters % d == 0)
     trainer = PPOTrainer(
         sim, windows_per_rollout=16,
         config=PPOConfig(epochs_per_iteration=4, update_microbatch=microbatch),

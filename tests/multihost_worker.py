@@ -1,0 +1,91 @@
+"""Worker for the two-process DCN harness (tests/test_multihost.py).
+
+Each of the two processes owns 4 virtual CPU devices; jax.distributed glues
+them into one 8-device world (gloo CPU collectives stand in for DCN), so the
+CROSS-process branches of parallel/multihost.py — put_global assembling a
+global array from per-process shards, to_host allgathering non-addressable
+shards — execute for real, followed by a BatchedSimulation stepping SPMD on
+the cross-process mesh.
+
+Run: python multihost_worker.py <process_id> <coordinator_port>
+Prints ROUNDTRIP_OK / ENGINE_OK lines consumed by the launcher test.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(pid: int, port: str) -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from kubernetriks_tpu.parallel.multihost import initialize_from_env
+
+    assert initialize_from_env(
+        coordinator_address=f"localhost:{port}", num_processes=2, process_id=pid
+    )
+    assert jax.process_count() == 2
+    assert len(jax.devices()) == 8 and len(jax.local_devices()) == 4
+
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from kubernetriks_tpu.parallel.multihost import (
+        global_mesh,
+        is_cross_process,
+        put_global,
+        to_host,
+    )
+
+    mesh = global_mesh()
+    assert is_cross_process(mesh)
+
+    # put_global -> to_host roundtrip through the non-addressable branches.
+    host = np.arange(64, dtype=np.int32).reshape(8, 8)
+    sharding = NamedSharding(mesh, PartitionSpec("clusters", None))
+    g = put_global({"x": host}, {"x": sharding})["x"]
+    assert not g.is_fully_addressable
+    np.testing.assert_array_equal(to_host(g), host)
+    print(f"ROUNDTRIP_OK {pid}", flush=True)
+
+    # Engine end-to-end on the cross-process mesh: trace upload via
+    # put_global, SPMD window stepping, metric readout via allgather.
+    from kubernetriks_tpu.batched.engine import build_batched_from_traces
+    from kubernetriks_tpu.config import SimulationConfig
+    from kubernetriks_tpu.trace.generator import (
+        PoissonWorkloadTrace,
+        UniformClusterTrace,
+    )
+
+    config = SimulationConfig.from_yaml(
+        "sim_name: mh2\nseed: 1\nscheduling_cycle_interval: 10.0"
+    )
+    cluster = UniformClusterTrace(4, cpu=16000, ram=32 * 1024**3)
+    workload = PoissonWorkloadTrace(
+        rate_per_second=0.5,
+        horizon=60.0,
+        seed=2,
+        cpu=2000,
+        ram=4 * 1024**3,
+        duration_range=(10.0, 30.0),
+    )
+    sim = build_batched_from_traces(
+        config,
+        cluster.convert_to_simulator_events(),
+        workload.convert_to_simulator_events(),
+        n_clusters=16,
+        max_pods_per_cycle=8,
+        mesh=mesh,
+    )
+    assert not sim.state.pods.phase.is_fully_addressable
+    sim.step_until_time(100.0)
+    counters = sim.metrics_summary()["counters"]
+    assert counters["processed_nodes"] == 4 * 16, counters
+    assert counters["scheduling_decisions"] > 0
+    print(f"ENGINE_OK {pid} {counters['scheduling_decisions']}", flush=True)
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]), sys.argv[2])

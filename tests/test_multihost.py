@@ -1,8 +1,9 @@
-"""Multi-host placement/readout helpers, exercised with their single-process
-degenerate semantics on the suite's 8-device virtual mesh (a real DCN run
-differs only in which branch is_cross_process/to_host select — the
-cross-process branches use jax's documented multihost APIs on the same
-shardings)."""
+"""Multi-host placement/readout helpers: single-process degenerate semantics
+on the suite's 8-device virtual mesh, plus a REAL two-process
+jax.distributed harness (test_two_process_cross_process_branches) that
+executes the cross-process branches of put_global/to_host — gloo CPU
+collectives standing in for DCN — and steps a BatchedSimulation SPMD on the
+cross-process mesh."""
 
 import jax
 import jax.numpy as jnp
@@ -34,6 +35,49 @@ def test_initialize_from_env_is_noop_without_coordinator():
     env = {k: v for k, v in os.environ.items() if not k.startswith("JAX_COORD")}
     env["JAX_PLATFORMS"] = "cpu"
     subprocess.run([sys.executable, "-c", code], env=env, check=True, timeout=120)
+
+
+def test_two_process_cross_process_branches():
+    """Two jax.distributed CPU processes (4 virtual devices each, one
+    8-device world): put_global assembles global arrays from per-process
+    shards, to_host allgathers non-addressable arrays, and the engine steps
+    on the cross-process mesh end to end (tests/multihost_worker.py)."""
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)), "multihost_worker.py")
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(i), str(port)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=600)
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out[-4000:]}"
+        assert f"ROUNDTRIP_OK {i}" in out
+        assert f"ENGINE_OK {i}" in out
+    # Both processes computed identical global metrics.
+    d0 = [l for l in outs[0].splitlines() if l.startswith("ENGINE_OK")][0].split()[2]
+    d1 = [l for l in outs[1].splitlines() if l.startswith("ENGINE_OK")][0].split()[2]
+    assert d0 == d1
 
 
 def test_put_global_matches_device_put():

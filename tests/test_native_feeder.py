@@ -329,3 +329,75 @@ def test_native_rejects_malformed_machine_id(tmp_path):
     instances.write_text("10,100,1,7,garbage,Terminated,0,1\n")
     with pytest.raises(ValueError, match="machine_id"):
         feeder.load_workload_arrays(str(instances), str(tasks))
+
+
+# --- opt-in real-trace tier -------------------------------------------------
+# Mirrors the reference's #[ignore]d real-CSV tests
+# (/root/reference/src/trace/alibaba_cluster_trace_v2017/workload.rs:206-219):
+# with KUBERNETRIKS_ALIBABA_DIR pointing at a directory holding the real
+# Alibaba v2017 machine_events.csv / batch_task.csv / batch_instance.csv,
+# the C++ feeder and the Python oracle must agree row for row at full scale.
+
+import os
+
+_REAL_DIR = os.environ.get("KUBERNETRIKS_ALIBABA_DIR")
+
+
+def _real_path(name):
+    path = os.path.join(_REAL_DIR, name)
+    assert os.path.exists(path), f"KUBERNETRIKS_ALIBABA_DIR lacks {name}"
+    return path
+
+
+@pytest.mark.skipif(
+    not _REAL_DIR, reason="set KUBERNETRIKS_ALIBABA_DIR to the real v2017 CSVs"
+)
+def test_real_alibaba_workload_native_matches_python():
+    inst = _real_path("batch_instance.csv")
+    task = _real_path("batch_task.csv")
+
+    arrays = feeder.load_workload_arrays(inst, task)
+    python = AlibabaWorkloadTraceV2017.from_files(inst, task).convert_to_simulator_events()
+
+    n = len(arrays.start_ts)
+    assert len(python) == n > 0
+    p_ts = np.fromiter((ts for ts, _ in python), np.float64, count=n)
+    p_cpu = np.fromiter(
+        (ev.pod.spec.resources.requests.cpu for _, ev in python), np.int64, count=n
+    )
+    p_ram = np.fromiter(
+        (ev.pod.spec.resources.requests.ram for _, ev in python), np.int64, count=n
+    )
+    p_dur = np.fromiter(
+        (ev.pod.spec.running_duration for _, ev in python), np.float64, count=n
+    )
+    np.testing.assert_array_equal(arrays.start_ts, p_ts)
+    np.testing.assert_array_equal(arrays.cpu_millicores.astype(np.int64), p_cpu)
+    np.testing.assert_array_equal(arrays.ram_bytes.astype(np.int64), p_ram)
+    np.testing.assert_array_equal(arrays.duration, p_dur)
+    # Names spot-check across the span (full string compare of 4M rows is
+    # pointless once the numeric join keys match).
+    for i in np.linspace(0, n - 1, 997).astype(int):
+        assert arrays.pod_name(int(i)) == python[int(i)][1].pod.metadata.name
+
+
+@pytest.mark.skipif(
+    not _REAL_DIR, reason="set KUBERNETRIKS_ALIBABA_DIR to the real v2017 CSVs"
+)
+def test_real_alibaba_cluster_native_matches_python():
+    machines = _real_path("machine_events.csv")
+
+    arrays = feeder.load_cluster_arrays(machines)
+    native = feeder.cluster_events_from_arrays(arrays)
+    python = _python_cluster_events(open(machines).read())
+
+    assert len(native) == len(python) > 0
+    for (nts, nev), (pts, pev) in zip(native, python):
+        assert nts == pts
+        assert type(nev) is type(pev)
+        if isinstance(nev, CreateNodeRequest):
+            assert nev.node.metadata.name == pev.node.metadata.name
+            assert nev.node.status.capacity.cpu == pev.node.status.capacity.cpu
+            assert nev.node.status.capacity.ram == pev.node.status.capacity.ram
+        else:
+            assert nev.node_name == pev.node_name
