@@ -561,18 +561,17 @@ def decision_metrics(metrics, assign_k, pod_queue_time_k, pod_sched_time):
     )
 
 
-def prepare_cycle(
+def prepare_queue(
     state: ClusterBatchState,
     W: jnp.ndarray,
     consts: StepConstants,
-    K: int,
     conditional_move: bool = False,
-) -> CycleCandidates:
-    """Cycle preamble shared by the kube-scheduler and RL-policy cycles:
-    unschedulable wake/flush moves, queue sort, top-K compaction. W: (C,)
-    int32 window index (cycle time T = W * interval)."""
+):
+    """Queue preamble shared by every cycle path (sorted-scan, Pallas
+    candidate kernel, Pallas selection kernel, RL): unschedulable wake/flush
+    moves and the eligibility mask. Returns (pods with moves applied,
+    last_flush_win, eligible (C, P))."""
     C, P = state.pods.phase.shape
-    rows = jnp.arange(C, dtype=jnp.int32)[:, None]
     pods = state.pods
     interval = jnp.float32(consts.scheduling_interval)
     Tpair = TPair(
@@ -620,15 +619,26 @@ def prepare_cycle(
     pods = pods._replace(phase=phase2, attempts=attempts2)
     last_flush_win = jnp.where(flush_now, W, state.last_flush_win)
 
-    # Queue order: (queue_ts, queue_seq); eligible = queued strictly before T
-    # — with pair times that is exactly queue_ts.win < W.
+    # Eligible = queued strictly before T — with pair times that is exactly
+    # queue_ts.win < W.
     eligible = (pods.phase == PHASE_QUEUED) & (pods.queue_ts.win < W[:, None])
-    sort_t = t_where(eligible, pods.queue_ts, t_inf((C, P)))
-    sort_seq = jnp.where(eligible, pods.queue_seq, jnp.iinfo(jnp.int32).max)
-    order = lexsort_time_i32(sort_t, sort_seq)  # (C, P)
+    return pods, last_flush_win, eligible
 
-    cand = order[:, :K]
-    cand_valid = eligible[rows, cand]
+
+def candidates_from_slots(
+    pods,
+    last_flush_win: jnp.ndarray,
+    cand: jnp.ndarray,
+    valid: jnp.ndarray,
+    W: jnp.ndarray,
+    consts: StepConstants,
+) -> CycleCandidates:
+    """Assemble CycleCandidates from chosen candidate slots — the gathers
+    and the `waited` formula shared by the sorted path and the in-kernel
+    selection path (ONE definition, so the paths cannot drift)."""
+    C = cand.shape[0]
+    rows = jnp.arange(C, dtype=jnp.int32)[:, None]
+    interval = jnp.float32(consts.scheduling_interval)
     init_win = pods.initial_attempt_ts.win[rows, cand]
     init_off = pods.initial_attempt_ts.off[rows, cand]
     waited = (W[:, None] - init_win).astype(jnp.float32) * interval - init_off
@@ -636,10 +646,36 @@ def prepare_cycle(
         pods=pods,
         last_flush_win=last_flush_win,
         cand=cand,
-        valid=cand_valid,
+        valid=valid,
         req_cpu=pods.req_cpu[rows, cand],
         req_ram=pods.req_ram[rows, cand],
         waited=waited,
+    )
+
+
+def prepare_cycle(
+    state: ClusterBatchState,
+    W: jnp.ndarray,
+    consts: StepConstants,
+    K: int,
+    conditional_move: bool = False,
+) -> CycleCandidates:
+    """prepare_queue + queue sort + top-K compaction. W: (C,) int32 window
+    index (cycle time T = W * interval)."""
+    C, P = state.pods.phase.shape
+    rows = jnp.arange(C, dtype=jnp.int32)[:, None]
+    pods, last_flush_win, eligible = prepare_queue(
+        state, W, consts, conditional_move
+    )
+
+    # Queue order: (queue_ts, queue_seq).
+    sort_t = t_where(eligible, pods.queue_ts, t_inf((C, P)))
+    sort_seq = jnp.where(eligible, pods.queue_seq, jnp.iinfo(jnp.int32).max)
+    order = lexsort_time_i32(sort_t, sort_seq)  # (C, P)
+
+    cand = order[:, :K]
+    return candidates_from_slots(
+        pods, last_flush_win, cand, eligible[rows, cand], W, consts
     )
 
 
@@ -747,6 +783,7 @@ def _run_scheduling_cycle(
     conditional_move: bool = False,
     pallas_mesh=None,
     pallas_axis: str = "clusters",
+    use_pallas_select: bool = False,
 ) -> ClusterBatchState:
     """One vectorized kube-scheduler cycle at window W for every cluster
     (scalar equivalent: reference scheduler.rs:246-333).
@@ -759,12 +796,66 @@ def _run_scheduling_cycle(
     C, P = state.pods.phase.shape
     N = state.nodes.alive.shape[1]
 
-    cc = prepare_cycle(state, W, consts, max_pods_per_cycle, conditional_move)
-    cand_valid, cand_req_cpu, cand_req_ram = cc.valid, cc.req_cpu, cc.req_ram
-
     alive = state.nodes.alive
     alive_count = alive.sum(axis=1, dtype=jnp.int32).astype(jnp.float32)
     pod_sched_time = jnp.float32(consts.time_per_node) * alive_count  # (C,)
+
+    if use_pallas and use_pallas_select:
+        # Fully fused path: queue selection happens IN-KERNEL by iterated
+        # lexicographic argmin, replacing the (C, P) 3-key sort + top-K
+        # gathers — the fixed per-window cost the sort path pays even on
+        # empty queues (see ops/scheduler_kernel.py).
+        from kubernetriks_tpu.ops.scheduler_kernel import (
+            fused_select_schedule_cycle,
+        )
+
+        pods, last_flush_win, eligible = prepare_queue(
+            state, W, consts, conditional_move
+        )
+        core = partial(
+            fused_select_schedule_cycle,
+            k_pods=max_pods_per_cycle,
+            interpret=pallas_interpret,
+        )
+        if pallas_mesh is not None:
+            from jax.sharding import PartitionSpec
+
+            row = PartitionSpec(pallas_axis, None)
+            core = jax.shard_map(
+                core,
+                mesh=pallas_mesh,
+                in_specs=(row,) * 9,
+                out_specs=(row,) * 7,
+                check_vma=False,
+            )
+        cand, cand_valid, assign_k, fitany_k, best_k, alloc_cpu, alloc_ram = core(
+            alive,
+            state.nodes.alloc_cpu,
+            state.nodes.alloc_ram,
+            eligible,
+            pods.queue_ts.win,
+            pods.queue_ts.off,
+            pods.queue_seq,
+            pods.req_cpu,
+            pods.req_ram,
+        )
+        cc = candidates_from_slots(
+            pods, last_flush_win, cand, cand_valid, W, consts
+        )
+        park_k = cand_valid & ~fitany_k
+        pod_queue_time_k, start_s_k, park_s_k = cycle_timing(
+            cand_valid, cc.waited, pod_sched_time, consts
+        )
+        metrics = decision_metrics(
+            state.metrics, assign_k, pod_queue_time_k, pod_sched_time
+        )
+        return commit_cycle(
+            state, cc, W, consts, alloc_cpu, alloc_ram, metrics,
+            assign_k, park_k, best_k, start_s_k, park_s_k,
+        )
+
+    cc = prepare_cycle(state, W, consts, max_pods_per_cycle, conditional_move)
+    cand_valid, cand_req_cpu, cand_req_ram = cc.valid, cc.req_cpu, cc.req_ram
 
     if use_pallas:
         # The (C, N)-heavy core runs as a fused VMEM kernel; the (C,)-shaped
@@ -874,6 +965,7 @@ def _window_body(
     conditional_move: bool = False,
     pallas_mesh=None,
     pallas_axis: str = "clusters",
+    use_pallas_select: bool = False,
 ) -> ClusterBatchState:
     W = jnp.broadcast_to(jnp.asarray(W, jnp.int32), state.time.shape)
     state = _apply_window_events(
@@ -889,6 +981,7 @@ def _window_body(
         conditional_move,
         pallas_mesh,
         pallas_axis,
+        use_pallas_select,
     )
     if autoscale_statics is not None:
         # Autoscaler ticks due by this window run after the scheduling cycle
@@ -964,6 +1057,7 @@ _STEP_STATICS = (
     "conditional_move",
     "pallas_mesh",
     "pallas_axis",
+    "use_pallas_select",
 )
 
 
@@ -983,6 +1077,7 @@ def window_step(
     conditional_move: bool = False,
     pallas_mesh=None,
     pallas_axis: str = "clusters",
+    use_pallas_select: bool = False,
 ) -> ClusterBatchState:
     """Advance every cluster through scheduling-cycle window index W."""
     return _window_body(
@@ -1000,6 +1095,7 @@ def window_step(
         conditional_move,
         pallas_mesh,
         pallas_axis,
+        use_pallas_select,
     )
 
 
@@ -1020,6 +1116,7 @@ def run_windows(
     collect_gauges: bool = False,
     pallas_mesh=None,
     pallas_axis: str = "clusters",
+    use_pallas_select: bool = False,
 ):
     """Scan a whole sequence of scheduling-cycle windows on-device (the hot
     benchmark loop: no host round-trips between cycles). window_idxs: (Wn,)
@@ -1045,6 +1142,7 @@ def run_windows(
             conditional_move,
             pallas_mesh,
             pallas_axis,
+            use_pallas_select,
         )
         return new, (gauge_snapshot(new) if collect_gauges else None)
 

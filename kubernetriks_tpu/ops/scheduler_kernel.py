@@ -161,6 +161,221 @@ def _cycle_kernel(
     jax.lax.while_loop(lambda k: k < k_bound, loop_body, jnp.int32(0))
 
 
+# The selection kernel asks Mosaic for a raised scoped-VMEM limit; its
+# fits-check budget must stay at ~40% of that because Mosaic double-buffers
+# the grid blocks.
+_SELECT_VMEM_LIMIT = 100 * 1024 * 1024
+
+
+def select_kernel_fits(n_nodes: int, n_pods: int, k_pods: int) -> bool:
+    """Whether the selection+cycle kernel's VMEM blocks fit: 6 pod blocks of
+    (Pp, 128) + 5 node blocks + 5 candidate output blocks + 1 pod scratch,
+    all int32, double-buffered across grid programs by Mosaic. The pod
+    blocks dominate; the budget is more generous than the candidate
+    kernel's because this kernel REPLACES the (C, P) lexsort and gathers,
+    so its win grows with P (v5e VMEM is ~128 MiB/core)."""
+    np_pad = -(-n_nodes // _SUB) * _SUB
+    pp_pad = -(-n_pods // _SUB) * _SUB
+    kp_pad = -(-k_pods // _SUB) * _SUB
+    resident = (5 * np_pad + 7 * pp_pad + 5 * kp_pad) * _LANE * 4
+    return 2 * resident <= int(0.8 * _SELECT_VMEM_LIMIT)
+
+
+def _select_cycle_kernel(
+    n_nodes: int,
+    k_pods: int,
+    alive_ref,      # (Np, LC) int32
+    alloc_cpu_ref,  # (Np, LC) int32
+    alloc_ram_ref,  # (Np, LC) int32
+    elig_ref,       # (Pp, LC) int32 0/1
+    qwin_ref,       # (Pp, LC) int32 queue_ts.win
+    qoff_ref,       # (Pp, LC) int32 BITCAST of queue_ts.off (non-negative
+                    #  f32, so the bit pattern orders identically to the float)
+    qseq_ref,       # (Pp, LC) int32
+    preq_cpu_ref,   # (Pp, LC) int32
+    preq_ram_ref,   # (Pp, LC) int32
+    cpu_out,        # (Np, LC) int32
+    ram_out,        # (Np, LC) int32
+    cand_out,       # (Kp, LC) int32 selected pod slot
+    valid_out,      # (Kp, LC) int32
+    assign_out,     # (Kp, LC) int32
+    fitany_out,     # (Kp, LC) int32
+    best_out,       # (Kp, LC) int32
+    rem_ref,        # (Pp, LC) int32 scratch: not-yet-selected eligible pods
+):
+    """Fused queue selection + scheduling cycle: candidate k is extracted
+    IN-KERNEL by an iterated per-lane lexicographic argmin over
+    (queue win, off, seq) — exactly the sorted order of the batched
+    ActiveQueue (step.lexsort_time_i32), seq unique per cluster, so the
+    extraction is deterministic — then scheduled against the VMEM-resident
+    node tile like _cycle_kernel. Replaces the (C, P) 3-key sort + top-K
+    compaction gathers of prepare_cycle with O(live-queue-depth) passes,
+    which is where dense shapes spend their fixed per-window cost."""
+    i0 = jnp.int32(0)
+    i1 = jnp.int32(1)
+    neg1 = jnp.int32(-1)
+    bigi = jnp.int32(np.iinfo(np.int32).max)
+    hundred = jnp.float32(100.0)
+    half = jnp.float32(0.5)
+    neg_inf = jnp.float32(_NEG_INF)
+
+    cpu_out[:] = alloc_cpu_ref[:]
+    ram_out[:] = alloc_ram_ref[:]
+    alive = alive_ref[:] != i0
+    iota_n = jax.lax.broadcasted_iota(jnp.int32, alive.shape, 0)
+    node_ok = iota_n < jnp.int32(n_nodes)
+
+    cand_out[:] = jnp.zeros_like(cand_out)
+    valid_out[:] = jnp.zeros_like(valid_out)
+    assign_out[:] = jnp.zeros_like(assign_out)
+    fitany_out[:] = jnp.zeros_like(fitany_out)
+    best_out[:] = jnp.zeros_like(best_out)
+    rem_ref[:] = elig_ref[:]
+
+    iota_p = jax.lax.broadcasted_iota(jnp.int32, elig_ref.shape, 0)
+    # Early exit: the deepest per-lane queue in this tile bounds the loop.
+    depth = jnp.max(jnp.sum(elig_ref[:], axis=0, keepdims=True))
+    k_bound = jnp.minimum(depth, jnp.int32(k_pods))
+
+    def body(k):
+        rem = rem_ref[:] != i0  # (Pp, LC)
+        # Per-lane lexicographic argmin over (win, off-bits, seq).
+        w = jnp.where(rem, qwin_ref[:], bigi)
+        minw = jnp.min(w, axis=0, keepdims=True)
+        m1 = rem & (qwin_ref[:] == minw)
+        o = jnp.where(m1, qoff_ref[:], bigi)
+        mino = jnp.min(o, axis=0, keepdims=True)
+        m2 = m1 & (qoff_ref[:] == mino)
+        s = jnp.where(m2, qseq_ref[:], bigi)
+        mins = jnp.min(s, axis=0, keepdims=True)
+        sel = m2 & (qseq_ref[:] == mins)  # exactly one row per non-empty lane
+
+        seli = sel.astype(jnp.int32)
+        slot = jnp.max(jnp.where(sel, iota_p, neg1), axis=0, keepdims=True)
+        valid = slot >= i0  # (1, LC)
+        rc = jnp.max(seli * preq_cpu_ref[:], axis=0, keepdims=True)
+        rr = jnp.max(seli * preq_ram_ref[:], axis=0, keepdims=True)
+
+        cpu = cpu_out[:]
+        ram = ram_out[:]
+        fit = alive & (rc <= cpu) & (rr <= ram)
+        cpu_f = cpu.astype(jnp.float32)
+        ram_f = ram.astype(jnp.float32)
+        cpu_score = jnp.where(
+            cpu > i0, (cpu_f - rc.astype(jnp.float32)) * hundred / cpu_f, neg_inf
+        )
+        ram_score = jnp.where(
+            ram > i0, (ram_f - rr.astype(jnp.float32)) * hundred / ram_f, neg_inf
+        )
+        score = jnp.where(fit, (cpu_score + ram_score) * half, neg_inf)
+        max_score = jnp.max(score, axis=0, keepdims=True)
+        best = jnp.max(
+            jnp.where((score == max_score) & node_ok, iota_n, neg1),
+            axis=0,
+            keepdims=True,
+        )
+        any_fit = jnp.max(fit.astype(jnp.int32), axis=0, keepdims=True) > i0
+        assign = valid & any_fit
+
+        upd = assign & (iota_n == best)
+        cpu_out[:] = cpu - jnp.where(upd, rc, i0)
+        ram_out[:] = ram - jnp.where(upd, rr, i0)
+        cand_out[pl.ds(k, 1), :] = jnp.where(valid, slot, i0)
+        valid_out[pl.ds(k, 1), :] = valid.astype(jnp.int32)
+        assign_out[pl.ds(k, 1), :] = assign.astype(jnp.int32)
+        fitany_out[pl.ds(k, 1), :] = any_fit.astype(jnp.int32)
+        best_out[pl.ds(k, 1), :] = best
+        rem_ref[:] = jnp.where(sel, i0, rem_ref[:])
+
+    def loop_body(k):
+        body(k)
+        return k + i1
+
+    jax.lax.while_loop(lambda k: k < k_bound, loop_body, jnp.int32(0))
+
+
+@functools.partial(jax.jit, static_argnames=("k_pods", "interpret"))
+def fused_select_schedule_cycle(
+    alive: jnp.ndarray,      # (C, N) bool
+    alloc_cpu: jnp.ndarray,  # (C, N) int32
+    alloc_ram: jnp.ndarray,  # (C, N) int32
+    eligible: jnp.ndarray,   # (C, P) bool
+    qwin: jnp.ndarray,       # (C, P) int32
+    qoff: jnp.ndarray,       # (C, P) float32 (non-negative)
+    qseq: jnp.ndarray,       # (C, P) int32
+    pod_req_cpu: jnp.ndarray,  # (C, P) int32
+    pod_req_ram: jnp.ndarray,  # (C, P) int32
+    k_pods: int,
+    interpret: bool = False,
+):
+    """Fused selection + scheduling loop in VMEM.
+
+    Returns (cand (C,K) int32 pod slots, valid (C,K) bool, assign (C,K) bool,
+    fit_any (C,K) bool, best (C,K) int32, new_alloc_cpu, new_alloc_ram) —
+    valid rows identical to prepare_cycle's sorted top-K compaction followed
+    by the lax.scan/_cycle_kernel loop (invalid rows are zeroed; every
+    consumer gates on valid)."""
+    C, N = alloc_cpu.shape
+    P = eligible.shape[1]
+    K = k_pods
+    Cp = -(-C // _LANE) * _LANE
+    Np = -(-N // _SUB) * _SUB
+    Pp = -(-P // _SUB) * _SUB
+    Kp = -(-K // _SUB) * _SUB
+
+    def prep(x, n_sub, fill):
+        return _pad_axis(_pad_axis(x.astype(jnp.int32).T, 0, n_sub, fill), 1, Cp, fill)
+
+    alive_p = prep(alive, Np, 0)
+    cpu_p = prep(alloc_cpu, Np, 0)
+    ram_p = prep(alloc_ram, Np, 0)
+    elig_p = prep(eligible, Pp, 0)
+    qwin_p = prep(qwin, Pp, 0)
+    # Non-negative f32 bit patterns sort like the floats; move them through
+    # the kernel as i32 so every block shares one dtype.
+    qoff_p = prep(jax.lax.bitcast_convert_type(qoff, jnp.int32), Pp, 0)
+    qseq_p = prep(qseq, Pp, 0)
+    reqc_p = prep(pod_req_cpu, Pp, 0)
+    reqr_p = prep(pod_req_ram, Pp, 0)
+
+    node_spec = pl.BlockSpec((Np, _LANE), lambda i: (0, i), memory_space=pltpu.VMEM)
+    pod_spec = pl.BlockSpec((Pp, _LANE), lambda i: (0, i), memory_space=pltpu.VMEM)
+    cand_spec = pl.BlockSpec((Kp, _LANE), lambda i: (0, i), memory_space=pltpu.VMEM)
+
+    kernel = functools.partial(_select_cycle_kernel, N, K)
+    with jax.enable_x64(False):
+        cpu_o, ram_o, cand_o, valid_o, assign_o, fitany_o, best_o = pl.pallas_call(
+            kernel,
+            grid=(Cp // _LANE,),
+            in_specs=[node_spec] * 3 + [pod_spec] * 6,
+            out_specs=[node_spec] * 2 + [cand_spec] * 5,
+            out_shape=[
+                jax.ShapeDtypeStruct((Np, Cp), jnp.int32),
+                jax.ShapeDtypeStruct((Np, Cp), jnp.int32),
+                jax.ShapeDtypeStruct((Kp, Cp), jnp.int32),
+                jax.ShapeDtypeStruct((Kp, Cp), jnp.int32),
+                jax.ShapeDtypeStruct((Kp, Cp), jnp.int32),
+                jax.ShapeDtypeStruct((Kp, Cp), jnp.int32),
+                jax.ShapeDtypeStruct((Kp, Cp), jnp.int32),
+            ],
+            scratch_shapes=[pltpu.VMEM((Pp, _LANE), jnp.int32)],
+            compiler_params=pltpu.CompilerParams(
+                vmem_limit_bytes=_SELECT_VMEM_LIMIT
+            ),
+            interpret=interpret,
+        )(alive_p, cpu_p, ram_p, elig_p, qwin_p, qoff_p, qseq_p, reqc_p, reqr_p)
+
+    return (
+        cand_o[:K, :C].T,
+        valid_o[:K, :C].T != 0,
+        assign_o[:K, :C].T != 0,
+        fitany_o[:K, :C].T != 0,
+        best_o[:K, :C].T,
+        cpu_o[:N, :C].T,
+        ram_o[:N, :C].T,
+    )
+
+
 def _pad_axis(x: jnp.ndarray, axis: int, to: int, value) -> jnp.ndarray:
     pad = to - x.shape[axis]
     if pad <= 0:

@@ -37,8 +37,8 @@ def main() -> int:
         ram=6 * 1024**3, duration_range=(15.0, 90.0),
     )
 
-    def build(pallas):
-        return build_batched_from_traces(
+    def build(pallas, select=None):
+        sim = build_batched_from_traces(
             config,
             cluster.convert_to_simulator_events(),
             workload.convert_to_simulator_events(),
@@ -46,26 +46,40 @@ def main() -> int:
             max_pods_per_cycle=32,
             use_pallas=pallas,
         )
+        if select is not None:
+            sim.use_pallas_select = select
+        return sim
 
-    scan_sim, pallas_sim = build(False), build(True)
-    assert pallas_sim.use_pallas and not scan_sim.use_pallas
-    scan_sim.step_until_time(600.0)
-    pallas_sim.step_until_time(600.0)
-    jax.block_until_ready(scan_sim.state.time)
-    jax.block_until_ready(pallas_sim.state.time)
+    # All three cycle formulations: lax.scan oracle, the fused
+    # selection+cycle kernel (the dense-shape default), and the
+    # sort+candidate kernel (the small-C default).
+    scan_sim = build(False)
+    select_sim = build(True)
+    cand_sim = build(True, select=False)
+    assert select_sim.use_pallas_select and not cand_sim.use_pallas_select
+    for sim in (scan_sim, select_sim, cand_sim):
+        sim.step_until_time(600.0)
+        jax.block_until_ready(sim.state.time)
 
     from kubernetriks_tpu.batched.state import compare_states
 
-    bad = compare_states(scan_sim.state, pallas_sim.state)
-    for key in bad:
-        print(f"MISMATCH at {key}")
     decisions = scan_sim.metrics_summary()["counters"]["scheduling_decisions"]
-    if bad:
-        print(f"FAIL: {len(bad)} mismatching leaves over {decisions} decisions")
+    failed = False
+    for label, sim in (("selection", select_sim), ("candidate", cand_sim)):
+        bad = compare_states(scan_sim.state, sim.state)
+        for key in bad:
+            print(f"MISMATCH ({label} kernel) at {key}")
+        if bad:
+            print(
+                f"FAIL: {label} kernel: {len(bad)} mismatching leaves over "
+                f"{decisions} decisions"
+            )
+            failed = True
+    if failed:
         return 1
     print(
-        f"OK: Mosaic kernel == scan path over {decisions} decisions "
-        "(state exact, metrics within ulp)"
+        f"OK: Mosaic selection+candidate kernels == scan path over "
+        f"{decisions} decisions (state exact, metrics within ulp)"
     )
     return 0
 

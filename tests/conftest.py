@@ -18,5 +18,17 @@ if "xla_force_host_platform_device_count" not in flags:
 # The axon sitecustomize registers the TPU backend at interpreter start and
 # pins jax_platforms before conftest runs; override through the config API.
 import jax  # noqa: E402
+import pytest  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    """Drop compiled executables at module boundaries: with the full suite in
+    one process, the accumulated compile state eventually segfaults XLA's CPU
+    compiler inside a later (unrelated) jit compile — reproducible only with
+    ~the whole suite's compile history, gone when any half runs alone. Costs
+    some cross-module recompiles; keeps the 170-test process bounded."""
+    yield
+    jax.clear_caches()
