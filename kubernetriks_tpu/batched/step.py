@@ -843,21 +843,9 @@ def _run_scheduling_cycle(
             pods, last_flush_win, cand, cand_valid, W, consts
         )
         park_k = cand_valid & ~fitany_k
-        pod_queue_time_k, start_s_k, park_s_k = cycle_timing(
-            cand_valid, cc.waited, pod_sched_time, consts
-        )
-        metrics = decision_metrics(
-            state.metrics, assign_k, pod_queue_time_k, pod_sched_time
-        )
-        return commit_cycle(
-            state, cc, W, consts, alloc_cpu, alloc_ram, metrics,
-            assign_k, park_k, best_k, start_s_k, park_s_k,
-        )
-
-    cc = prepare_cycle(state, W, consts, max_pods_per_cycle, conditional_move)
-    cand_valid, cand_req_cpu, cand_req_ram = cc.valid, cc.req_cpu, cc.req_ram
-
-    if use_pallas:
+    elif use_pallas:
+        cc = prepare_cycle(state, W, consts, max_pods_per_cycle, conditional_move)
+        cand_valid, cand_req_cpu, cand_req_ram = cc.valid, cc.req_cpu, cc.req_ram
         # The (C, N)-heavy core runs as a fused VMEM kernel; the (C,)-shaped
         # timing/metric mechanics below replicate the scan path's float-op
         # ordering exactly (see ops/scheduler_kernel.py).
@@ -890,6 +878,9 @@ def _run_scheduling_cycle(
         )
         park_k = cand_valid & ~fitany_k
     else:
+        cc = prepare_cycle(state, W, consts, max_pods_per_cycle, conditional_move)
+        cand_valid, cand_req_cpu, cand_req_ram = cc.valid, cc.req_cpu, cc.req_ram
+
         def body(carry, xs):
             alloc_cpu, alloc_ram = carry
             valid, req_cpu, req_ram = xs
@@ -936,7 +927,7 @@ def _run_scheduling_cycle(
         )
         assign_k, park_k, best_k = (o.T for o in outs)
 
-    # Timing/metric mechanics: vectorized and shared by both paths above
+    # Timing/metric mechanics: vectorized and shared by ALL THREE paths above
     # (and the RL path), so the decision cores stay the only divergence.
     pod_queue_time_k, start_s_k, park_s_k = cycle_timing(
         cand_valid, cc.waited, pod_sched_time, consts
@@ -1097,6 +1088,207 @@ def window_step(
         pallas_axis,
         use_pallas_select,
     )
+
+
+def _next_interesting_window(
+    state: ClusterBatchState,
+    slab: TraceSlab,
+    W: jnp.ndarray,
+    consts: StepConstants,
+    autoscale_statics,
+    flush_windows: int,
+) -> jnp.ndarray:
+    """First window index > W whose body could change state (scalar, min
+    over clusters). A window with none of the triggers below is PROVABLY the
+    identity on all simulation state except the cadence bookkeeping that
+    _catch_up_bookkeeping replays (last_flush_win, hpa_next/ca_next, time):
+    no due trace events, no due pod finishes, no pending autoscaler effects,
+    no eligible queued pod (an empty cycle assigns/parks/measures nothing
+    and signals are already zeroed by the previous commit), no flush window
+    while pods are parked, and no CA/HPA tick that could act.
+
+    Every trigger is CONSERVATIVE (running a window early is always safe —
+    window execution at any index is semantics-preserving); what is never
+    allowed is skipping past a trigger."""
+    from kubernetriks_tpu.batched.timerep import INF_WIN
+
+    pods, nodes = state.pods, state.nodes
+    C = state.time.shape[0]
+    rows1 = jnp.arange(C, dtype=jnp.int32)
+    big = jnp.int32(INF_WIN)
+    E_total = slab.packed.shape[1]
+
+    def amin(x):
+        return jnp.min(x).astype(jnp.int32)
+
+    # Next unapplied trace event (applied when stepping win+1).
+    cursor = jnp.clip(state.event_cursor, 0, E_total - 1)
+    ev_win = slab.packed[rows1, cursor, 0]
+    ev_next = jnp.where(state.event_cursor < E_total, ev_win, big)
+    cand = amin(ev_next) + 1
+
+    # Pod finishes (resolved in the finish pair's window or the next; running
+    # the earlier window is a harmless no-op when off > 0).
+    running = pods.phase == PHASE_RUNNING
+    cand = jnp.minimum(cand, amin(jnp.where(running, pods.finish_time.win, big)))
+
+    # Pending effect times (applied when stepping win+1): CA node
+    # creations/removals, HPA pod removals.
+    cand = jnp.minimum(cand, amin(nodes.create_time.win) + 1)
+    cand = jnp.minimum(cand, amin(nodes.remove_time.win) + 1)
+    cand = jnp.minimum(cand, amin(pods.removal_time.win) + 1)
+
+    # Queued pods become eligible at queue_ts.win + 1.
+    queued = pods.phase == PHASE_QUEUED
+    cand = jnp.minimum(cand, amin(jnp.where(queued, pods.queue_ts.win, big)) + 1)
+
+    # Parked pods: the flush cadence can wake them, and a due CA tick can
+    # scale up from the unscheduled cache.
+    parked_any = (pods.phase == PHASE_UNSCHEDULABLE).any()
+    flush_next = jnp.min(state.last_flush_win) + jnp.int32(flush_windows)
+    cand = jnp.minimum(cand, jnp.where(parked_any, flush_next, big))
+
+    if autoscale_statics is not None and state.auto is not None:
+        auto = state.auto
+        ca_tick = amin(auto.ca_next.win)
+        hpa_tick = amin(auto.hpa_next.win)
+        ca_can_act = parked_any | (auto.ca_count.sum() > 0)
+        cand = jnp.minimum(cand, jnp.where(ca_can_act, ca_tick, big))
+        # HPA ticks are interesting whenever a group could be active (the
+        # engine parks hpa_next at +inf otherwise, making this a no-op).
+        cand = jnp.minimum(cand, hpa_tick)
+
+    return jnp.maximum(W + jnp.int32(1), cand)
+
+
+def _catch_up_bookkeeping(
+    state: ClusterBatchState,
+    from_w: jnp.ndarray,
+    to_w: jnp.ndarray,
+    consts: StepConstants,
+    autoscale_statics,
+) -> ClusterBatchState:
+    """Replay the cadence bookkeeping of the skipped windows [from_w, to_w)
+    with the SAME per-window arithmetic the window body uses, so a
+    fast-forwarded run's state is bit-identical to continuous stepping:
+    last_flush_win advances at the flush cadence, due autoscaler ticks
+    advance hpa_next/ca_next once per window, and time tracks the last
+    covered window. O(skipped windows) scalar work per cluster — ~10 tiny
+    (C,)-shaped ops per window vs ~2k for a full body."""
+    interval = jnp.float32(consts.scheduling_interval)
+    has_auto = autoscale_statics is not None and state.auto is not None
+
+    def body(carry):
+        w, last_flush, hpa_next, ca_next = carry
+        wc = jnp.broadcast_to(w, last_flush.shape)
+        flush_now = (wc - last_flush).astype(jnp.float32) * interval >= jnp.float32(
+            consts.flush_interval
+        )
+        last_flush = jnp.where(flush_now, wc, last_flush)
+        if has_auto:
+            T = TPair(win=wc, off=jnp.zeros_like(hpa_next.off))
+            hpa_next = t_where(
+                t_le(hpa_next, T),
+                t_add(hpa_next, autoscale_statics.hpa_interval, interval),
+                hpa_next,
+            )
+            ca_next = t_where(
+                t_le(ca_next, T),
+                t_add(ca_next, autoscale_statics.ca_interval, interval),
+                ca_next,
+            )
+        return (w + jnp.int32(1), last_flush, hpa_next, ca_next)
+
+    if has_auto:
+        hpa0, ca0 = state.auto.hpa_next, state.auto.ca_next
+    else:
+        dummy = TPair(
+            win=jnp.zeros_like(state.last_flush_win),
+            off=jnp.zeros(state.last_flush_win.shape, jnp.float32),
+        )
+        hpa0, ca0 = dummy, dummy
+    _, last_flush, hpa_next, ca_next = jax.lax.while_loop(
+        lambda carry: carry[0] < to_w,
+        body,
+        (jnp.asarray(from_w, jnp.int32), state.last_flush_win, hpa0, ca0),
+    )
+    state = state._replace(
+        last_flush_win=last_flush,
+        time=jnp.maximum(state.time, to_w - 1),
+    )
+    if has_auto:
+        state = state._replace(
+            auto=state.auto._replace(hpa_next=hpa_next, ca_next=ca_next)
+        )
+    return state
+
+
+@partial(jax.jit, static_argnames=_STEP_STATICS + ("flush_windows",))
+def run_windows_skip(
+    state: ClusterBatchState,
+    slab: TraceSlab,
+    first: jnp.ndarray,
+    last: jnp.ndarray,
+    consts: StepConstants,
+    max_events_per_window: int,
+    max_pods_per_cycle: int,
+    autoscale_statics=None,
+    max_ca_pods_per_cycle: int = 64,
+    max_pods_per_scale_down: int = 8,
+    use_pallas: bool = False,
+    pallas_interpret: bool = False,
+    conditional_move: bool = False,
+    pallas_mesh=None,
+    pallas_axis: str = "clusters",
+    use_pallas_select: bool = False,
+    flush_windows: int = 3,
+):
+    """run_windows with FAST-FORWARD over provably no-op windows: a dynamic
+    while_loop executes only interesting windows (see
+    _next_interesting_window) and replays the skipped windows' cadence
+    bookkeeping exactly, so the final state is bit-identical to stepping
+    every index in [first, last]. One compiled program serves any span
+    (first/last are traced scalars). No per-window gauge collection — the
+    engine falls back to run_windows when gauges are on."""
+
+    def cond(carry):
+        _, W = carry
+        return W <= last
+
+    def body(carry):
+        state, W = carry
+        state = _window_body(
+            state,
+            slab,
+            W,
+            consts,
+            max_events_per_window,
+            max_pods_per_cycle,
+            autoscale_statics,
+            max_ca_pods_per_cycle,
+            max_pods_per_scale_down,
+            use_pallas,
+            pallas_interpret,
+            conditional_move,
+            pallas_mesh,
+            pallas_axis,
+            use_pallas_select,
+        )
+        W_next = jnp.minimum(
+            _next_interesting_window(
+                state, slab, W, consts, autoscale_statics, flush_windows
+            ),
+            last + jnp.int32(1),
+        )
+        state = _catch_up_bookkeeping(
+            state, W + jnp.int32(1), W_next, consts, autoscale_statics
+        )
+        return state, W_next
+
+    state, _ = jax.lax.while_loop(
+        cond, body, (state, jnp.asarray(first, jnp.int32))
+    )
+    return state
 
 
 @partial(jax.jit, static_argnames=_STEP_STATICS + ("collect_gauges",))

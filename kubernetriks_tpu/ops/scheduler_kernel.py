@@ -67,6 +67,47 @@ def kernel_fits(n_nodes: int, k_pods: int) -> bool:
     return resident <= _VMEM_BUDGET_BYTES
 
 
+def _fit_score_place(alive, node_ok, iota_n, cpu, ram, rc, rr, valid):
+    """ONE in-kernel definition of the per-candidate decision core shared by
+    _cycle_kernel and _select_cycle_kernel: Fit filter +
+    LeastAllocatedResources score + last-max-wins argmax (ties resolve to
+    the highest node slot, matching the reference's `>=` sweep over
+    name-sorted nodes) + the allocatable update for the placed node.
+    Inputs: (Np, LC) node tiles, (1, LC) candidate requests/validity.
+    Returns (assign (1, LC) bool, any_fit (1, LC) bool, best (1, LC) i32,
+    new_cpu (Np, LC), new_ram (Np, LC))."""
+    i0 = jnp.int32(0)
+    neg1 = jnp.int32(-1)
+    hundred = jnp.float32(100.0)
+    half = jnp.float32(0.5)
+    neg_inf = jnp.float32(_NEG_INF)
+
+    fit = alive & (rc <= cpu) & (rr <= ram)
+    cpu_f = cpu.astype(jnp.float32)
+    ram_f = ram.astype(jnp.float32)
+    cpu_score = jnp.where(
+        cpu > i0, (cpu_f - rc.astype(jnp.float32)) * hundred / cpu_f, neg_inf
+    )
+    ram_score = jnp.where(
+        ram > i0, (ram_f - rr.astype(jnp.float32)) * hundred / ram_f, neg_inf
+    )
+    score = jnp.where(fit, (cpu_score + ram_score) * half, neg_inf)
+    max_score = jnp.max(score, axis=0, keepdims=True)
+    best = jnp.max(
+        jnp.where((score == max_score) & node_ok, iota_n, neg1),
+        axis=0,
+        keepdims=True,
+    )
+    # any() lowers to an i1 reduction Mosaic rejects; reduce in i32. Padded
+    # slots never fit (alive is 0 there).
+    any_fit = jnp.max(fit.astype(jnp.int32), axis=0, keepdims=True) > i0
+    assign = valid & any_fit
+    upd = assign & (iota_n == best)
+    new_cpu = cpu - jnp.where(upd, rc, i0)
+    new_ram = ram - jnp.where(upd, rr, i0)
+    return assign, any_fit, best, new_cpu, new_ram
+
+
 def _cycle_kernel(
     n_real: int,
     k_pods: int,
@@ -86,10 +127,6 @@ def _cycle_kernel(
     # path's time arrays are f64), bare Python scalars trace as weak i64/f64
     # constants, which Mosaic cannot lower inside the kernel.
     i0 = jnp.int32(0)
-    neg1 = jnp.int32(-1)
-    hundred = jnp.float32(100.0)
-    half = jnp.float32(0.5)
-    neg_inf = jnp.float32(_NEG_INF)
 
     cpu_out[:] = alloc_cpu_ref[:]
     ram_out[:] = alloc_ram_ref[:]
@@ -113,40 +150,15 @@ def _cycle_kernel(
     k_bound = jnp.minimum(k_live, jnp.int32(k_pods))
 
     def body(k):
-        cpu = cpu_out[:]
-        ram = ram_out[:]
         req_cpu = req_cpu_ref[pl.ds(k, 1), :]  # (1, LC) int32
         req_ram = req_ram_ref[pl.ds(k, 1), :]
         valid = valid_ref[pl.ds(k, 1), :] != i0
 
-        fit = alive & (req_cpu <= cpu) & (req_ram <= ram)
-        cpu_f = cpu.astype(jnp.float32)
-        ram_f = ram.astype(jnp.float32)
-        cpu_score = jnp.where(
-            cpu > i0, (cpu_f - req_cpu.astype(jnp.float32)) * hundred / cpu_f, neg_inf
+        assign, any_fit, best, new_cpu, new_ram = _fit_score_place(
+            alive, node_ok, iota, cpu_out[:], ram_out[:], req_cpu, req_ram, valid
         )
-        ram_score = jnp.where(
-            ram > i0, (ram_f - req_ram.astype(jnp.float32)) * hundred / ram_f, neg_inf
-        )
-        score = jnp.where(fit, (cpu_score + ram_score) * half, neg_inf)
-
-        # Last-max-wins argmax over the real node sublanes (ties resolve to the
-        # highest node slot, matching the reference's `>=` sweep).
-        max_score = jnp.max(score, axis=0, keepdims=True)
-        best = jnp.max(
-            jnp.where((score == max_score) & node_ok, iota, neg1),
-            axis=0,
-            keepdims=True,
-        )  # (1, LC)
-        # any() lowers to an i1 reduction Mosaic rejects; reduce in i32.
-        any_fit = (
-            jnp.max(fit.astype(jnp.int32), axis=0, keepdims=True) > i0
-        )  # padded slots never fit
-        assign = valid & any_fit
-
-        upd = assign & (iota == best)
-        cpu_out[:] = cpu - jnp.where(upd, req_cpu, i0)
-        ram_out[:] = ram - jnp.where(upd, req_ram, i0)
+        cpu_out[:] = new_cpu
+        ram_out[:] = new_ram
         assign_out[pl.ds(k, 1), :] = assign.astype(jnp.int32)
         fitany_out[pl.ds(k, 1), :] = any_fit.astype(jnp.int32)
         best_out[pl.ds(k, 1), :] = best
@@ -215,9 +227,6 @@ def _select_cycle_kernel(
     i1 = jnp.int32(1)
     neg1 = jnp.int32(-1)
     bigi = jnp.int32(np.iinfo(np.int32).max)
-    hundred = jnp.float32(100.0)
-    half = jnp.float32(0.5)
-    neg_inf = jnp.float32(_NEG_INF)
 
     cpu_out[:] = alloc_cpu_ref[:]
     ram_out[:] = alloc_ram_ref[:]
@@ -256,30 +265,11 @@ def _select_cycle_kernel(
         rc = jnp.max(seli * preq_cpu_ref[:], axis=0, keepdims=True)
         rr = jnp.max(seli * preq_ram_ref[:], axis=0, keepdims=True)
 
-        cpu = cpu_out[:]
-        ram = ram_out[:]
-        fit = alive & (rc <= cpu) & (rr <= ram)
-        cpu_f = cpu.astype(jnp.float32)
-        ram_f = ram.astype(jnp.float32)
-        cpu_score = jnp.where(
-            cpu > i0, (cpu_f - rc.astype(jnp.float32)) * hundred / cpu_f, neg_inf
+        assign, any_fit, best, new_cpu, new_ram = _fit_score_place(
+            alive, node_ok, iota_n, cpu_out[:], ram_out[:], rc, rr, valid
         )
-        ram_score = jnp.where(
-            ram > i0, (ram_f - rr.astype(jnp.float32)) * hundred / ram_f, neg_inf
-        )
-        score = jnp.where(fit, (cpu_score + ram_score) * half, neg_inf)
-        max_score = jnp.max(score, axis=0, keepdims=True)
-        best = jnp.max(
-            jnp.where((score == max_score) & node_ok, iota_n, neg1),
-            axis=0,
-            keepdims=True,
-        )
-        any_fit = jnp.max(fit.astype(jnp.int32), axis=0, keepdims=True) > i0
-        assign = valid & any_fit
-
-        upd = assign & (iota_n == best)
-        cpu_out[:] = cpu - jnp.where(upd, rc, i0)
-        ram_out[:] = ram - jnp.where(upd, rr, i0)
+        cpu_out[:] = new_cpu
+        ram_out[:] = new_ram
         cand_out[pl.ds(k, 1), :] = jnp.where(valid, slot, i0)
         valid_out[pl.ds(k, 1), :] = valid.astype(jnp.int32)
         assign_out[pl.ds(k, 1), :] = assign.astype(jnp.int32)
