@@ -102,6 +102,25 @@ def _rel_seconds(t: TPair, base_win: jnp.ndarray, interval) -> jnp.ndarray:
     return (t.win - base_win).astype(jnp.float32) * jnp.float32(interval) + t.off
 
 
+
+def _shard_rowwise(core, n_in: int, n_out: int, mesh, axis: str):
+    """shard_map a kernel wrapper over the cluster axis: every input/output
+    is a (C, ...) array sharded on axis 0 (pallas_call has no GSPMD
+    partitioning rule, so each device runs the kernel on its own shard; the
+    wrappers pad per-shard, and clusters are independent so no collectives
+    are needed)."""
+    from jax.sharding import PartitionSpec
+
+    row = PartitionSpec(axis, None)
+    return jax.shard_map(
+        core,
+        mesh=mesh,
+        in_specs=(row,) * n_in,
+        out_specs=(row,) * n_out,
+        check_vma=False,
+    )
+
+
 def _apply_window_events(
     state: ClusterBatchState,
     slab: TraceSlab,
@@ -109,6 +128,11 @@ def _apply_window_events(
     consts: StepConstants,
     max_events_per_window: int,
     conditional_move: bool = False,
+    use_pallas: bool = False,
+    pallas_interpret: bool = False,
+    pallas_mesh=None,
+    pallas_axis: str = "clusters",
+    use_pallas_select: bool = False,
 ) -> ClusterBatchState:
     """Apply every trace event with effect time STRICTLY before the cycle time
     W * interval, and resolve all pod finishes due in the window.
@@ -133,6 +157,23 @@ def _apply_window_events(
     rows = rows1[:, None]
     base = W - 1  # (C,) the window the applied events fall in
     f32inf = jnp.float32(INF)
+
+    from kubernetriks_tpu.ops.scheduler_kernel import (
+        event_kernel_fits,
+        fused_event_scatter,
+    )
+
+    # The one-hot scatter kernels sweep whole (P, 128-lane) tiles per event,
+    # so like the selection kernel they only pay when the cluster lanes are
+    # dense — use_pallas_select carries exactly that gate (measured: the
+    # C=1 replay regressed 229 s -> 350 s with them always-on).
+    use_event_kernel = (
+        use_pallas and use_pallas_select and event_kernel_fits(N, P, E)
+    )
+    if use_event_kernel:
+        event_core = partial(fused_event_scatter, interpret=pallas_interpret)
+        if pallas_mesh is not None:
+            event_core = _shard_rowwise(event_core, 10, 5, pallas_mesh, pallas_axis)
 
     # --- bulk-apply the window's slab events, E at a time -------------------
     # E is a CHUNK size, not a worst-case bound: chunks apply inside a
@@ -186,32 +227,40 @@ def _apply_window_events(
         is_rn = valid & (ev_k == EV_REMOVE_NODE)
         is_cp = valid & (ev_k == EV_CREATE_POD)
         is_rp = valid & (ev_k == EV_REMOVE_POD)
-
-        # Scatter helpers: out-of-range slot drops the write.
-        def drop_slot(mask, width):
-            return jnp.where(mask, ev_s, width)
-
-        created = created.at[rows, drop_slot(is_cn, N)].set(True, mode="drop")
-        node_removal = node_removal.at[rows, drop_slot(is_rn, N)].min(
-            jnp.where(is_rn, ev_rel, f32inf), mode="drop"
-        )
-        pod_create = pod_create.at[rows, drop_slot(is_cp, P)].min(
-            jnp.where(is_cp, ev_rel, f32inf), mode="drop"
-        )
         # Queue sequence numbers follow slab (== emission) order, continuing
         # across chunks via the running n_creates.
         create_rank = jnp.cumsum(is_cp, axis=1, dtype=jnp.int32) - 1
-        pod_create_seq = pod_create_seq.at[rows, drop_slot(is_cp, P)].max(
-            jnp.where(
-                is_cp,
-                state.queue_seq_counter[:, None] + n_creates[:, None] + create_rank,
-                0,
-            ),
-            mode="drop",
-        )
-        pod_removal = pod_removal.at[rows, drop_slot(is_rp, P)].min(
-            jnp.where(is_rp, ev_rel, f32inf), mode="drop"
-        )
+        ev_seq = state.queue_seq_counter[:, None] + n_creates[:, None] + create_rank
+
+        if use_event_kernel:
+            # One Pallas call replaces the five (C, E)-indexed scatters
+            # below (~5 ms/window at dense shapes; scatter cost is
+            # per-index on TPU).
+            created, node_removal, pod_create, pod_create_seq, pod_removal = (
+                event_core(
+                    ev_k, ev_s, ev_rel, ev_seq, valid,
+                    created, node_removal, pod_create, pod_create_seq,
+                    pod_removal,
+                )
+            )
+        else:
+            # Scatter helpers: out-of-range slot drops the write.
+            def drop_slot(mask, width):
+                return jnp.where(mask, ev_s, width)
+
+            created = created.at[rows, drop_slot(is_cn, N)].set(True, mode="drop")
+            node_removal = node_removal.at[rows, drop_slot(is_rn, N)].min(
+                jnp.where(is_rn, ev_rel, f32inf), mode="drop"
+            )
+            pod_create = pod_create.at[rows, drop_slot(is_cp, P)].min(
+                jnp.where(is_cp, ev_rel, f32inf), mode="drop"
+            )
+            pod_create_seq = pod_create_seq.at[rows, drop_slot(is_cp, P)].max(
+                jnp.where(is_cp, ev_seq, 0), mode="drop"
+            )
+            pod_removal = pod_removal.at[rows, drop_slot(is_rp, P)].min(
+                jnp.where(is_rp, ev_rel, f32inf), mode="drop"
+            )
         return (
             cursor + valid.sum(axis=1, dtype=jnp.int32),
             created,
@@ -306,34 +355,50 @@ def _apply_window_events(
 
     # Free resources of finished and removed-while-running pods (a dead node's
     # allocatable is irrelevant; slots are never reused). A straight
-    # (C, P)-indexed scatter is the single most expensive op in the step, and
-    # only a handful of pods free per window — compact up to F freed pods per
-    # round with top_k (40x cheaper than a sort here) and scatter F-sized
-    # chunks, looping for the rare overflow window (integer adds commute, so
-    # the ordering is irrelevant).
+    # (C, P)-indexed scatter is the single most expensive op in the step
+    # (measured 27 ms/window at 1024x256), and only a handful of pods free
+    # per window. Preferred: the Pallas free kernel (per-lane iterated
+    # extraction + node one-hot adds, early exit at the deepest lane's freed
+    # count — integer adds commute, so it is bit-identical). Fallback:
+    # compact up to F freed pods per round with top_k and scatter F-sized
+    # chunks — correct everywhere, but each round's lax.top_k lowers to a
+    # full (C, P) sort on TPU (~4 ms/window at dense shapes).
     freed = finishes | removed_running
-    F = min(P, 32)  # freed-compaction chunk width (independent of E)
-
-    def free_cond(carry):
-        return carry[0].any()
-
-    def free_body(carry):
-        pending, acpu, aram = carry
-        _, idx = jax.lax.top_k(pending.astype(jnp.int32), F)
-        fv = pending[rows, idx]
-        tgt = jnp.where(fv, node_idx[rows, idx], N)
-        acpu = acpu.at[rows, tgt].add(
-            jnp.where(fv, pods.req_cpu[rows, idx], 0), mode="drop"
-        )
-        aram = aram.at[rows, tgt].add(
-            jnp.where(fv, pods.req_ram[rows, idx], 0), mode="drop"
-        )
-        pending = pending.at[rows, jnp.where(fv, idx, P)].set(False, mode="drop")
-        return (pending, acpu, aram)
-
-    _, alloc_cpu, alloc_ram = jax.lax.while_loop(
-        free_cond, free_body, (freed, alloc_cpu, alloc_ram)
+    from kubernetriks_tpu.ops.scheduler_kernel import (
+        free_kernel_fits,
+        fused_free_resources,
     )
+
+    if use_pallas and use_pallas_select and free_kernel_fits(N, P):
+        core = partial(fused_free_resources, interpret=pallas_interpret)
+        if pallas_mesh is not None:
+            core = _shard_rowwise(core, 6, 2, pallas_mesh, pallas_axis)
+        alloc_cpu, alloc_ram = core(
+            freed, pods.node, pods.req_cpu, pods.req_ram, alloc_cpu, alloc_ram
+        )
+    else:
+        F = min(P, 32)  # freed-compaction chunk width (independent of E)
+
+        def free_cond(carry):
+            return carry[0].any()
+
+        def free_body(carry):
+            pending, acpu, aram = carry
+            _, idx = jax.lax.top_k(pending.astype(jnp.int32), F)
+            fv = pending[rows, idx]
+            tgt = jnp.where(fv, node_idx[rows, idx], N)
+            acpu = acpu.at[rows, tgt].add(
+                jnp.where(fv, pods.req_cpu[rows, idx], 0), mode="drop"
+            )
+            aram = aram.at[rows, tgt].add(
+                jnp.where(fv, pods.req_ram[rows, idx], 0), mode="drop"
+            )
+            pending = pending.at[rows, jnp.where(fv, idx, P)].set(False, mode="drop")
+            return (pending, acpu, aram)
+
+        _, alloc_cpu, alloc_ram = jax.lax.while_loop(
+            free_cond, free_body, (freed, alloc_cpu, alloc_ram)
+        )
 
     # Finished pods.
     n_done = finishes.sum(axis=1, dtype=jnp.int32)
@@ -692,13 +757,19 @@ def commit_cycle(
     best_k,
     start_s_k,
     park_s_k,
+    use_pallas: bool = False,
+    pallas_interpret: bool = False,
+    pallas_mesh=None,
+    pallas_axis: str = "clusters",
 ) -> ClusterBatchState:
     """Scatter the K per-cluster decisions back into (C, P) state.
 
     start_s_k / park_s_k are float32 second offsets relative to the cycle
     time T = W * interval; the absolute start/finish/park pairs are
     reconstructed elementwise after two cheap float32 scatters (64-bit value
-    scatters are the slow path on TPU)."""
+    scatters are the slow path on TPU). With use_pallas, the four
+    (C, K)-indexed scatters run as one Pallas one-hot kernel instead
+    (ops/scheduler_kernel.fused_commit_scatter, bit-identical)."""
     C, P = cc.pods.phase.shape
     rows = jnp.arange(C, dtype=jnp.int32)[:, None]
     pods = cc.pods
@@ -706,28 +777,44 @@ def commit_cycle(
     interval = jnp.float32(consts.scheduling_interval)
     f32inf = jnp.float32(INF)
 
-    new_phase = jnp.where(
-        assign_k,
-        jnp.int32(PHASE_RUNNING),
-        jnp.where(park_k, jnp.int32(PHASE_UNSCHEDULABLE), jnp.int32(-1)),
-    ).astype(pods.phase.dtype)
-    touched = assign_k | park_k
-    phase = pods.phase.at[rows, jnp.where(touched, cand, P)].set(
-        jnp.where(touched, new_phase, 0), mode="drop"
+    from kubernetriks_tpu.ops.scheduler_kernel import (
+        commit_kernel_fits,
+        fused_commit_scatter,
     )
-    node = pods.node.at[rows, jnp.where(assign_k, cand, P)].set(
-        jnp.where(assign_k, best_k, 0), mode="drop"
-    )
-    start_tmp = (
-        jnp.full((C, P), INF, jnp.float32)
-        .at[rows, jnp.where(assign_k, cand, P)]
-        .set(jnp.where(assign_k, start_s_k, f32inf), mode="drop")
-    )
-    park_tmp = (
-        jnp.full((C, P), INF, jnp.float32)
-        .at[rows, jnp.where(park_k, cand, P)]
-        .set(jnp.where(park_k, park_s_k, f32inf), mode="drop")
-    )
+
+    if use_pallas and commit_kernel_fits(P, cand.shape[1]):
+        core = partial(fused_commit_scatter, interpret=pallas_interpret)
+        if pallas_mesh is not None:
+            core = _shard_rowwise(core, 8, 4, pallas_mesh, pallas_axis)
+        phase, node, start_tmp, park_tmp = core(
+            cand, assign_k, park_k, best_k, start_s_k, park_s_k,
+            pods.phase, pods.node,
+        )
+        phase = phase.astype(pods.phase.dtype)
+        node = node.astype(pods.node.dtype)
+    else:
+        new_phase = jnp.where(
+            assign_k,
+            jnp.int32(PHASE_RUNNING),
+            jnp.where(park_k, jnp.int32(PHASE_UNSCHEDULABLE), jnp.int32(-1)),
+        ).astype(pods.phase.dtype)
+        touched = assign_k | park_k
+        phase = pods.phase.at[rows, jnp.where(touched, cand, P)].set(
+            jnp.where(touched, new_phase, 0), mode="drop"
+        )
+        node = pods.node.at[rows, jnp.where(assign_k, cand, P)].set(
+            jnp.where(assign_k, best_k, 0), mode="drop"
+        )
+        start_tmp = (
+            jnp.full((C, P), INF, jnp.float32)
+            .at[rows, jnp.where(assign_k, cand, P)]
+            .set(jnp.where(assign_k, start_s_k, f32inf), mode="drop")
+        )
+        park_tmp = (
+            jnp.full((C, P), INF, jnp.float32)
+            .at[rows, jnp.where(park_k, cand, P)]
+            .set(jnp.where(park_k, park_s_k, f32inf), mode="drop")
+        )
 
     started = start_tmp < f32inf
     start_pair = t_norm(
@@ -818,16 +905,7 @@ def _run_scheduling_cycle(
             interpret=pallas_interpret,
         )
         if pallas_mesh is not None:
-            from jax.sharding import PartitionSpec
-
-            row = PartitionSpec(pallas_axis, None)
-            core = jax.shard_map(
-                core,
-                mesh=pallas_mesh,
-                in_specs=(row,) * 9,
-                out_specs=(row,) * 7,
-                check_vma=False,
-            )
+            core = _shard_rowwise(core, 9, 7, pallas_mesh, pallas_axis)
         cand, cand_valid, assign_k, fitany_k, best_k, alloc_cpu, alloc_ram = core(
             alive,
             state.nodes.alloc_cpu,
@@ -853,21 +931,7 @@ def _run_scheduling_cycle(
 
         core = partial(fused_schedule_cycle, interpret=pallas_interpret)
         if pallas_mesh is not None:
-            # pallas_call has no GSPMD partitioning rule, so under a mesh the
-            # kernel runs through shard_map: every device gets its
-            # (C_shard, ...) tile — exactly the layout the kernel's
-            # 128-cluster-lane grid already consumes — and no collectives are
-            # needed (clusters are independent).
-            from jax.sharding import PartitionSpec
-
-            row = PartitionSpec(pallas_axis, None)
-            core = jax.shard_map(
-                core,
-                mesh=pallas_mesh,
-                in_specs=(row,) * 6,
-                out_specs=(row,) * 5,
-                check_vma=False,
-            )
+            core = _shard_rowwise(core, 6, 5, pallas_mesh, pallas_axis)
         assign_k, fitany_k, best_k, alloc_cpu, alloc_ram = core(
             alive,
             state.nodes.alloc_cpu,
@@ -938,6 +1002,10 @@ def _run_scheduling_cycle(
     return commit_cycle(
         state, cc, W, consts, alloc_cpu, alloc_ram, metrics,
         assign_k, park_k, best_k, start_s_k, park_s_k,
+        use_pallas=use_pallas and use_pallas_select,
+        pallas_interpret=pallas_interpret,
+        pallas_mesh=pallas_mesh,
+        pallas_axis=pallas_axis,
     )
 
 
@@ -960,7 +1028,17 @@ def _window_body(
 ) -> ClusterBatchState:
     W = jnp.broadcast_to(jnp.asarray(W, jnp.int32), state.time.shape)
     state = _apply_window_events(
-        state, slab, W, consts, max_events_per_window, conditional_move
+        state,
+        slab,
+        W,
+        consts,
+        max_events_per_window,
+        conditional_move,
+        use_pallas,
+        pallas_interpret,
+        pallas_mesh,
+        pallas_axis,
+        use_pallas_select,
     )
     state = _run_scheduling_cycle(
         state,

@@ -366,6 +366,422 @@ def fused_select_schedule_cycle(
     )
 
 
+def free_kernel_fits(n_nodes: int, n_pods: int) -> bool:
+    """VMEM fits-check for the freed-resource kernel: 5 pod blocks (incl.
+    scratch) + 4 node blocks, int32, double-buffered by Mosaic, plus stack
+    temporaries for the loop body's (Pp, LC) masks — the kernel raises the
+    scoped limit to _SELECT_VMEM_LIMIT, the check keeps ~40% headroom."""
+    np_pad = -(-n_nodes // _SUB) * _SUB
+    pp_pad = -(-n_pods // _SUB) * _SUB
+    resident = (5 * pp_pad + 4 * np_pad) * _LANE * 4
+    return 2 * resident <= int(0.8 * _SELECT_VMEM_LIMIT)
+
+
+def _free_kernel(
+    freed_ref,     # (Pp, LC) int32 0/1
+    node_ref,      # (Pp, LC) int32 assigned node slot
+    reqc_ref,      # (Pp, LC) int32
+    reqr_ref,      # (Pp, LC) int32
+    acpu_ref,      # (Np, LC) int32
+    aram_ref,      # (Np, LC) int32
+    acpu_out,      # (Np, LC) int32
+    aram_out,      # (Np, LC) int32
+    rem_ref,       # (Pp, LC) int32 scratch
+):
+    """Return freed pods' requests to their nodes' allocatable — the batched
+    analog of the per-event resource release (reference:
+    src/core/node_component.rs finish/removal handling). Replaces the XLA
+    top_k-compaction loop of _apply_window_events, whose per-round
+    lax.top_k lowers to a FULL (C, P) sort on TPU (~4 ms/window at dense
+    shapes); here each freed pod is extracted by a per-lane first-set-bit
+    pass and added via a node one-hot, with a data-dependent early exit at
+    the deepest lane's freed count. Integer adds commute, so the result is
+    bit-identical to the XLA loop."""
+    i0 = jnp.int32(0)
+    neg1 = jnp.int32(-1)
+    bigi = jnp.int32(np.iinfo(np.int32).max)
+
+    acpu_out[:] = acpu_ref[:]
+    aram_out[:] = aram_ref[:]
+    rem_ref[:] = freed_ref[:]
+    iota_p = jax.lax.broadcasted_iota(jnp.int32, freed_ref.shape, 0)
+    iota_n = jax.lax.broadcasted_iota(jnp.int32, acpu_ref.shape, 0)
+    k_bound = jnp.max(jnp.sum(freed_ref[:], axis=0, keepdims=True))
+
+    def body(k):
+        rem = rem_ref[:] != i0
+        first = jnp.min(jnp.where(rem, iota_p, bigi), axis=0, keepdims=True)
+        sel = rem & (iota_p == first)
+        seli = sel.astype(jnp.int32)
+        node = jnp.max(jnp.where(sel, node_ref[:], neg1), axis=0, keepdims=True)
+        rc = jnp.max(seli * reqc_ref[:], axis=0, keepdims=True)
+        rr = jnp.max(seli * reqr_ref[:], axis=0, keepdims=True)
+        oh = iota_n == node  # node == -1 (empty lane) matches nothing
+        acpu_out[:] = acpu_out[:] + jnp.where(oh, rc, i0)
+        aram_out[:] = aram_out[:] + jnp.where(oh, rr, i0)
+        rem_ref[:] = jnp.where(sel, i0, rem_ref[:])
+
+    def loop_body(k):
+        body(k)
+        return k + jnp.int32(1)
+
+    jax.lax.while_loop(lambda k: k < k_bound, loop_body, jnp.int32(0))
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def fused_free_resources(
+    freed: jnp.ndarray,      # (C, P) bool
+    node: jnp.ndarray,       # (C, P) int32 (>= 0 for freed pods)
+    req_cpu: jnp.ndarray,    # (C, P) int32
+    req_ram: jnp.ndarray,    # (C, P) int32
+    alloc_cpu: jnp.ndarray,  # (C, N) int32
+    alloc_ram: jnp.ndarray,  # (C, N) int32
+    interpret: bool = False,
+):
+    """(new_alloc_cpu, new_alloc_ram) with every freed pod's requests added
+    back to its node — bit-identical to the top_k-compaction loop."""
+    C, N = alloc_cpu.shape
+    P = freed.shape[1]
+    Cp = -(-C // _LANE) * _LANE
+    Np = -(-N // _SUB) * _SUB
+    Pp = -(-P // _SUB) * _SUB
+
+    def prep(x, n_sub, fill):
+        return _pad_axis(_pad_axis(x.astype(jnp.int32).T, 0, n_sub, fill), 1, Cp, fill)
+
+    freed_p = prep(freed, Pp, 0)
+    node_p = prep(node, Pp, -1)
+    reqc_p = prep(req_cpu, Pp, 0)
+    reqr_p = prep(req_ram, Pp, 0)
+    acpu_p = prep(alloc_cpu, Np, 0)
+    aram_p = prep(alloc_ram, Np, 0)
+
+    node_spec = pl.BlockSpec((Np, _LANE), lambda i: (0, i), memory_space=pltpu.VMEM)
+    pod_spec = pl.BlockSpec((Pp, _LANE), lambda i: (0, i), memory_space=pltpu.VMEM)
+
+    with jax.enable_x64(False):
+        acpu_o, aram_o = pl.pallas_call(
+            _free_kernel,
+            grid=(Cp // _LANE,),
+            in_specs=[pod_spec] * 4 + [node_spec] * 2,
+            out_specs=[node_spec] * 2,
+            out_shape=[
+                jax.ShapeDtypeStruct((Np, Cp), jnp.int32),
+                jax.ShapeDtypeStruct((Np, Cp), jnp.int32),
+            ],
+            scratch_shapes=[pltpu.VMEM((Pp, _LANE), jnp.int32)],
+            compiler_params=pltpu.CompilerParams(
+                vmem_limit_bytes=_SELECT_VMEM_LIMIT
+            ),
+            interpret=interpret,
+        )(freed_p, node_p, reqc_p, reqr_p, acpu_p, aram_p)
+
+    return acpu_o[:N, :C].T, aram_o[:N, :C].T
+
+
+def event_kernel_fits(n_nodes: int, n_pods: int, n_events: int) -> bool:
+    """VMEM fits-check for the event-scatter kernel: 3 pod in + 3 pod out,
+    2 node in + 2 node out, 5 event blocks, int32/f32, double-buffered,
+    plus loop-body temporaries (the kernel raises the scoped limit)."""
+    np_pad = -(-n_nodes // _SUB) * _SUB
+    pp_pad = -(-n_pods // _SUB) * _SUB
+    ep_pad = -(-n_events // _SUB) * _SUB
+    resident = (6 * pp_pad + 4 * np_pad + 5 * ep_pad) * _LANE * 4
+    return 2 * resident <= int(0.8 * _SELECT_VMEM_LIMIT)
+
+
+# Event kinds, duplicated from batched/state.py (importing it here would pull
+# the x64 config flip into kernel-only users).
+_EV_CREATE_NODE = 1
+_EV_REMOVE_NODE = 2
+_EV_CREATE_POD = 3
+_EV_REMOVE_POD = 4
+
+
+def _event_kernel(
+    kind_ref,     # (Ep, LC) int32
+    slot_ref,     # (Ep, LC) int32 (device coords; out-of-range = drop)
+    rel_ref,      # (Ep, LC) float32 effect time rel-seconds
+    seq_ref,      # (Ep, LC) int32 queue sequence for creates
+    valid_ref,    # (Ep, LC) int32 0/1 (per-lane prefix)
+    created_ref,  # (Np, LC) int32
+    nrm_ref,      # (Np, LC) float32 node-removal time accumulator (min)
+    pcr_ref,      # (Pp, LC) float32 pod-create time accumulator (min)
+    pseq_ref,     # (Pp, LC) int32 pod-create seq accumulator (max)
+    prm_ref,      # (Pp, LC) float32 pod-removal time accumulator (min)
+    created_out,
+    nrm_out,
+    pcr_out,
+    pseq_out,
+    prm_out,
+):
+    """Apply one chunk of due trace events to the per-slot accumulators —
+    the Pallas replacement for the five (C, E)-indexed XLA scatters in
+    _apply_window_events' chunk body (measured ~5 ms/window at dense
+    shapes). Event k is applied across all cluster lanes simultaneously via
+    slot one-hots; min/max combiners match the scatter semantics exactly,
+    and out-of-range slots (shifted-out sliding-window pods) match no
+    one-hot row, reproducing mode='drop'."""
+    i0 = jnp.int32(0)
+    i1 = jnp.int32(1)
+
+    created_out[:] = created_ref[:]
+    nrm_out[:] = nrm_ref[:]
+    pcr_out[:] = pcr_ref[:]
+    pseq_out[:] = pseq_ref[:]
+    prm_out[:] = prm_ref[:]
+
+    iota_n = jax.lax.broadcasted_iota(jnp.int32, created_ref.shape, 0)
+    iota_p = jax.lax.broadcasted_iota(jnp.int32, pcr_ref.shape, 0)
+    k_bound = jnp.max(jnp.sum(valid_ref[:], axis=0, keepdims=True))
+
+    def body(k):
+        kind = kind_ref[pl.ds(k, 1), :]
+        slot = slot_ref[pl.ds(k, 1), :]
+        rel = rel_ref[pl.ds(k, 1), :]
+        seq = seq_ref[pl.ds(k, 1), :]
+        v = valid_ref[pl.ds(k, 1), :] != i0
+
+        is_cn = v & (kind == jnp.int32(_EV_CREATE_NODE))
+        is_rn = v & (kind == jnp.int32(_EV_REMOVE_NODE))
+        is_cp = v & (kind == jnp.int32(_EV_CREATE_POD))
+        is_rp = v & (kind == jnp.int32(_EV_REMOVE_POD))
+
+        oh_n = iota_n == slot
+        created_out[:] = jnp.where(oh_n & is_cn, i1, created_out[:])
+        nrm_out[:] = jnp.where(
+            oh_n & is_rn, jnp.minimum(nrm_out[:], rel), nrm_out[:]
+        )
+        oh_p = iota_p == slot
+        pcr_out[:] = jnp.where(
+            oh_p & is_cp, jnp.minimum(pcr_out[:], rel), pcr_out[:]
+        )
+        pseq_out[:] = jnp.where(
+            oh_p & is_cp, jnp.maximum(pseq_out[:], seq), pseq_out[:]
+        )
+        prm_out[:] = jnp.where(
+            oh_p & is_rp, jnp.minimum(prm_out[:], rel), prm_out[:]
+        )
+
+    def loop_body(k):
+        body(k)
+        return k + i1
+
+    jax.lax.while_loop(lambda k: k < k_bound, loop_body, jnp.int32(0))
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def fused_event_scatter(
+    ev_kind: jnp.ndarray,   # (C, E) int32
+    ev_slot: jnp.ndarray,   # (C, E) int32 device coords
+    ev_rel: jnp.ndarray,    # (C, E) float32
+    ev_seq: jnp.ndarray,    # (C, E) int32
+    ev_valid: jnp.ndarray,  # (C, E) bool (per-lane prefix)
+    created: jnp.ndarray,       # (C, N) bool
+    node_removal: jnp.ndarray,  # (C, N) float32
+    pod_create: jnp.ndarray,    # (C, P) float32
+    pod_create_seq: jnp.ndarray,  # (C, P) int32
+    pod_removal: jnp.ndarray,   # (C, P) float32
+    interpret: bool = False,
+):
+    """Returns the five accumulators with this chunk's events applied,
+    bit-identical to the XLA scatter formulation."""
+    C, N = created.shape
+    P = pod_create.shape[1]
+    E = ev_kind.shape[1]
+    Cp = -(-C // _LANE) * _LANE
+    Np = -(-N // _SUB) * _SUB
+    Pp = -(-P // _SUB) * _SUB
+    Ep = -(-E // _SUB) * _SUB
+
+    def prep(x, n_sub, fill):
+        return _pad_axis(_pad_axis(x.T, 0, n_sub, fill), 1, Cp, fill)
+
+    f32inf = jnp.float32(np.inf)
+    args = (
+        prep(ev_kind.astype(jnp.int32), Ep, 0),
+        prep(ev_slot.astype(jnp.int32), Ep, -1),
+        prep(ev_rel.astype(jnp.float32), Ep, 0.0),
+        prep(ev_seq.astype(jnp.int32), Ep, 0),
+        prep(ev_valid.astype(jnp.int32), Ep, 0),
+        prep(created.astype(jnp.int32), Np, 0),
+        prep(node_removal.astype(jnp.float32), Np, f32inf),
+        prep(pod_create.astype(jnp.float32), Pp, f32inf),
+        prep(pod_create_seq.astype(jnp.int32), Pp, 0),
+        prep(pod_removal.astype(jnp.float32), Pp, f32inf),
+    )
+
+    def spec(n_sub):
+        return pl.BlockSpec((n_sub, _LANE), lambda i: (0, i), memory_space=pltpu.VMEM)
+
+    shapes = [
+        jax.ShapeDtypeStruct((Np, Cp), jnp.int32),
+        jax.ShapeDtypeStruct((Np, Cp), jnp.float32),
+        jax.ShapeDtypeStruct((Pp, Cp), jnp.float32),
+        jax.ShapeDtypeStruct((Pp, Cp), jnp.int32),
+        jax.ShapeDtypeStruct((Pp, Cp), jnp.float32),
+    ]
+    with jax.enable_x64(False):
+        created_o, nrm_o, pcr_o, pseq_o, prm_o = pl.pallas_call(
+            _event_kernel,
+            grid=(Cp // _LANE,),
+            in_specs=[spec(Ep)] * 5 + [spec(Np)] * 2 + [spec(Pp)] * 3,
+            out_specs=[spec(Np)] * 2 + [spec(Pp)] * 3,
+            out_shape=shapes,
+            compiler_params=pltpu.CompilerParams(
+                vmem_limit_bytes=_SELECT_VMEM_LIMIT
+            ),
+            interpret=interpret,
+        )(*args)
+
+    return (
+        created_o[:N, :C].T != 0,
+        nrm_o[:N, :C].T,
+        pcr_o[:P, :C].T,
+        pseq_o[:P, :C].T,
+        prm_o[:P, :C].T,
+    )
+
+
+def commit_kernel_fits(n_pods: int, k_pods: int) -> bool:
+    """VMEM fits-check for the commit-scatter kernel: 2 pod in + 4 pod out +
+    6 candidate blocks, double-buffered, plus loop temporaries (the kernel
+    raises the scoped limit)."""
+    pp_pad = -(-n_pods // _SUB) * _SUB
+    kp_pad = -(-k_pods // _SUB) * _SUB
+    resident = (6 * pp_pad + 6 * kp_pad) * _LANE * 4
+    return 2 * resident <= int(0.8 * _SELECT_VMEM_LIMIT)
+
+
+# Pod phases, duplicated from batched/state.py (see _EV_* note above).
+_PHASE_UNSCHEDULABLE = 2
+_PHASE_RUNNING = 3
+
+
+def _commit_kernel(
+    cand_ref,     # (Kp, LC) int32 pod slot
+    assign_ref,   # (Kp, LC) int32 0/1
+    park_ref,     # (Kp, LC) int32 0/1
+    best_ref,     # (Kp, LC) int32 node slot
+    start_ref,    # (Kp, LC) float32 start offset rel-seconds
+    parks_ref,    # (Kp, LC) float32 park offset rel-seconds
+    phase_ref,    # (Pp, LC) int32
+    node_ref,     # (Pp, LC) int32
+    phase_out,    # (Pp, LC) int32
+    node_out,     # (Pp, LC) int32
+    start_out,    # (Pp, LC) float32 (+inf = untouched)
+    park_out,     # (Pp, LC) float32 (+inf = untouched)
+):
+    """Scatter the cycle's K per-lane decisions back into the (P,) pod
+    arrays — the Pallas replacement for commit_cycle's four (C, K)-indexed
+    XLA scatters. Candidate slots are unique within a cycle, so the one-hot
+    writes are order-independent and bit-identical to the scatters."""
+    i0 = jnp.int32(0)
+    i1 = jnp.int32(1)
+    inf = jnp.float32(np.inf)
+
+    phase_out[:] = phase_ref[:]
+    node_out[:] = node_ref[:]
+    start_out[:] = jnp.full_like(start_out, inf)
+    park_out[:] = jnp.full_like(park_out, inf)
+
+    iota_p = jax.lax.broadcasted_iota(jnp.int32, phase_ref.shape, 0)
+    # touched == assign | park == the valid prefix (assign = valid & fit,
+    # park = valid & ~fit), so its per-lane count bounds the loop.
+    touched_all = (assign_ref[:] + park_ref[:]) > i0
+    k_bound = jnp.max(
+        jnp.sum(touched_all.astype(jnp.int32), axis=0, keepdims=True)
+    )
+
+    def body(k):
+        cand = cand_ref[pl.ds(k, 1), :]
+        assign = assign_ref[pl.ds(k, 1), :] != i0
+        park = park_ref[pl.ds(k, 1), :] != i0
+        best = best_ref[pl.ds(k, 1), :]
+        start_s = start_ref[pl.ds(k, 1), :]
+        park_s = parks_ref[pl.ds(k, 1), :]
+        touched = assign | park
+
+        oh = iota_p == cand
+        new_phase = jnp.where(
+            assign, jnp.int32(_PHASE_RUNNING), jnp.int32(_PHASE_UNSCHEDULABLE)
+        )
+        phase_out[:] = jnp.where(oh & touched, new_phase, phase_out[:])
+        node_out[:] = jnp.where(oh & assign, best, node_out[:])
+        start_out[:] = jnp.where(oh & assign, start_s, start_out[:])
+        park_out[:] = jnp.where(oh & park, park_s, park_out[:])
+
+    def loop_body(k):
+        body(k)
+        return k + i1
+
+    jax.lax.while_loop(lambda k: k < k_bound, loop_body, jnp.int32(0))
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def fused_commit_scatter(
+    cand: jnp.ndarray,     # (C, K) int32
+    assign: jnp.ndarray,   # (C, K) bool
+    park: jnp.ndarray,     # (C, K) bool
+    best: jnp.ndarray,     # (C, K) int32
+    start_s: jnp.ndarray,  # (C, K) float32
+    park_s: jnp.ndarray,   # (C, K) float32
+    phase: jnp.ndarray,    # (C, P) int32
+    node: jnp.ndarray,     # (C, P) int32
+    interpret: bool = False,
+):
+    """Returns (phase, node, start_tmp, park_tmp) with the decisions
+    applied; start_tmp/park_tmp are +inf where untouched, matching the XLA
+    formulation in commit_cycle."""
+    C, P = phase.shape
+    K = cand.shape[1]
+    Cp = -(-C // _LANE) * _LANE
+    Pp = -(-P // _SUB) * _SUB
+    Kp = -(-K // _SUB) * _SUB
+
+    def prep(x, n_sub, fill):
+        return _pad_axis(_pad_axis(x.T, 0, n_sub, fill), 1, Cp, fill)
+
+    args = (
+        prep(cand.astype(jnp.int32), Kp, -1),
+        prep(assign.astype(jnp.int32), Kp, 0),
+        prep(park.astype(jnp.int32), Kp, 0),
+        prep(best.astype(jnp.int32), Kp, 0),
+        prep(start_s.astype(jnp.float32), Kp, 0.0),
+        prep(park_s.astype(jnp.float32), Kp, 0.0),
+        prep(phase.astype(jnp.int32), Pp, 0),
+        prep(node.astype(jnp.int32), Pp, 0),
+    )
+
+    def spec(n_sub):
+        return pl.BlockSpec((n_sub, _LANE), lambda i: (0, i), memory_space=pltpu.VMEM)
+
+    with jax.enable_x64(False):
+        phase_o, node_o, start_o, park_o = pl.pallas_call(
+            _commit_kernel,
+            grid=(Cp // _LANE,),
+            in_specs=[spec(Kp)] * 6 + [spec(Pp)] * 2,
+            out_specs=[spec(Pp)] * 4,
+            out_shape=[
+                jax.ShapeDtypeStruct((Pp, Cp), jnp.int32),
+                jax.ShapeDtypeStruct((Pp, Cp), jnp.int32),
+                jax.ShapeDtypeStruct((Pp, Cp), jnp.float32),
+                jax.ShapeDtypeStruct((Pp, Cp), jnp.float32),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                vmem_limit_bytes=_SELECT_VMEM_LIMIT
+            ),
+            interpret=interpret,
+        )(*args)
+
+    return (
+        phase_o[:P, :C].T,
+        node_o[:P, :C].T,
+        start_o[:P, :C].T,
+        park_o[:P, :C].T,
+    )
+
+
 def _pad_axis(x: jnp.ndarray, axis: int, to: int, value) -> jnp.ndarray:
     pad = to - x.shape[axis]
     if pad <= 0:

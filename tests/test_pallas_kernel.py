@@ -241,3 +241,131 @@ def test_full_sim_selection_kernel_matches_scan():
     sel_sim.step_until_time(400.0)
     bad = compare_states(scan_sim.state, sel_sim.state)
     assert not bad, bad
+
+
+# --- free / event / commit scatter kernels -----------------------------------
+
+
+def test_free_kernel_matches_scatter_add():
+    from kubernetriks_tpu.ops.scheduler_kernel import fused_free_resources
+
+    rng = np.random.default_rng(7)
+    C, P, N = 5, 40, 9
+    freed = rng.random((C, P)) < 0.3
+    node = rng.integers(0, N, size=(C, P)).astype(np.int32)
+    req_cpu = rng.integers(1, 500, size=(C, P)).astype(np.int32)
+    req_ram = rng.integers(1, 500, size=(C, P)).astype(np.int32)
+    alloc_cpu = rng.integers(0, 10_000, size=(C, N)).astype(np.int32)
+    alloc_ram = rng.integers(0, 10_000, size=(C, N)).astype(np.int32)
+
+    got_cpu, got_ram = fused_free_resources(
+        jnp.asarray(freed), jnp.asarray(node), jnp.asarray(req_cpu),
+        jnp.asarray(req_ram), jnp.asarray(alloc_cpu), jnp.asarray(alloc_ram),
+        interpret=True,
+    )
+    want_cpu, want_ram = alloc_cpu.copy(), alloc_ram.copy()
+    for c in range(C):
+        for p in range(P):
+            if freed[c, p]:
+                want_cpu[c, node[c, p]] += req_cpu[c, p]
+                want_ram[c, node[c, p]] += req_ram[c, p]
+    np.testing.assert_array_equal(np.asarray(got_cpu), want_cpu)
+    np.testing.assert_array_equal(np.asarray(got_ram), want_ram)
+
+
+def test_event_kernel_matches_scatters():
+    from kubernetriks_tpu.ops.scheduler_kernel import fused_event_scatter
+
+    rng = np.random.default_rng(11)
+    C, E, N, P = 4, 12, 7, 20
+    kind = rng.integers(1, 5, size=(C, E)).astype(np.int32)
+    # Node events index N-space, pod events P-space; sprinkle out-of-range
+    # slots (sliding-window drops).
+    slot = np.where(
+        (kind == 1) | (kind == 2),
+        rng.integers(0, N + 2, size=(C, E)),
+        rng.integers(0, P + 3, size=(C, E)),
+    ).astype(np.int32)
+    rel = rng.uniform(-5.0, 15.0, size=(C, E)).astype(np.float32)
+    seq = rng.integers(0, 1000, size=(C, E)).astype(np.int32)
+    # valid must be a per-lane prefix (due events are a sorted slab prefix).
+    counts = rng.integers(0, E + 1, size=(C,))
+    valid = np.arange(E)[None, :] < counts[:, None]
+
+    created0 = rng.random((C, N)) < 0.2
+    nrm0 = np.where(rng.random((C, N)) < 0.3, rng.uniform(0, 20, (C, N)), np.inf).astype(np.float32)
+    pcr0 = np.full((C, P), np.inf, np.float32)
+    pseq0 = np.zeros((C, P), np.int32)
+    prm0 = np.full((C, P), np.inf, np.float32)
+
+    got = fused_event_scatter(
+        jnp.asarray(kind), jnp.asarray(slot), jnp.asarray(rel),
+        jnp.asarray(seq), jnp.asarray(valid),
+        jnp.asarray(created0), jnp.asarray(nrm0), jnp.asarray(pcr0),
+        jnp.asarray(pseq0), jnp.asarray(prm0),
+        interpret=True,
+    )
+    created, nrm, pcr, pseq, prm = (
+        created0.copy(), nrm0.copy(), pcr0.copy(), pseq0.copy(), prm0.copy()
+    )
+    for c in range(C):
+        for e in range(E):
+            if not valid[c, e]:
+                continue
+            s = slot[c, e]
+            if kind[c, e] == 1 and s < N:
+                created[c, s] = True
+            elif kind[c, e] == 2 and s < N:
+                nrm[c, s] = min(nrm[c, s], rel[c, e])
+            elif kind[c, e] == 3 and s < P:
+                pcr[c, s] = min(pcr[c, s], rel[c, e])
+                pseq[c, s] = max(pseq[c, s], seq[c, e])
+            elif kind[c, e] == 4 and s < P:
+                prm[c, s] = min(prm[c, s], rel[c, e])
+    np.testing.assert_array_equal(np.asarray(got[0]), created)
+    np.testing.assert_array_equal(np.asarray(got[1]), nrm)
+    np.testing.assert_array_equal(np.asarray(got[2]), pcr)
+    np.testing.assert_array_equal(np.asarray(got[3]), pseq)
+    np.testing.assert_array_equal(np.asarray(got[4]), prm)
+
+
+def test_commit_kernel_matches_scatters():
+    from kubernetriks_tpu.ops.scheduler_kernel import fused_commit_scatter
+
+    rng = np.random.default_rng(13)
+    C, K, P, N = 4, 10, 30, 6
+    # Unique candidate slots per cluster (a pod is selected at most once).
+    cand = np.stack([rng.permutation(P)[:K] for _ in range(C)]).astype(np.int32)
+    counts = rng.integers(0, K + 1, size=(C,))
+    valid = np.arange(K)[None, :] < counts[:, None]
+    assign = valid & (rng.random((C, K)) < 0.6)
+    park = valid & ~assign
+    best = rng.integers(0, N, size=(C, K)).astype(np.int32)
+    start_s = rng.uniform(0, 5, size=(C, K)).astype(np.float32)
+    park_s = rng.uniform(0, 5, size=(C, K)).astype(np.float32)
+    phase0 = rng.integers(0, 4, size=(C, P)).astype(np.int32)
+    node0 = rng.integers(-1, N, size=(C, P)).astype(np.int32)
+
+    got = fused_commit_scatter(
+        jnp.asarray(cand), jnp.asarray(assign), jnp.asarray(park),
+        jnp.asarray(best), jnp.asarray(start_s), jnp.asarray(park_s),
+        jnp.asarray(phase0), jnp.asarray(node0),
+        interpret=True,
+    )
+    phase, node = phase0.copy(), node0.copy()
+    start_tmp = np.full((C, P), np.inf, np.float32)
+    park_tmp = np.full((C, P), np.inf, np.float32)
+    for c in range(C):
+        for k in range(K):
+            s = cand[c, k]
+            if assign[c, k]:
+                phase[c, s] = 3
+                node[c, s] = best[c, k]
+                start_tmp[c, s] = start_s[c, k]
+            elif park[c, k]:
+                phase[c, s] = 2
+                park_tmp[c, s] = park_s[c, k]
+    np.testing.assert_array_equal(np.asarray(got[0]), phase)
+    np.testing.assert_array_equal(np.asarray(got[1]), node)
+    np.testing.assert_array_equal(np.asarray(got[2]), start_tmp)
+    np.testing.assert_array_equal(np.asarray(got[3]), park_tmp)
