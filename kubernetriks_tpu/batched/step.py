@@ -369,12 +369,18 @@ def _apply_window_events(
         fused_free_resources,
     )
 
+    duration_s = t_seconds_f32(pods.duration, interval)
+    dur_stats = None
     if use_pallas and use_pallas_select and free_kernel_fits(N, P):
         core = partial(fused_free_resources, interpret=pallas_interpret)
         if pallas_mesh is not None:
-            core = _shard_rowwise(core, 6, 2, pallas_mesh, pallas_axis)
-        alloc_cpu, alloc_ram = core(
-            freed, pods.node, pods.req_cpu, pods.req_ram, alloc_cpu, alloc_ram
+            core = _shard_rowwise(core, 8, 3, pallas_mesh, pallas_axis)
+        # The kernel also folds the finished pods' duration-estimator
+        # samples (count/total/total_sq/min/max), replacing the five
+        # (C, P) masked reductions below.
+        alloc_cpu, alloc_ram, dur_stats = core(
+            freed, pods.node, pods.req_cpu, pods.req_ram,
+            finishes, duration_s, alloc_cpu, alloc_ram,
         )
     else:
         F = min(P, 32)  # freed-compaction chunk width (independent of E)
@@ -401,12 +407,25 @@ def _apply_window_events(
         )
 
     # Finished pods.
-    n_done = finishes.sum(axis=1, dtype=jnp.int32)
-    duration_s = t_seconds_f32(pods.duration, interval)
+    if dur_stats is not None:
+        n_done = dur_stats[:, 0].astype(jnp.int32)
+        est = metrics.pod_duration
+        pod_duration_est = EstArrays(
+            count=est.count + n_done,
+            total=est.total + dur_stats[:, 1],
+            total_sq=est.total_sq + dur_stats[:, 2],
+            minimum=jnp.minimum(est.minimum, dur_stats[:, 3]),
+            maximum=jnp.maximum(est.maximum, dur_stats[:, 4]),
+        )
+    else:
+        n_done = finishes.sum(axis=1, dtype=jnp.int32)
+        pod_duration_est = _est_add_reduced(
+            metrics.pod_duration, duration_s, finishes
+        )
     metrics = metrics._replace(
         pods_succeeded=metrics.pods_succeeded + n_done,
         terminated_pods=metrics.terminated_pods + n_done,
-        pod_duration=_est_add_reduced(metrics.pod_duration, duration_s, finishes),
+        pod_duration=pod_duration_est,
         processed_nodes=metrics.processed_nodes + created.sum(axis=1, dtype=jnp.int32),
     )
     phase = jnp.where(finishes, PHASE_SUCCEEDED, phase)

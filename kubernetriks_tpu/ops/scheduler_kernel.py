@@ -367,13 +367,14 @@ def fused_select_schedule_cycle(
 
 
 def free_kernel_fits(n_nodes: int, n_pods: int) -> bool:
-    """VMEM fits-check for the freed-resource kernel: 5 pod blocks (incl.
-    scratch) + 4 node blocks, int32, double-buffered by Mosaic, plus stack
-    temporaries for the loop body's (Pp, LC) masks — the kernel raises the
-    scoped limit to _SELECT_VMEM_LIMIT, the check keeps ~40% headroom."""
+    """VMEM fits-check for the freed-resource kernel: 7 pod blocks (incl.
+    finish mask, estimator values and scratch) + 4 node blocks,
+    double-buffered by Mosaic, plus stack temporaries for the loop body's
+    (Pp, LC) masks — the kernel raises the scoped limit to
+    _SELECT_VMEM_LIMIT, the check keeps ~40% headroom."""
     np_pad = -(-n_nodes // _SUB) * _SUB
     pp_pad = -(-n_pods // _SUB) * _SUB
-    resident = (5 * pp_pad + 4 * np_pad) * _LANE * 4
+    resident = (7 * pp_pad + 4 * np_pad) * _LANE * 4
     return 2 * resident <= int(0.8 * _SELECT_VMEM_LIMIT)
 
 
@@ -382,10 +383,13 @@ def _free_kernel(
     node_ref,      # (Pp, LC) int32 assigned node slot
     reqc_ref,      # (Pp, LC) int32
     reqr_ref,      # (Pp, LC) int32
+    finish_ref,    # (Pp, LC) int32 0/1 (finishes subset of freed)
+    value_ref,     # (Pp, LC) float32 estimator sample (pod duration seconds)
     acpu_ref,      # (Np, LC) int32
     aram_ref,      # (Np, LC) int32
     acpu_out,      # (Np, LC) int32
     aram_out,      # (Np, LC) int32
+    stats_out,     # (8, LC) float32: rows count/total/total_sq/min/max
     rem_ref,       # (Pp, LC) int32 scratch
 ):
     """Return freed pods' requests to their nodes' allocatable — the batched
@@ -396,13 +400,26 @@ def _free_kernel(
     shapes); here each freed pod is extracted by a per-lane first-set-bit
     pass and added via a node one-hot, with a data-dependent early exit at
     the deepest lane's freed count. Integer adds commute, so the result is
-    bit-identical to the XLA loop."""
+    bit-identical to the XLA loop.
+
+    The same iteration also folds the pod-duration estimator samples of the
+    FINISHED subset (stats_out rows 0..4: count/total/total_sq/min/max) —
+    replacing the five (C, P) masked reductions of _est_add_reduced, whose
+    unfused passes cost ~1.5 ms/window at dense shapes. The float32 sums
+    accumulate in a different order than XLA's tiled reduction: within the
+    documented metric-accumulator tolerance (docs/PARITY.md)."""
     i0 = jnp.int32(0)
     neg1 = jnp.int32(-1)
     bigi = jnp.int32(np.iinfo(np.int32).max)
+    f0 = jnp.float32(0.0)
+    f1 = jnp.float32(1.0)
+    finf = jnp.float32(np.inf)
 
     acpu_out[:] = acpu_ref[:]
     aram_out[:] = aram_ref[:]
+    stats_out[:] = jnp.zeros_like(stats_out)
+    stats_out[3:4, :] = stats_out[3:4, :] + finf
+    stats_out[4:5, :] = stats_out[4:5, :] - finf
     rem_ref[:] = freed_ref[:]
     iota_p = jax.lax.broadcasted_iota(jnp.int32, freed_ref.shape, 0)
     iota_n = jax.lax.broadcasted_iota(jnp.int32, acpu_ref.shape, 0)
@@ -421,6 +438,14 @@ def _free_kernel(
         aram_out[:] = aram_out[:] + jnp.where(oh, rr, i0)
         rem_ref[:] = jnp.where(sel, i0, rem_ref[:])
 
+        fin = jnp.max(seli * finish_ref[:], axis=0, keepdims=True) > i0
+        v = jnp.max(jnp.where(sel, value_ref[:], -finf), axis=0, keepdims=True)
+        stats_out[0:1, :] = stats_out[0:1, :] + jnp.where(fin, f1, f0)
+        stats_out[1:2, :] = stats_out[1:2, :] + jnp.where(fin, v, f0)
+        stats_out[2:3, :] = stats_out[2:3, :] + jnp.where(fin, v * v, f0)
+        stats_out[3:4, :] = jnp.minimum(stats_out[3:4, :], jnp.where(fin, v, finf))
+        stats_out[4:5, :] = jnp.maximum(stats_out[4:5, :], jnp.where(fin, v, -finf))
+
     def loop_body(k):
         body(k)
         return k + jnp.int32(1)
@@ -434,12 +459,16 @@ def fused_free_resources(
     node: jnp.ndarray,       # (C, P) int32 (>= 0 for freed pods)
     req_cpu: jnp.ndarray,    # (C, P) int32
     req_ram: jnp.ndarray,    # (C, P) int32
+    finishes: jnp.ndarray,   # (C, P) bool (the estimator subset of freed)
+    value: jnp.ndarray,      # (C, P) float32 estimator sample per pod
     alloc_cpu: jnp.ndarray,  # (C, N) int32
     alloc_ram: jnp.ndarray,  # (C, N) int32
     interpret: bool = False,
 ):
-    """(new_alloc_cpu, new_alloc_ram) with every freed pod's requests added
-    back to its node — bit-identical to the top_k-compaction loop."""
+    """(new_alloc_cpu, new_alloc_ram, stats (C, 5)) — the allocatables with
+    every freed pod's requests added back (bit-identical to the
+    top_k-compaction loop) and the finished pods' estimator fold
+    (count/total/total_sq/min/max of `value`)."""
     C, N = alloc_cpu.shape
     P = freed.shape[1]
     Cp = -(-C // _LANE) * _LANE
@@ -447,36 +476,40 @@ def fused_free_resources(
     Pp = -(-P // _SUB) * _SUB
 
     def prep(x, n_sub, fill):
-        return _pad_axis(_pad_axis(x.astype(jnp.int32).T, 0, n_sub, fill), 1, Cp, fill)
+        return _pad_axis(_pad_axis(x.T, 0, n_sub, fill), 1, Cp, fill)
 
-    freed_p = prep(freed, Pp, 0)
-    node_p = prep(node, Pp, -1)
-    reqc_p = prep(req_cpu, Pp, 0)
-    reqr_p = prep(req_ram, Pp, 0)
-    acpu_p = prep(alloc_cpu, Np, 0)
-    aram_p = prep(alloc_ram, Np, 0)
+    freed_p = prep(freed.astype(jnp.int32), Pp, 0)
+    node_p = prep(node.astype(jnp.int32), Pp, -1)
+    reqc_p = prep(req_cpu.astype(jnp.int32), Pp, 0)
+    reqr_p = prep(req_ram.astype(jnp.int32), Pp, 0)
+    fin_p = prep(finishes.astype(jnp.int32), Pp, 0)
+    val_p = prep(value.astype(jnp.float32), Pp, 0.0)
+    acpu_p = prep(alloc_cpu.astype(jnp.int32), Np, 0)
+    aram_p = prep(alloc_ram.astype(jnp.int32), Np, 0)
 
     node_spec = pl.BlockSpec((Np, _LANE), lambda i: (0, i), memory_space=pltpu.VMEM)
     pod_spec = pl.BlockSpec((Pp, _LANE), lambda i: (0, i), memory_space=pltpu.VMEM)
+    stats_spec = pl.BlockSpec((8, _LANE), lambda i: (0, i), memory_space=pltpu.VMEM)
 
     with jax.enable_x64(False):
-        acpu_o, aram_o = pl.pallas_call(
+        acpu_o, aram_o, stats_o = pl.pallas_call(
             _free_kernel,
             grid=(Cp // _LANE,),
-            in_specs=[pod_spec] * 4 + [node_spec] * 2,
-            out_specs=[node_spec] * 2,
+            in_specs=[pod_spec] * 6 + [node_spec] * 2,
+            out_specs=[node_spec] * 2 + [stats_spec],
             out_shape=[
                 jax.ShapeDtypeStruct((Np, Cp), jnp.int32),
                 jax.ShapeDtypeStruct((Np, Cp), jnp.int32),
+                jax.ShapeDtypeStruct((8, Cp), jnp.float32),
             ],
             scratch_shapes=[pltpu.VMEM((Pp, _LANE), jnp.int32)],
             compiler_params=pltpu.CompilerParams(
                 vmem_limit_bytes=_SELECT_VMEM_LIMIT
             ),
             interpret=interpret,
-        )(freed_p, node_p, reqc_p, reqr_p, acpu_p, aram_p)
+        )(freed_p, node_p, reqc_p, reqr_p, fin_p, val_p, acpu_p, aram_p)
 
-    return acpu_o[:N, :C].T, aram_o[:N, :C].T
+    return acpu_o[:N, :C].T, aram_o[:N, :C].T, stats_o[:5, :C].T
 
 
 def event_kernel_fits(n_nodes: int, n_pods: int, n_events: int) -> bool:

@@ -258,9 +258,12 @@ def test_free_kernel_matches_scatter_add():
     alloc_cpu = rng.integers(0, 10_000, size=(C, N)).astype(np.int32)
     alloc_ram = rng.integers(0, 10_000, size=(C, N)).astype(np.int32)
 
-    got_cpu, got_ram = fused_free_resources(
+    finishes = freed & (rng.random((C, P)) < 0.7)
+    value = rng.uniform(0.0, 100.0, size=(C, P)).astype(np.float32)
+    got_cpu, got_ram, stats = fused_free_resources(
         jnp.asarray(freed), jnp.asarray(node), jnp.asarray(req_cpu),
-        jnp.asarray(req_ram), jnp.asarray(alloc_cpu), jnp.asarray(alloc_ram),
+        jnp.asarray(req_ram), jnp.asarray(finishes), jnp.asarray(value),
+        jnp.asarray(alloc_cpu), jnp.asarray(alloc_ram),
         interpret=True,
     )
     want_cpu, want_ram = alloc_cpu.copy(), alloc_ram.copy()
@@ -271,6 +274,15 @@ def test_free_kernel_matches_scatter_add():
                 want_ram[c, node[c, p]] += req_ram[c, p]
     np.testing.assert_array_equal(np.asarray(got_cpu), want_cpu)
     np.testing.assert_array_equal(np.asarray(got_ram), want_ram)
+    # Estimator fold over the finished subset.
+    stats = np.asarray(stats)
+    for c in range(C):
+        vals = value[c][finishes[c]]
+        assert stats[c, 0] == len(vals)
+        np.testing.assert_allclose(stats[c, 1], vals.sum(), rtol=1e-6)
+        np.testing.assert_allclose(stats[c, 2], (vals * vals).sum(), rtol=1e-6)
+        assert stats[c, 3] == (vals.min() if len(vals) else np.inf)
+        assert stats[c, 4] == (vals.max() if len(vals) else -np.inf)
 
 
 def test_event_kernel_matches_scatters():
