@@ -140,3 +140,21 @@ def test_pallas_shard_map_matches_scan_on_mesh(mixed_traces, full_run, mesh):
     sim.step_until_time(HORIZON)
     bad = compare_states(full_run.state, sim.state)
     assert not bad, bad
+
+
+def test_checkpoint_resume_through_flagship_composition(tmp_path, mixed_traces, full_run):
+    """save/load_checkpoint mid-run through the COMPOSED configuration
+    (sliding pod window + segmented HPA rings + CA): the restored sim must
+    resume with the correct window base and finish identical to the
+    uninterrupted run."""
+    half = _build(mixed_traces, pod_window=64)
+    half.step_until_time(800.0)
+    assert half._pod_base > 0, "checkpoint should capture a shifted window"
+    half.save_checkpoint(str(tmp_path / "flagship_ckpt"))
+
+    resumed = _build(mixed_traces, pod_window=64)
+    resumed.load_checkpoint(str(tmp_path / "flagship_ckpt"))
+    assert resumed._pod_base == half._pod_base
+    assert resumed.next_window == half.next_window
+    resumed.step_until_time(HORIZON)
+    _assert_matches_full(resumed, full_run)
