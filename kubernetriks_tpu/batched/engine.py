@@ -719,37 +719,53 @@ class BatchedSimulation:
         pod-group tail beyond it is untouched. Returns False if no shift is
         possible."""
         from kubernetriks_tpu.batched.state import (
+            PHASE_EMPTY,
             PHASE_FAILED,
             PHASE_REMOVED,
             PHASE_SUCCEEDED,
         )
         from kubernetriks_tpu.batched.state import duration_pair_np
 
+        def slice_pad(arr, start, width, fill):
+            """arr[:, start:start+width], right-padded with fill past the
+            trace's plain-pod segment."""
+            seg = arr[:, start : start + width]
+            if seg.shape[1] < width:
+                pad = np.full(
+                    (arr.shape[0], width - seg.shape[1]), fill, arr.dtype
+                )
+                seg = np.concatenate([seg, pad], axis=1)
+            return seg
+
         W = self.pod_window
+        win_lo = self._pod_base
         phases = to_host(self.state.pods.phase)[:, :W]
         terminal = (
             (phases == PHASE_SUCCEEDED)
             | (phases == PHASE_REMOVED)
             | (phases == PHASE_FAILED)
         )
-        nonterm = ~terminal
+        # Padding slots — EMPTY with NO create event in the trace (shorter
+        # clusters of a heterogeneous batch, or the padded tail) — can never
+        # come alive, so they never block the shift. EMPTY slots whose
+        # create event is still pending must stay.
+        no_create = np.iinfo(np.int32).max
+        create_win = slice_pad(self._pod_create_win, win_lo, W, no_create)
+        padding = (phases == PHASE_EMPTY) & (create_win == no_create)
+        blocking = ~(terminal | padding)
         first_live = np.where(
-            nonterm.any(axis=1), nonterm.argmax(axis=1), phases.shape[1]
+            blocking.any(axis=1), blocking.argmax(axis=1), phases.shape[1]
         )
         s = int(first_live.min())
         if s <= 0:
             return False
 
         C = phases.shape[0]
-        lo = self._pod_base + W
+        refill_lo = win_lo + W
         full = self._full_pods
 
         def payload(arr, fill):
-            seg = arr[:, lo : lo + s]
-            if seg.shape[1] < s:
-                pad = np.full((C, s - seg.shape[1]), fill, arr.dtype)
-                seg = np.concatenate([seg, pad], axis=1)
-            return seg
+            return slice_pad(arr, refill_lo, s, fill)
 
         # The refill slots are pristine pod slots — built by the SAME
         # constructor init_state uses, so windowed and full-resident runs

@@ -158,3 +158,49 @@ def test_checkpoint_resume_through_flagship_composition(tmp_path, mixed_traces, 
     assert resumed.next_window == half.next_window
     resumed.step_until_time(HORIZON)
     _assert_matches_full(resumed, full_run)
+
+
+def test_heterogeneous_batch_segmented_layout():
+    """A batch mixing DIFFERENT traces — one with an HPA pod group, one with
+    plain pods only, one with nodes only (zero pods) — through the segmented
+    layout and the sliding window: each cluster must behave exactly like its
+    own single-cluster full-resident run."""
+    config = default_test_simulation_config(HPA_CA_SUFFIX)
+    from kubernetriks_tpu.batched.engine import BatchedSimulation
+    from kubernetriks_tpu.batched.trace_compile import compile_cluster_trace
+
+    cluster = GenericClusterTrace.from_yaml(HPA_CA_CLUSTER).convert_to_simulator_events()
+    plain = PoissonWorkloadTrace(
+        rate_per_second=0.2,
+        horizon=900.0,
+        seed=29,
+        cpu=1000,
+        ram=2 * 1024**3,
+        duration_range=(15.0, 60.0),
+    ).convert_to_simulator_events()
+    group = GenericWorkloadTrace.from_yaml(HPA_CA_WORKLOAD).convert_to_simulator_events()
+
+    mixed = compile_cluster_trace(
+        cluster, sorted(plain + group, key=lambda e: e[0]), config
+    )
+    plain_only = compile_cluster_trace(cluster, list(plain), config)
+    nodes_only = compile_cluster_trace(cluster, [], config)
+    batch = [mixed, plain_only, nodes_only]
+
+    hetero = BatchedSimulation(
+        config, batch, max_pods_per_cycle=16, pod_window=48
+    )
+    assert hetero._resident_shift > 0, "segmented layout must be active"
+    hetero.step_until_time(1200.0)
+    assert hetero._pod_base > 0
+
+    for i, compiled in enumerate(batch):
+        solo = BatchedSimulation(config, [compiled], max_pods_per_cycle=16)
+        solo.step_until_time(1200.0)
+        assert hetero.cluster_metrics(i) == solo.cluster_metrics(0), i
+        pv_h, pv_s = hetero.pod_view(i), solo.pod_view(0)
+        for name in pv_h:
+            assert pv_h[name] == pv_s[name], (i, name)
+        if i == 0:
+            # The group cluster's replica trajectory is its own.
+            assert hetero.hpa_replicas(0) == solo.hpa_replicas(0)
