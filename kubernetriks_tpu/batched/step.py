@@ -13,13 +13,19 @@ one event at a time) with array programs:
   finish-vs-removal interleaving is resolved elementwise per pod by comparing
   finish_time against min(window_end, node_removal_time, pod_removal_time) —
   ordering fidelity without a per-event loop.
-- The kube-scheduler cycle is a COMPACTED sequential scan: the queue is sorted
-  by (queue_ts, queue_seq) — identical to the scalar ActiveQueue's
-  (timestamp, insertion seq) min-heap — the top-K candidates are gathered to
-  (C, K) arrays, the scan updates only (C, N) allocatables per step (Fit mask +
-  LeastAllocatedResources score + last-wins argmax, reference semantics:
-  src/core/scheduler/kube_scheduler.rs:63-152, plugin.rs:33-63), and results
-  scatter back to (C, P) once.
+- The kube-scheduler cycle has three equivalent formulations (see
+  _run_scheduling_cycle): a sorted top-K compaction + lax.scan (the oracle;
+  queue order (queue_ts, queue_seq) == the scalar ActiveQueue's (timestamp,
+  insertion seq) min-heap; Fit mask + LeastAllocatedResources score +
+  last-wins argmax, reference semantics:
+  src/core/scheduler/kube_scheduler.rs:63-152, plugin.rs:33-63), the same
+  sort feeding a Pallas candidate kernel with a data-dependent early exit,
+  and — on dense cluster batches — a fully fused Pallas selection kernel
+  with no sort at all (ops/scheduler_kernel.py). Dense batches also route
+  the freed-resource, event-application and decision-commit scatters
+  through one-hot Pallas kernels (TPU scatter cost is per-index).
+- run_windows_skip fast-forwards over provably no-op windows (bit-exact;
+  the engine auto-enables it on sparse traces).
 
 Time is the 32-bit (win, off) pair of timerep.py. Each step runs at window
 index W (cycle time T = W * interval); all event/effect times applied in the

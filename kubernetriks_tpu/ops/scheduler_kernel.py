@@ -1,24 +1,35 @@
-"""Pallas TPU kernel: fused kube-scheduler cycle over a cluster batch.
+"""Pallas TPU kernels for the batched simulation's hot loop.
 
-The batched scheduling cycle (batched/step.py _run_scheduling_cycle, scalar
-equivalent reference: src/core/scheduler/scheduler.rs:246-333) is a K-step
-sequential loop — pod k's Fit filter + LeastAllocatedResources score +
-last-wins argmax (reference: src/core/scheduler/plugin.rs:33-63,
-kube_scheduler.rs:140-150) must see the allocatable updates of pods 0..k-1.
-As a lax.scan, each of the K iterations round-trips the (C, N) allocatable
-arrays through HBM. This kernel runs the whole loop with the node tile pinned
-in VMEM: one HBM read and one write of node state per cycle instead of K.
+Five kernels, one layout: everything works TRANSPOSED — clusters ride the
+128-wide lane dimension (one grid program per 128-cluster tile) and
+node/pod/candidate slots ride sublanes, because Mosaic only allows dynamic
+slicing (`pl.ds(k, 1)`) on sublane dimensions, and per-lane one-hot
+compares replace data-dependent scatters (TPU scatter cost is per-index).
+Every kernel carries a data-dependent early exit at the tile's actual work
+count, which lax.scan formulations cannot express.
 
-Layout: the kernel works TRANSPOSED — clusters ride the 128-wide lane
-dimension (one grid program per 128-cluster tile) and node/candidate slots
-ride sublanes, because Mosaic only allows dynamic slicing (the per-iteration
-candidate row `pl.ds(k, 1)`) on sublane dimensions; lane-dim indices must be
-statically 128-aligned.
+- `_cycle_kernel` (fused_schedule_cycle): the K-pod scheduling loop — pod
+  k's Fit filter + LeastAllocatedResources score + last-wins argmax
+  (reference: src/core/scheduler/plugin.rs:33-63, kube_scheduler.rs:140-150)
+  must see the allocatable updates of pods 0..k-1; the node tile stays
+  pinned in VMEM across the loop (one HBM round-trip per cycle instead
+  of K).
+- `_select_cycle_kernel` (fused_select_schedule_cycle): the same loop with
+  candidate EXTRACTION in-kernel via an iterated per-lane lexicographic
+  argmin over the queue keys — the dense-batch default, eliminating the
+  (C, P) 3-key sort.
+- `_free_kernel` (fused_free_resources): freed pods' requests returned to
+  their nodes via one-hot adds + the finished pods' duration-estimator fold.
+- `_event_kernel` (fused_event_scatter): one chunk of due trace events
+  applied to the per-slot accumulators (five XLA scatters replaced).
+- `_commit_kernel` (fused_commit_scatter): the cycle's decisions scattered
+  back into the (P,) pod arrays.
 
-The kernel computes only the state-dependent core (fit/score/argmax +
-allocatable updates) and returns per-candidate decisions; the cheap (C,)-
-shaped timing/metric mechanics stay in step.py where they replicate the
-scan path's float-op ordering bit for bit.
+The decision kernels return per-candidate outputs; the cheap (C,)-shaped
+timing/metric mechanics stay in step.py where they replicate the scan
+path's float-op ordering bit for bit. Parity: interpret-mode unit tests +
+full-sim equivalence in tests/test_pallas_kernel.py, on-hardware 3-way
+check in scripts/check_tpu_parity.py.
 """
 
 from __future__ import annotations
