@@ -153,3 +153,51 @@ def test_dense_trace_exact():
     )
     cluster, workload = _sparse_traces(rate=1.5, horizon=400.0, seed=41)
     _run_both(config, cluster, workload, 700.0)
+
+
+def test_fast_forward_under_mesh_exact():
+    """Fast-forward on an 8-device mesh: the skip's global reductions and
+    bookkeeping catch-up must behave identically sharded."""
+    import jax
+    from jax.sharding import Mesh
+
+    config = SimulationConfig.from_yaml(
+        "sim_name: ffm\nseed: 1\nscheduling_cycle_interval: 10.0"
+    )
+    cluster, workload = _sparse_traces(rate=0.04, horizon=2000.0, seed=47)
+    mesh = Mesh(np.array(jax.devices()), ("clusters",))
+    plain = build_batched_from_traces(
+        config, list(cluster), list(workload), n_clusters=8,
+        max_pods_per_cycle=8, fast_forward=False,
+    )
+    fast = build_batched_from_traces(
+        config, list(cluster), list(workload), n_clusters=8,
+        max_pods_per_cycle=8, fast_forward=True, mesh=mesh,
+    )
+    plain.step_until_time(3000.0)
+    fast.step_until_time(3000.0)
+    assert len(fast.state.pods.phase.devices()) == 8
+    bad = compare_states(plain.state, fast.state)
+    assert not bad, bad
+
+
+def test_gauge_collection_forces_per_window_stepping():
+    """collect_gauges needs one sample per window, so the fast-forward
+    dispatch must fall back to the scan — the gauge series stays dense."""
+    config = SimulationConfig.from_yaml(
+        "sim_name: ffg\nseed: 1\nscheduling_cycle_interval: 10.0"
+    )
+    cluster, workload = _sparse_traces(rate=0.03, horizon=800.0, seed=53)
+    sim = build_batched_from_traces(
+        config, list(cluster), list(workload), n_clusters=2,
+        max_pods_per_cycle=8, fast_forward=True,
+    )
+    assert sim.fast_forward
+    sim.collect_gauges = True
+    sim.step_until_time(1000.0)
+    times, samples = sim.gauge_series()
+    # One gauge row per window (0..100 inclusive), no gaps despite
+    # fast_forward being on.
+    assert len(times) == 101
+    np.testing.assert_allclose(np.diff(times), 10.0)
+    assert samples.shape[0] == 101
