@@ -74,11 +74,25 @@ def policy_cycle(
     rng: jnp.ndarray,
     greedy: bool = False,
     conditional_move: bool = False,
+    reward_size_weighted: bool = False,
+    shaping_coef: float = 0.0,
+    shaping_gamma: float = 0.99,
 ) -> Tuple[ClusterBatchState, Transition]:
     """One scheduling cycle (at window index W) where the policy picks nodes;
     returns the K per-cluster transitions. Action space = nodes, masked to
     Fit-feasible ones; no feasible node -> the pod parks unschedulable (like
-    the Fit filter)."""
+    the Fit filter).
+
+    Reward options (defaults preserve the plain +1/-1 reward):
+    - reward_size_weighted: placements/parks pay req_cpu/node_cap instead of
+      1 — capacity-weighted throughput, so stranding a full-node pod costs
+      what a full node's worth of small pods earns.
+    - shaping_coef (alpha): potential-based shaping F = gamma*phi(s') -
+      phi(s) with phi = alpha * (count of whole-free alive nodes). Fragmenting
+      a pristine node is charged AT the decision that fragments it instead of
+      hundreds of decisions later when a large pod parks — potential-based,
+      so the optimal policy is unchanged (Ng/Harada/Russell 1999) but the
+      credit horizon collapses from O(rollout) to O(1)."""
     C, P = state.pods.phase.shape
     N = state.nodes.alive.shape[1]
     rows1 = jnp.arange(C, dtype=jnp.int32)
@@ -122,16 +136,43 @@ def policy_cycle(
         assign = valid & any_fit
         park = valid & ~any_fit
         action_c = jnp.clip(action, 0, None)
+        whole_free_before = (
+            (alive & (alloc_cpu == state.nodes.cap_cpu))
+            .sum(axis=1)
+            .astype(jnp.float32)
+        )
         alloc_cpu = alloc_cpu.at[rows1, action_c].add(jnp.where(assign, -req_cpu, 0))
         alloc_ram = alloc_ram.at[rows1, action_c].add(jnp.where(assign, -req_ram, 0))
 
-        # Reward: +1 per placement, -1 per unschedulable park, minus a queue
-        # time penalty so the policy learns not to strand future pods.
+        # Reward: placement pays +1 (or its capacity share), an unschedulable
+        # park costs the same magnitude, minus a queue-time penalty so the
+        # policy learns not to strand future pods.
+        if reward_size_weighted:
+            cap_at = jnp.maximum(
+                state.nodes.cap_cpu[rows1, action_c].astype(jnp.float32), 1.0
+            )
+            unit = req_cpu.astype(jnp.float32) / cap_at
+        else:
+            unit = jnp.ones_like(req_cpu, jnp.float32)
         reward = jnp.where(
             assign,
-            1.0 - 0.01 * jnp.minimum(pod_queue_time.astype(jnp.float32), 100.0),
-            jnp.where(park, -1.0, 0.0),
+            unit - 0.01 * jnp.minimum(pod_queue_time.astype(jnp.float32), 100.0),
+            jnp.where(park, -unit, 0.0),
         )
+        if shaping_coef:
+            whole_free_after = (
+                (alive & (alloc_cpu == state.nodes.cap_cpu))
+                .sum(axis=1)
+                .astype(jnp.float32)
+            )
+            # Only valid decisions carry shaping (invalid slots must stay
+            # transparent to GAE's masked recursion).
+            reward = reward + jnp.where(
+                valid,
+                shaping_coef
+                * (jnp.float32(shaping_gamma) * whole_free_after - whole_free_before),
+                0.0,
+            )
         transition = Transition(
             obs=obs,
             action=action,
@@ -170,6 +211,9 @@ def policy_cycle(
         "conditional_move",
         "max_ca_pods_per_cycle",
         "max_pods_per_scale_down",
+        "reward_size_weighted",
+        "shaping_coef",
+        "shaping_gamma",
     ),
 )
 def rollout(
@@ -187,6 +231,9 @@ def rollout(
     autoscale_statics=None,
     max_ca_pods_per_cycle: int = 64,
     max_pods_per_scale_down: int = 8,
+    reward_size_weighted: bool = False,
+    shaping_coef: float = 0.0,
+    shaping_gamma: float = 0.99,
 ) -> Tuple[ClusterBatchState, Transition]:
     """Scan scheduling windows (int32 indices) under the policy; transitions
     stacked (W, K, C, ...). With autoscale_statics, the HPA/CA passes run
@@ -203,6 +250,8 @@ def rollout(
         st, transition = policy_cycle(
             st, w_arr, consts, max_pods_per_cycle, policy_apply, params, sub,
             greedy=greedy, conditional_move=conditional_move,
+            reward_size_weighted=reward_size_weighted,
+            shaping_coef=shaping_coef, shaping_gamma=shaping_gamma,
         )
         if autoscale_statics is not None:
             from kubernetriks_tpu.batched.autoscale import ca_pass, hpa_pass

@@ -1,0 +1,121 @@
+"""Policy evaluation: the learning-proof harness for the RL scheduler.
+
+Compares, on the SAME trace and the SAME scheduling-window cadence:
+  - the learned policy run greedily (argmax actions, no exploration noise),
+  - the KubeScheduler batched path (Fit filter + LeastAllocatedResources
+    score — the reference default, src/core/scheduler/kube_scheduler.rs),
+against placement metrics read from the shared MetricArrays, so the
+comparison is apples-to-apples: both paths use prepare_cycle/commit_cycle
+and decision_metrics identically (rl/env.py vs batched/step.py).
+
+The headline scenario (scripts/train_rl_proof.py, tests/test_rl_learning.py)
+is a contended bimodal mix: a high-rate small-pod process plus a low-rate
+large-pod process on a cluster sized so that SPREADING small pods (what
+LeastAllocated does) fragments every node below the large-pod request,
+while PACKING them leaves whole nodes free. Placement strategy — not
+capacity — decides whether large pods ever place, which is exactly the
+signal a learned scheduler must discover to beat the baseline.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetriks_tpu.batched.engine import BatchedSimulation
+from kubernetriks_tpu.batched.state import (
+    PHASE_RUNNING,
+    PHASE_SUCCEEDED,
+    PHASE_UNSCHEDULABLE,
+)
+from kubernetriks_tpu.rl.env import rollout
+
+
+def _summary(
+    state, n_windows: int, large_cpu: int | None = None
+) -> Dict[str, float]:
+    """Placement metrics from a terminal ClusterBatchState (per-cluster means).
+
+    With large_cpu set, also reports the placement fraction of "large" pods
+    (req_cpu >= large_cpu) — the class whose fate depends on placement
+    strategy in the bimodal fragmentation scenario."""
+    m = state.metrics
+    C = state.time.shape[0]
+    placements = float(np.asarray(m.scheduling_decisions).sum()) / C
+    succeeded = float(np.asarray(m.pods_succeeded).sum()) / C
+    qt_count = np.asarray(m.queue_time.count, np.float64)
+    qt_total = np.asarray(m.queue_time.total, np.float64)
+    mean_queue_time = float(qt_total.sum() / np.maximum(qt_count.sum(), 1.0))
+    phases = np.asarray(state.pods.phase)
+    unschedulable = float((phases == PHASE_UNSCHEDULABLE).sum()) / C
+    placed_mask = (phases == PHASE_RUNNING) | (phases == PHASE_SUCCEEDED)
+    placed_now = float(placed_mask.sum()) / C
+    out = {
+        "placements_per_cluster": placements,
+        "succeeded_per_cluster": succeeded,
+        "mean_queue_time_s": mean_queue_time,
+        "unschedulable_left_per_cluster": unschedulable,
+        "placed_or_done_per_cluster": placed_now,
+        "windows": float(n_windows),
+    }
+    if large_cpu is not None:
+        req = np.asarray(state.pods.req_cpu)
+        large = (req >= large_cpu) & (phases != 0)  # created large-pod slots
+        n_large = max(int(large.sum()), 1)
+        out["large_pods_per_cluster"] = float(large.sum()) / C
+        out["large_placed_frac"] = float((large & placed_mask).sum()) / n_large
+        out["large_unschedulable_frac"] = float(
+            (large & (phases == PHASE_UNSCHEDULABLE)).sum()
+        ) / n_large
+    return out
+
+
+def eval_policy(
+    sim: BatchedSimulation,
+    policy_apply,
+    params,
+    window_idxs: np.ndarray,
+    rng,
+    greedy: bool = True,
+    large_cpu: int | None = None,
+) -> Dict[str, float]:
+    """Run the policy over the given windows from the sim's CURRENT state
+    (do not reuse a stepped sim — build a fresh one per evaluation)."""
+    final_state, flat = rollout(
+        sim.state,
+        sim.slab,
+        jnp.asarray(window_idxs, jnp.int32),
+        sim.consts,
+        params,
+        rng,
+        policy_apply,
+        sim.max_events_per_window,
+        sim.max_pods_per_cycle,
+        greedy=greedy,
+        conditional_move=sim.conditional_move,
+        autoscale_statics=sim.autoscale_statics,
+        max_ca_pods_per_cycle=sim.max_ca_pods_per_cycle,
+        max_pods_per_scale_down=sim.max_pods_per_scale_down,
+    )
+    out = _summary(final_state, len(window_idxs), large_cpu)
+    valid = np.asarray(flat.valid)
+    obs = np.asarray(flat.obs)
+    parks = valid & ~(obs[..., 1] > 0).any(axis=-1)
+    C = valid.shape[-1]
+    out["park_decisions_per_cluster"] = float(parks.sum()) / C
+    out["mean_reward"] = float(
+        (np.asarray(flat.reward) * valid).sum() / max(valid.sum(), 1)
+    )
+    return out
+
+
+def eval_kube(
+    sim: BatchedSimulation,
+    window_idxs: np.ndarray,
+    large_cpu: int | None = None,
+) -> Dict[str, float]:
+    """Run the KubeScheduler batched path over the same windows (fresh sim)."""
+    sim._dispatch_windows(np.asarray(window_idxs, np.int32))
+    return _summary(sim.state, len(window_idxs), large_cpu)

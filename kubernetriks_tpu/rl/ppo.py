@@ -40,6 +40,10 @@ class PPOConfig(NamedTuple):
     # accumulated gradient equals the monolithic one up to fp reduction
     # order.
     update_microbatch: int = 0
+    # Rollout reward options (see rl/env.py policy_cycle): capacity-weighted
+    # placement rewards and potential-based fragmentation shaping.
+    reward_size_weighted: bool = False
+    shaping_coef: float = 0.0
 
 
 def compute_gae(
@@ -304,6 +308,9 @@ class PPOTrainer:
             autoscale_statics=self.sim.autoscale_statics,
             max_ca_pods_per_cycle=self.sim.max_ca_pods_per_cycle,
             max_pods_per_scale_down=self.sim.max_pods_per_scale_down,
+            reward_size_weighted=self.config.reward_size_weighted,
+            shaping_coef=self.config.shaping_coef,
+            shaping_gamma=self.config.gamma,
         )
         # (W, K, C, ...) -> (W*K, C, ...) decision-ordered sequence.
         flat = jax.tree.map(

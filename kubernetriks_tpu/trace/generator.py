@@ -70,6 +70,7 @@ class PoissonWorkloadTrace(Trace):
         ram: int = 1024**3,
         duration_range: Tuple[float, float] = (10.0, 300.0),
         max_pods: Optional[int] = None,
+        name_prefix: str = "poisson_pod",
     ) -> None:
         self.rate = rate_per_second
         self.horizon = horizon
@@ -78,6 +79,7 @@ class PoissonWorkloadTrace(Trace):
         self.ram = ram
         self.duration_range = duration_range
         self.max_pods = max_pods
+        self.name_prefix = name_prefix
         self._count: Optional[int] = None
 
     def convert_to_simulator_events(self) -> TraceEvents:
@@ -94,7 +96,7 @@ class PoissonWorkloadTrace(Trace):
                 (
                     t,
                     CreatePodRequest(
-                        pod=Pod.new(f"poisson_pod_{i}", self.cpu, self.ram, duration)
+                        pod=Pod.new(f"{self.name_prefix}_{i}", self.cpu, self.ram, duration)
                     ),
                 )
             )
@@ -104,6 +106,27 @@ class PoissonWorkloadTrace(Trace):
 
     def event_count(self) -> int:
         return self._count if self._count is not None else int(self.rate * self.horizon)
+
+
+class MergedWorkloadTrace(Trace):
+    """Time-merge of several workload traces into one event stream — e.g. a
+    bimodal mix of a high-rate small-pod process and a low-rate large-pod
+    process, the contended shape where placement policy (packing vs
+    spreading) decides whether large pods ever fit. Pass distinct
+    name_prefix values to the parts so pod names stay unique."""
+
+    def __init__(self, *parts: Trace) -> None:
+        self.parts = parts
+
+    def convert_to_simulator_events(self) -> TraceEvents:
+        events: TraceEvents = []
+        for part in self.parts:
+            events.extend(part.convert_to_simulator_events())
+        events.sort(key=lambda pair: pair[0])
+        return events
+
+    def event_count(self) -> int:
+        return sum(part.event_count() for part in self.parts)
 
 
 class UniformClusterTrace(Trace):
