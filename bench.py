@@ -7,9 +7,13 @@ Shapes:
 - 1024 x 256-node clusters — the BASELINE.md tracked "1024x256-node vmap
   batch on single TPU" config, kept for round-over-round continuity
   (BENCH_r01/r02 recorded it).
+- composed flagship: 256 clusters x (HPA pod group + cluster autoscaler +
+  sliding pod window + Pallas kernels) — the composed-path tracker (r4);
+  regressions in autoscaler passes / window slides / segmented slots show
+  here even when the pure-scheduler shapes hold.
 - 1250 x 1000-node clusters — the NORTH-STAR per-chip share: >=10k
   concurrent 1000-node clusters on a v5e-8 is 1250 per chip
-  (BASELINE.json). vs_baseline is computed on this line.
+  (BASELINE.json). vs_baseline is computed on this line (the LAST line).
 
 The reference publishes no benchmark numbers (BASELINE.md); vs_baseline is
 measured against the driver-set north star of 1M decisions/s on a v5e-8,
@@ -79,6 +83,110 @@ def run_shape(n_clusters: int, n_nodes: int) -> float:
     return decisions / elapsed
 
 
+def run_composed(n_clusters: int = 256, n_nodes: int = 32) -> float:
+    """The COMPOSED flagship configuration as a tracked line (VERDICT r3
+    item 4): HPA pod groups + cluster autoscaler + sliding pod window +
+    Pallas kernels on a dense cluster batch. Regressions in the composed
+    path (autoscaler passes, window slides, segmented slot layout) show up
+    here even when the pure-scheduler shapes above hold."""
+    from kubernetriks_tpu.batched.engine import build_batched_from_traces
+    from kubernetriks_tpu.config import SimulationConfig
+    from kubernetriks_tpu.trace.generator import (
+        PoissonWorkloadTrace,
+        UniformClusterTrace,
+    )
+    from kubernetriks_tpu.trace.generic import GenericWorkloadTrace
+
+    config = SimulationConfig.from_yaml(
+        """
+sim_name: bench_composed
+seed: 1
+scheduling_cycle_interval: 10.0
+horizontal_pod_autoscaler:
+  enabled: true
+cluster_autoscaler:
+  enabled: true
+  scan_interval: 10.0
+  max_node_count: 32
+  node_groups:
+  - node_template:
+      metadata: {name: ca_node}
+      status: {capacity: {cpu: 64000, ram: 137438953472}}
+"""
+    )
+    cluster = UniformClusterTrace(n_nodes, cpu=64000, ram=128 * 1024**3)
+    # Plain load ~88% of base capacity: the HPA burst pushes past it, so
+    # pods park and the CA provisions (and later retires) template nodes.
+    plain = PoissonWorkloadTrace(
+        rate_per_second=1.5,
+        horizon=1000.0,
+        seed=3,
+        cpu=16000,
+        ram=32 * 1024**3,
+        duration_range=(30.0, 120.0),
+        name_prefix="plain",
+    )
+    group = GenericWorkloadTrace.from_yaml(
+        """
+events:
+- timestamp: 49.5
+  event_type:
+    !CreatePodGroup
+      pod_group:
+        name: grp
+        initial_pod_count: 8
+        max_pod_count: 64
+        pod_template:
+          metadata: {name: grp}
+          spec:
+            resources:
+              requests: {cpu: 8000, ram: 17179869184}
+              limits: {cpu: 8000, ram: 17179869184}
+        target_resources_usage: {cpu_utilization: 0.5}
+        resources_usage_model_config:
+          cpu_config:
+            model_name: pod_group
+            config: |
+              - duration: 300.0
+                total_load: 4.0
+              - duration: 300.0
+                total_load: 24.0
+              - duration: 400.0
+                total_load: 2.0
+"""
+    ).convert_to_simulator_events()
+    workload = sorted(
+        plain.convert_to_simulator_events() + group, key=lambda e: e[0]
+    )
+    sim = build_batched_from_traces(
+        config,
+        cluster.convert_to_simulator_events(),
+        workload,
+        n_clusters=n_clusters,
+        max_pods_per_cycle=64,
+        pod_window=512,
+        use_pallas=True,
+    )
+
+    def decisions_now() -> int:
+        return int(np.asarray(sim.state.metrics.scheduling_decisions).sum())
+
+    sim.step_until_time(190.0)  # warm-up: compile the chunk shapes
+    decisions_before = decisions_now()
+    t0 = time.perf_counter()
+    end = 390.0
+    while end <= 1200.0:
+        sim.step_until_time(end)
+        end += 200.0
+    decisions = decisions_now() - decisions_before
+    elapsed = time.perf_counter() - t0
+    assert sim._pod_base > 0, "composed bench: pod window never slid"
+    c = sim.metrics_summary()["counters"]
+    assert c["total_scaled_up_pods"] > 0, "composed bench: HPA idle"
+    assert c["total_scaled_up_nodes"] > 0, "composed bench: CA idle"
+    return decisions / elapsed
+
+
 def main() -> None:
     continuity = run_shape(1024, 256)
     print(
@@ -89,6 +197,20 @@ def main() -> None:
                 "unit": "decisions/s",
                 "vs_baseline": round(
                     continuity / BASELINE_DECISIONS_PER_SEC_PER_CHIP, 3
+                ),
+            }
+        ),
+        flush=True,
+    )
+    composed = run_composed()
+    print(
+        json.dumps(
+            {
+                "metric": "pod-scheduling decisions/sec (single chip, composed flagship: 256 clusters x HPA+CA+sliding window+Pallas)",
+                "value": round(composed),
+                "unit": "decisions/s",
+                "vs_baseline": round(
+                    composed / BASELINE_DECISIONS_PER_SEC_PER_CHIP, 3
                 ),
             }
         ),

@@ -18,30 +18,45 @@ the load-curve elapsed-time evaluation (float64 on tiny (C, G) shapes — the
 curves cycle over arbitrary-length periods, where float32 elapsed time at
 Alibaba-scale timestamps would blur the curve position).
 
-Documented deviations from the scalar path (replica/node COUNTS match; exact
-identity of scaled-down members may differ):
-- HPA scale-down removes pods in FIFO creation order; the scalar path pops the
-  lexicographically-smallest name, which deviates once indices reach 10+
-  (kube_horizontal_pod_autoscaler.rs:197-205 pops a BTreeSet). Utilization is
-  count-based, so trajectories are unaffected.
-- CA decisions read state at the window boundary instead of at the simulated
-  storage-snapshot time (a sub-window skew), and re-arm on a fixed cadence.
-  The scalar path re-arms with delay 0 when the info round-trip
-  (2 x as_to_ca + processing) exceeds scan_interval
-  (cluster_autoscaler.rs:256-262), i.e. it degrades to back-to-back cycles;
-  the batched path ticks at every due window, which IS the back-to-back
-  cadence at window granularity (a cycle can never run more than once per
-  window on either path, since decisions only change at window boundaries
-  here). With the default delays (round-trip 1.34 s << 10 s scan interval)
-  the branch never triggers, so the fixed cadence is exact; under overrun
-  configs both paths converge to one cycle per window and differ only in
-  sub-window effect timing, which the pending-effect arrays already carry.
+Round-4 exact-CA semantics (the old "one-window visibility shift" and
+"fixed cadence" approximations are retired; tests/test_random_ca_equivalence
+pins sample-for-sample trajectory equality, incl. conditional-move churn):
+- ca_next carries the TRUE cycle fire time: the scalar re-arms
+  scan_interval after the info round-trip returns
+  (cluster_autoscaler.py on_response; reference
+  cluster_autoscaler.rs:256-262 with delay 0 on overrun), so the period is
+  round_trip + scan_interval and cycles DRIFT across windows. Cycle k runs
+  in the window containing its storage-snapshot time s_k = fire + as_to_ca
+  + as_to_ps; effects compose from the fire time.
+- The decision reads the storage's view at s_k exactly: pre-cycle shadows
+  when s_k precedes this window's commit visibility (ca_pass `pre`), and
+  finish-visibility reconstruction on both sides of the window boundary
+  (_ca_scale_down vis_gone/vis_back).
+- Scale-down walks candidates and first-fits re-placements in NODE-NAME
+  order (info.nodes is name-sorted); scale-up bin-packs the cache in
+  POD-NAME order (scale_up_info sorts names) via the static name ranks.
+
+Remaining bounded deviations:
 - Scale-up considers at most K_up cache pods and scale-down at most K_sd pods
   per candidate node per cycle; overflow is deferred to the next cycle
   (scale-up) or conservatively skipped (scale-down).
 - Scaled-up slots are never reused: each group reserves
   slots ~ multiplier x max_count, mirroring the reference's pre-sized
   component pool (src/simulator.rs:212-230) without reclaim.
+- CA-cache name ORDER for HPA replicas whose slot has been ring-reused uses
+  the slot's first occupant's static name rank (pod_name_rank); HPA
+  scale-down victim IDENTITY is exact regardless (pods.hpa_idx stores the
+  live occupant's replica index).
+- Sub-scan-interval CA cadences (scan_interval < the window interval)
+  degrade to one cycle per window.
+
+Round-4 HPA identity semantics: scale-down pops the lexicographically
+SMALLEST replica name from the group's live set exactly like the scalar's
+BTreeSet (kube_horizontal_pod_autoscaler.rs:197-205) — victims are
+scattered, so scale-up activates the first free slots of the reserve in
+slot order and stores each occupant's replica index in pods.hpa_idx
+("{group}_{idx}" naming, idx = total-created counter); hpa_head counts
+total removals, keeping current = tail - head.
 """
 
 from __future__ import annotations
@@ -51,7 +66,6 @@ from typing import NamedTuple, Tuple
 import jax
 import jax.numpy as jnp
 
-from kubernetriks_tpu.batched.step import lexsort_time_i32
 from kubernetriks_tpu.batched.state import (
     ClusterBatchState,
     PHASE_EMPTY,
@@ -69,6 +83,7 @@ from kubernetriks_tpu.batched.timerep import (
     t_add,
     t_inf,
     t_le,
+    t_lt,
     t_min,
     t_where,
     t_zeros,
@@ -114,13 +129,20 @@ class AutoscaleStatics(NamedTuple):
     ca_slot_group: jnp.ndarray  # (C, S) int32 owning group; -1 pad
     # --- scalar time constants (pairs) ---
     hpa_interval: TPair
-    ca_interval: TPair
     hpa_tolerance: jnp.ndarray  # f64 scalar
     ca_threshold: jnp.ndarray  # f64 scalar
     d_hpa_up: TPair  # HPA tick -> scaled-up pod enters scheduler queue
     d_hpa_down: TPair  # HPA tick -> pod removal effect at storage
     d_ca_up: TPair  # CA tick -> scaled-up node schedulable
     d_ca_down: TPair  # CA tick -> node removal effect at node
+    # --- exact-CA cadence/visibility (r4; see ca_pass docstring) ---
+    ca_period: TPair  # true cycle period: round-trip + scan (or just rt)
+    ca_snap: TPair  # cycle fire -> storage snapshot (as_to_ca + as_to_ps)
+    ca_finish_vis: TPair  # node finish -> storage visibility
+    ca_commit_vis: TPair  # scheduler commit (assign/park) -> storage visibility
+    pod_name_rank: jnp.ndarray  # (C, P) int32 lexicographic name rank; BIG = n/a
+    node_name_rank: jnp.ndarray  # (C, N) int32 node-name rank (trace + CA slots)
+    ca_sd_order: jnp.ndarray  # (C, S) CA slot indices in name order
 
 
 class AutoscaleState(NamedTuple):
@@ -269,46 +291,52 @@ def _hpa_pass_body(
 
     act = active & present
     delta = jnp.where(act, desired - current, 0)
-    # Slots are a ring over the group's reserve: head/tail are monotonic
-    # counters and the live window [head, tail) maps onto ring offsets
-    # modulo slot_count, so churn (scale-down then scale-up, repeated by the
-    # cyclic load curves) reuses freed slots instead of exhausting the
-    # reserve. A slot is only reusable once its previous occupant reached a
-    # terminal phase; `up` is clamped to the longest reusable prefix of the
-    # candidate window (counters accumulate incrementally, so resetting a
-    # terminal slot never corrupts metrics).
+    # head/tail are monotonic counters: tail = total replicas ever created
+    # (the scalar's total_created naming counter), head = total removed, so
+    # current = tail - head. Slots are REUSED: name-exact scale-down pops
+    # scattered victims, so churn (repeated by the cyclic load curves) frees
+    # arbitrary slots, and scale-up activates the first `up` reusable slots
+    # of the reserve in slot-offset order; `up` is clamped to the reusable
+    # count so the reserve can never be exceeded.
     count_g = jnp.maximum(st.pg_slot_count, 1)
     up0 = jnp.minimum(jnp.maximum(delta, 0), count_g - current)
     down = jnp.minimum(jnp.maximum(-delta, 0), current)
 
     slot_start_p = st.pg_slot_start[rows, gid_c]  # (C, P); garbage where gid<0
-    off = jnp.arange(P, dtype=jnp.int32)[None, :] - slot_start_p
     in_group = gid >= 0
-    count_p = count_g[rows, gid_c]
-    tail_ring = jnp.mod(auto.hpa_tail, count_g)[rows, gid_c]
-    head_ring = jnp.mod(auto.hpa_head, count_g)[rows, gid_c]
-    rel_tail = jnp.mod(off - tail_ring, count_p)  # candidate rank if < up
-    rel_head = jnp.mod(off - head_ring, count_p)
+    tail_p = auto.hpa_tail[rows, gid_c]
 
+    # Scale-up activates the FIRST `up` reusable slots of the group's
+    # reserve in slot-offset order (name-exact scale-down pops scattered
+    # victims, so free slots are not ring-contiguous); the new occupant's
+    # replica index idx = tail + rank is STORED in pods.hpa_idx — names are
+    # "{group}_{idx}" exactly like the scalar's total_created naming.
     reusable = (
         (pods.phase == PHASE_EMPTY)
         | (pods.phase == PHASE_SUCCEEDED)
         | (pods.phase == PHASE_REMOVED)
         | (pods.phase == PHASE_FAILED)
     )
-    up0_p = up0[rows, gid_c]
-    blocked = in_group & (rel_tail < up0_p) & ~reusable
-    big = jnp.int32(1 << 30)
-    min_blocked = (
-        jnp.full((C, Gp + 1), big, jnp.int32)
+    reuse_in_g = in_group & reusable
+    n_reusable = (
+        jnp.zeros((C, Gp + 1), jnp.int32)
         .at[rows, gid_c]
-        .min(jnp.where(blocked, rel_tail, big))[:, :Gp]
+        .add(reuse_in_g.astype(jnp.int32))[:, :Gp]
     )
-    up = jnp.minimum(up0, min_blocked)
+    up = jnp.minimum(up0, n_reusable)
     up_p = up[rows, gid_c]
     down_p = down[rows, gid_c]
 
-    activate = in_group & (rel_tail < up_p) & reusable
+    # Rank among the group's reusable slots, slot-offset order (exclusive
+    # running count minus its value at the group's first slot).
+    cs_excl = (
+        jnp.cumsum(reuse_in_g, axis=1, dtype=jnp.int32)
+        - reuse_in_g.astype(jnp.int32)
+    )
+    start_cs = cs_excl[rows, jnp.clip(slot_start_p, 0, P - 1)]
+    reuse_rank = cs_excl - start_cs
+    activate = reuse_in_g & (reuse_rank < up_p)
+    # Global activation rank for unique queue sequence numbers.
     rank = jnp.cumsum(activate, axis=1, dtype=jnp.int32) - 1
     n_up = activate.sum(axis=1, dtype=jnp.int32)
     enq = t_add(T, st.d_hpa_up, interval)  # (C,) pair
@@ -320,13 +348,68 @@ def _hpa_pass_body(
     )
     initial_attempt_ts = t_where(activate, enq_p, pods.initial_attempt_ts)
     attempts = jnp.where(activate, 1, pods.attempts)
+    hpa_idx = jnp.where(activate, tail_p + reuse_rank, pods.hpa_idx)
     # Reset state left over from a previous occupant of a reused slot.
     node = jnp.where(activate, -1, pods.node)
     start_time = t_where(activate, t_zeros((C, P)), pods.start_time)
     finish_time = t_where(activate, t_inf((C, P)), pods.finish_time)
 
-    # --- scale down: mark ring offsets [head, head+down) for removal -------
-    deactivate = in_group & (rel_head < down_p) & ~activate
+    # --- scale down: remove the lexicographically-smallest replica names --
+    # The scalar pops the string-smallest name from the group's live set
+    # (kube_horizontal_pod_autoscaler.rs:197-205, a BTreeSet of
+    # "{group}_{idx}" names) — NOT FIFO: "g_10" < "g_2". The occupant index
+    # lives in pods.hpa_idx (stored at activation); its decimal-string
+    # order is a numeric key (left-aligned value, then digit count), and
+    # the `down` smallest keys among live group members are the victims.
+    # hpa_head stays the total-removed counter, so current = tail - head.
+    live = (
+        in_group
+        & (
+            (pods.phase == PHASE_QUEUED)
+            | (pods.phase == PHASE_UNSCHEDULABLE)
+            | (pods.phase == PHASE_RUNNING)
+        )
+        & is_inf(pods.removal_time)
+        & ~activate
+    )
+    occ_idx = jnp.maximum(pods.hpa_idx, 0)
+    # Decimal-string order key for idx < 10^8: left-align to 8 digits,
+    # tie-break shorter-first. Fits int32: key < 10^8 * 16.
+    digits = (
+        1
+        + (occ_idx >= 10).astype(jnp.int32)
+        + (occ_idx >= 100).astype(jnp.int32)
+        + (occ_idx >= 1_000).astype(jnp.int32)
+        + (occ_idx >= 10_000).astype(jnp.int32)
+        + (occ_idx >= 100_000).astype(jnp.int32)
+        + (occ_idx >= 1_000_000).astype(jnp.int32)
+        + (occ_idx >= 10_000_000).astype(jnp.int32)
+    )
+    pow10 = jnp.asarray(
+        [0, 10_000_000, 1_000_000, 100_000, 10_000, 1_000, 100, 10, 1],
+        jnp.int32,
+    )
+    name_key = occ_idx * pow10[digits] * jnp.int32(16) + digits
+    big = jnp.int32(1 << 30)
+    sort_gid = jnp.where(live, gid_c, Gp)
+    sort_key = jnp.where(live, name_key, big)
+    iota_p2 = jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32)[None, :], (C, P))
+    s_gid, _, s_slot = jax.lax.sort(
+        (sort_gid, sort_key, iota_p2), dimension=1, num_keys=2, is_stable=True
+    )
+    # Rank within group = sorted position - group's first sorted position.
+    gseg_start = (
+        jnp.full((C, Gp + 1), P, jnp.int32)
+        .at[rows, s_gid]
+        .min(iota_p2, mode="drop")
+    )
+    rank_sorted = iota_p2 - gseg_start[rows, s_gid]
+    vrank = (
+        jnp.zeros((C, P), jnp.int32)
+        .at[rows, s_slot]
+        .set(rank_sorted)
+    )
+    deactivate = live & (vrank < down_p)
     removal_time = t_where(activate, t_inf((C, P)), pods.removal_time)
     rem = t_add(T, st.d_hpa_down, interval)  # (C,) pair
     rem_p = _broadcast_pair(rem, (C, P))
@@ -356,6 +439,7 @@ def _hpa_pass_body(
             node=node,
             start_time=start_time,
             finish_time=finish_time,
+            hpa_idx=hpa_idx,
         ),
         metrics=metrics,
         queue_seq_counter=state.queue_seq_counter + n_up,
@@ -369,10 +453,13 @@ def _ca_scale_up(
     st: AutoscaleStatics,
     branch: jnp.ndarray,
     K_up: int,
+    phase_v: jnp.ndarray,
+    attempts_v: jnp.ndarray,
 ):
     """Bin-packing scale-up over the unscheduled-pod cache
     (reference: kube_cluster_autoscaler.rs:190-240). Returns
-    (planned (C,S) bool, planned_per_group (C,Gn))."""
+    (planned (C,S) bool, planned_per_group (C,Gn)). phase_v/attempts_v are
+    the storage-visible views supplied by ca_pass."""
     pods = state.pods
     C, P = pods.phase.shape
     S = st.ca_slots.shape[1]
@@ -383,12 +470,24 @@ def _ca_scale_up(
     # The storage unscheduled-pods cache: parked pods plus woken-but-unscheduled
     # pods (attempts>=2 after a wake, reference: persistent_storage.rs cache
     # removal only on assignment).
-    in_cache = (pods.phase == PHASE_UNSCHEDULABLE) | (
-        (pods.phase == PHASE_QUEUED) & (pods.attempts >= 2)
+    in_cache = (phase_v == PHASE_UNSCHEDULABLE) | (
+        (phase_v == PHASE_QUEUED) & (attempts_v >= 2)
     )
-    key_t = t_where(in_cache, pods.queue_ts, t_inf((C, P)))
-    key_seq = jnp.where(in_cache, pods.queue_seq, _BIG_I32)
-    order = lexsort_time_i32(key_t, key_seq)[:, :K_up]
+    # The storage snapshot is NAME-sorted (scale_up_info, reference
+    # persistent_storage.rs:137-146) and bin-packing consumes it in that
+    # order. pod_name_rank carries the static lexicographic ranks (BIG for
+    # slots whose names are runtime-assigned or shifted — those fall back
+    # to queue order after every ranked pod, count-exact).
+    name_key = jnp.where(in_cache, st.pod_name_rank, _BIG_I32)
+    tie_win = jnp.where(in_cache, pods.queue_ts.win, _BIG_I32)
+    tie_off = jnp.where(in_cache, pods.queue_ts.off, jnp.float32(jnp.inf))
+    tie_seq = jnp.where(in_cache, pods.queue_seq, _BIG_I32)
+    iota_p = jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32)[None, :], (C, P))
+    _, _, _, _, order_full = jax.lax.sort(
+        (name_key, tie_win, tie_off, tie_seq, iota_p), dimension=1,
+        num_keys=4, is_stable=True,
+    )
+    order = order_full[:, :K_up]
     cvalid = in_cache[rows, order] & branch[:, None]
     creq_cpu = pods.req_cpu[rows, order]
     creq_ram = pods.req_ram[rows, order]
@@ -465,10 +564,22 @@ def _ca_scale_down(
     st: AutoscaleStatics,
     branch: jnp.ndarray,
     K_sd: int,
+    phase_v: jnp.ndarray,
+    alloc_cpu_v: jnp.ndarray,
+    alloc_ram_v: jnp.ndarray,
+    snap: TPair,
+    interval,
 ):
     """Threshold + simulated-re-placement scale-down
     (reference: kube_cluster_autoscaler.rs:242-290). Returns
-    (removed (C,S) bool, removed_per_group (C,Gn))."""
+    (removed (C,S) bool, removed_per_group (C,Gn)).
+
+    phase_v/alloc_*_v are the storage-visible views from ca_pass; on top of
+    them the finish-visibility correction reconstructs what the storage
+    knows at the snapshot time `snap`: a running pod whose finish became
+    visible by snap counts as gone (its resources freed), and a
+    just-succeeded pod whose finish is NOT yet visible still counts as
+    running (its resources held, and it still needs re-placement)."""
     pods, nodes = state.pods, state.nodes
     C, P = pods.phase.shape
     N = nodes.alive.shape[1]
@@ -479,11 +590,45 @@ def _ca_scale_down(
     col_n = jnp.arange(N, dtype=jnp.int32)[None, :]
     iota_p = jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32)[None, :], (C, P))
 
-    # Group running pods by assigned node ONCE (a per-slot (C, P) mask +
-    # argsort made the pass O(S * P log P) per window — fatal at trace scale);
-    # each node's pods become a contiguous segment of `porder`, located by a
-    # scatter-min first-index and scatter-add count.
-    on_any = pods.phase == PHASE_RUNNING
+    snap_p = _broadcast_pair(snap, (C, P))
+    # Running pod whose finish notification reached storage by snap: gone.
+    vis_gone = (phase_v == PHASE_RUNNING) & t_le(
+        t_add(pods.finish_time, st.ca_finish_vis, interval), snap_p
+    )
+    # Succeeded pod the storage hasn't seen finish yet: still running there.
+    # (finish = start + duration; service pods never reach SUCCEEDED.)
+    succ_finish = t_add(
+        t_add(pods.start_time, pods.duration, interval),
+        st.ca_finish_vis,
+        interval,
+    )
+    vis_back = (phase_v == PHASE_SUCCEEDED) & ~t_le(succ_finish, snap_p)
+    # HPA removals whose storage effect landed by snap: gone (removal_time
+    # is already a storage-effect time, d_hpa_down).
+    vis_removed = (phase_v == PHASE_RUNNING) & t_le(pods.removal_time, snap_p)
+    vis_gone = vis_gone | vis_removed
+
+    # Virtual allocatables as the storage sees them.
+    node_c = jnp.clip(pods.node, 0, N - 1)
+    d_cpu = jnp.where(vis_gone, pods.req_cpu, 0) - jnp.where(
+        vis_back, pods.req_cpu, 0
+    )
+    d_ram = jnp.where(vis_gone, pods.req_ram, 0) - jnp.where(
+        vis_back, pods.req_ram, 0
+    )
+    touched = vis_gone | vis_back
+    alloc_cpu_v = alloc_cpu_v.at[rows, jnp.where(touched, node_c, N)].add(
+        d_cpu, mode="drop"
+    )
+    alloc_ram_v = alloc_ram_v.at[rows, jnp.where(touched, node_c, N)].add(
+        d_ram, mode="drop"
+    )
+
+    # Group storage-visible running pods by assigned node ONCE (a per-slot
+    # (C, P) mask + argsort made the pass O(S * P log P) per window — fatal
+    # at trace scale); each node's pods become a contiguous segment of
+    # `porder`, located by a scatter-min first-index and scatter-add count.
+    on_any = ((phase_v == PHASE_RUNNING) & ~vis_gone) | vis_back
     key_node = jnp.where(on_any, pods.node, jnp.int32(N))
     key_sorted, porder = jax.lax.sort(
         (key_node, iota_p), dimension=1, num_keys=1, is_stable=True
@@ -500,18 +645,15 @@ def _ca_scale_down(
     )
     col_k = jnp.arange(K_sd, dtype=jnp.int32)[None, :]
 
-    # Only CA slots that were ever allocated (cursor-bounded per group) can
-    # hold a node; iterate just those. Before the first scale-up this loop
-    # runs ZERO iterations — the common case on healthy clusters.
-    s_used = jnp.max(
-        jnp.where(auto.ca_cursor > 0, st.ng_ca_start + auto.ca_cursor, 0)
-    ).astype(jnp.int32)
-    s_used = jnp.minimum(s_used, jnp.int32(S))
-
     def outer(carry, s):
         valloc_cpu, valloc_ram = carry
-        # (C,) global node slot of CA slot s.
-        slot = jax.lax.dynamic_index_in_dim(st.ca_slots, s, 1, keepdims=False)
+        # The scalar walks candidates in NODE-NAME order (info.nodes is
+        # name-sorted) and earlier candidates' committed re-placements are
+        # visible to later ones — iterate CA slots through the name-order
+        # permutation, (C,) per cluster.
+        sidx = jax.lax.dynamic_index_in_dim(st.ca_sd_order, s, 1, keepdims=False)
+        # (C,) global node slot of this candidate.
+        slot = st.ca_slots[rows1, sidx]
         slot_ok = (slot >= 0) & branch
         slotc = jnp.clip(slot, 0, N - 1)
         alive_here = nodes.alive[rows1, slotc] & slot_ok
@@ -560,7 +702,11 @@ def _ca_scale_down(
                 & (rram[:, None] <= vram)
             )
             any_fit = fit.any(axis=1)
-            tgt = jax.lax.argmax(fit, 1, jnp.int32)  # first-fit in slot order
+            # First-fit in NODE-NAME order (the scalar iterates the
+            # name-sorted info.nodes list; _node_fits_pod first match).
+            tgt = jax.lax.argmin(
+                jnp.where(fit, st.node_name_rank, _BIG_I32), 1, jnp.int32
+            )
             place = pv & any_fit
             vcpu = vcpu.at[rows1, jnp.where(place, tgt, N)].add(-rcpu, mode="drop")
             vram = vram.at[rows1, jnp.where(place, tgt, N)].add(-rram, mode="drop")
@@ -582,16 +728,27 @@ def _ca_scale_down(
     def loop_body(carry):
         s, valloc_cpu, valloc_ram, removed = carry
         valloc_cpu, valloc_ram, success = outer((valloc_cpu, valloc_ram), s)
-        removed = removed.at[:, s].set(success)
+        sidx = jax.lax.dynamic_index_in_dim(st.ca_sd_order, s, 1, keepdims=False)
+        removed = removed.at[rows1, sidx].max(success)
         return (s + jnp.int32(1), valloc_cpu, valloc_ram, removed)
 
+    # Name-order iteration: allocated slots are not a prefix of the name
+    # permutation, so bound the walk by the LAST alive candidate's position
+    # in permuted order (zero iterations before the first scale-up; dead /
+    # unallocated slots inside the bound no-op through the alive_here gate).
+    slot_perm = jnp.take_along_axis(st.ca_slots, st.ca_sd_order, axis=1)
+    alive_perm = (slot_perm >= 0) & nodes.alive[
+        rows, jnp.clip(slot_perm, 0, N - 1)
+    ]
+    iota_s = jnp.arange(S, dtype=jnp.int32)[None, :]
+    s_bound = jnp.max(jnp.where(alive_perm, iota_s + 1, 0)).astype(jnp.int32)
     _, _, _, removed = jax.lax.while_loop(
-        lambda carry: carry[0] < s_used,
+        lambda carry: carry[0] < s_bound,
         loop_body,
         (
             jnp.int32(0),
-            nodes.alloc_cpu,
-            nodes.alloc_ram,
+            alloc_cpu_v,
+            alloc_ram_v,
             jnp.zeros((C, S), bool),
         ),
     )
@@ -612,18 +769,55 @@ def ca_pass(
     consts: StepConstants,
     K_up: int,
     K_sd: int,
+    pre=None,
 ) -> Tuple[ClusterBatchState, AutoscaleState]:
-    """One masked cluster-autoscaler cycle at window W (scalar equivalent:
+    """One masked cluster-autoscaler cycle (scalar equivalent:
     cluster_autoscaler.py cycle; AUTO info policy: scale up iff the
-    unscheduled cache is non-empty, reference: persistent_storage.rs:381-412)."""
+    unscheduled cache is non-empty, reference: persistent_storage.rs:381-412).
+
+    Exact cadence + snapshot semantics (r4): `auto.ca_next` is the TRUE
+    cycle-fire time c_k (the scalar re-arms scan_interval after the info
+    round-trip returns, so the period drifts relative to windows); the
+    storage snapshot the decision reads lands at s_k = c_k + ca_snap. Cycle
+    k runs in the window W with W*iv <= s_k < (W+1)*iv, whose post-cycle
+    state matches the snapshot up to two sub-window corrections:
+
+    - pre-cycle shadows: if s_k precedes this window's commit-visibility
+      time T + ca_commit_vis, the storage has not yet seen THIS cycle's
+      assignments/parks — `pre` = (phase, attempts, alloc_cpu, alloc_ram)
+      captured before the cycle supplies the storage's view.
+    - finish visibility (handled inside _ca_scale_down): the storage learns
+      a pod finish at F + ca_finish_vis, which can be on either side of s_k
+      relative to the window boundary the arrays reflect.
+    """
     pods, nodes, metrics = state.pods, state.nodes, state.metrics
     C = pods.phase.shape[0]
     interval = jnp.float32(consts.scheduling_interval)
     T = TPair(win=W, off=jnp.zeros((C,), jnp.float32))
+    T_next = TPair(win=W + 1, off=jnp.zeros((C,), jnp.float32))
 
-    due = t_le(auto.ca_next, T)
-    in_cache = (pods.phase == PHASE_UNSCHEDULABLE) | (
-        (pods.phase == PHASE_QUEUED) & (pods.attempts >= 2)
+    c_k = auto.ca_next
+    snap = t_add(c_k, st.ca_snap, interval)
+    due = t_lt(snap, T_next)
+
+    commit_vis = t_add(T, st.ca_commit_vis, interval)
+    early_snap = due & t_lt(snap, commit_vis)
+    if pre is not None:
+        pre_phase, pre_attempts, pre_alloc_cpu, pre_alloc_ram = pre
+        phase_v = jnp.where(early_snap[:, None], pre_phase, pods.phase)
+        attempts_v = jnp.where(early_snap[:, None], pre_attempts, pods.attempts)
+        alloc_cpu_v = jnp.where(
+            early_snap[:, None], pre_alloc_cpu, nodes.alloc_cpu
+        )
+        alloc_ram_v = jnp.where(
+            early_snap[:, None], pre_alloc_ram, nodes.alloc_ram
+        )
+    else:
+        phase_v, attempts_v = pods.phase, pods.attempts
+        alloc_cpu_v, alloc_ram_v = nodes.alloc_cpu, nodes.alloc_ram
+
+    in_cache = (phase_v == PHASE_UNSCHEDULABLE) | (
+        (phase_v == PHASE_QUEUED) & (attempts_v >= 2)
     )
     any_unsched = in_cache.any(axis=1)
     up_branch = due & any_unsched
@@ -637,14 +831,17 @@ def ca_pass(
     Gn = st.ng_ca_start.shape[1]
     planned, planned_per_group = jax.lax.cond(
         up_branch.any(),
-        lambda: _ca_scale_up(state, auto, st, up_branch, K_up),
+        lambda: _ca_scale_up(state, auto, st, up_branch, K_up, phase_v, attempts_v),
         lambda: (jnp.zeros((C, S), bool), jnp.zeros((C, Gn), jnp.int32)),
     )
     removed, removed_per_group = jax.lax.cond(
         # ca_count (live CA nodes) rather than ca_cursor (ever allocated):
         # once everything scaled back down there is nothing to remove.
         down_branch.any() & (auto.ca_count.sum() > 0),
-        lambda: _ca_scale_down(state, auto, st, down_branch, K_sd),
+        lambda: _ca_scale_down(
+            state, auto, st, down_branch, K_sd,
+            phase_v, alloc_cpu_v, alloc_ram_v, snap, interval,
+        ),
         lambda: (jnp.zeros((C, S), bool), jnp.zeros((C, Gn), jnp.int32)),
     )
 
@@ -658,7 +855,7 @@ def ca_pass(
     touch_create = (
         jnp.zeros((C, N), bool).at[rows, tgt_create].set(True, mode="drop")
     )
-    eff_up = _broadcast_pair(t_add(T, st.d_ca_up, interval), (C, N))
+    eff_up = _broadcast_pair(t_add(c_k, st.d_ca_up, interval), (C, N))
     create_time = t_where(
         touch_create, t_min(nodes.create_time, eff_up), nodes.create_time
     )
@@ -666,7 +863,7 @@ def ca_pass(
     touch_remove = (
         jnp.zeros((C, N), bool).at[rows, tgt_remove].set(True, mode="drop")
     )
-    eff_down = _broadcast_pair(t_add(T, st.d_ca_down, interval), (C, N))
+    eff_down = _broadcast_pair(t_add(c_k, st.d_ca_down, interval), (C, N))
     remove_time = t_where(
         touch_remove, t_min(nodes.remove_time, eff_down), nodes.remove_time
     )
@@ -679,7 +876,7 @@ def ca_pass(
         ca_count=auto.ca_count + planned_per_group - removed_per_group,
         ca_cursor=auto.ca_cursor + planned_per_group,
         ca_next=t_where(
-            due, t_add(auto.ca_next, st.ca_interval, interval), auto.ca_next
+            due, t_add(c_k, st.ca_period, interval), c_k
         ),
     )
     state = state._replace(

@@ -61,6 +61,7 @@ def build_autoscale_statics(
     ram_unit: int,
     ca_slot_multiplier: int = 2,
     pod_slot_offset: int = 0,
+    sliding: bool = False,
 ):
     """Host-side compilation of pod-group (HPA) and node-group (CA) tables.
     pod_slot_offset: global-to-device pod-slot shift for the resident
@@ -191,6 +192,67 @@ def build_autoscale_statics(
         return TPair(win=jnp.asarray(w), off=jnp.asarray(o))
 
     f64 = lambda x: jnp.asarray(x, jnp.float64)  # noqa: E731
+
+    # The CA's true cadence drifts: the scalar proxy re-arms scan_interval
+    # AFTER the info round-trip returns (cluster_autoscaler.py on_response;
+    # reference cluster_autoscaler.rs:256-262 — delay 0 on overrun), so the
+    # period is round_trip + scan_interval (or just round_trip on overrun),
+    # NOT window-aligned scan_interval. ca_next carries the true fire time.
+    ca_roundtrip = 2.0 * (
+        delays.as_to_ca_network_delay + delays.as_to_ps_network_delay
+    )
+    ca_period_s = ca_roundtrip + (
+        ca_config.scan_interval if ca_roundtrip <= ca_config.scan_interval else 0.0
+    )
+
+    # Lexicographic name ranks of the trace's pods (device slot coords):
+    # the storage's unscheduled-cache snapshot is name-sorted
+    # (persistent_storage.py scale_up_info; reference
+    # persistent_storage.rs:137-146), and the CA bin-packs in that order.
+    # Ranks are static only while device slots don't shift — under a
+    # sliding pod window they stay BIG and the cache keeps insertion order
+    # (count-exact, identity documented in docs/PARITY.md). HPA ring slots
+    # beyond the trace's initial replicas get fresh names at runtime and
+    # likewise stay BIG.
+    BIG_RANK = np.int32(1 << 30)
+    # Tiled batches repeat a handful of compiled traces across many
+    # clusters; memoize the object-dtype argsorts per unique trace.
+    _rank_cache: dict = {}
+
+    def _ranks_for(names_key, names):
+        got = _rank_cache.get(names_key)
+        if got is None:
+            order = np.argsort(np.asarray(names, dtype=object), kind="stable")
+            got = np.empty(len(names), np.int32)
+            got[order] = np.arange(len(names), dtype=np.int32)
+            _rank_cache[names_key] = got
+        return got
+
+    pod_name_rank = np.full((C, n_pods), BIG_RANK, np.int32)
+    if not sliding and pod_slot_offset == 0:
+        for ci, trace in enumerate(compiled_traces):
+            ranks = _ranks_for(("pod", id(trace)), trace.pod_names[:n_pods])
+            pod_name_rank[ci, : len(ranks)] = ranks
+
+    # Node-name ranks over trace nodes + CA slots (slot names are static:
+    # slot k of group g is always "{g}_{k+1}", matching the scalar's
+    # total_allocated naming). The CA scale-down walks candidates and
+    # first-fits re-placements in NAME order (info.nodes is name-sorted,
+    # persistent_storage.sorted_nodes) — slot order differs once a name set
+    # straddles a digit boundary ("g_10" < "g_2") or trace names interleave.
+    N_total = n_trace_nodes + S
+    node_name_rank = np.full((C, N_total), BIG_RANK, np.int32)
+    ca_sd_order = np.tile(np.arange(S, dtype=np.int32), (C, 1))
+    for ci, trace in enumerate(compiled_traces):
+        names = list(trace.node_names[:n_trace_nodes]) + extra_node_names
+        ranks = _ranks_for(("node", id(trace)), names)
+        node_name_rank[ci, : len(ranks)] = ranks
+        if S:
+            ca_ranks = node_name_rank[ci, n_trace_nodes:]
+            ca_sd_order[ci] = np.argsort(ca_ranks, kind="stable").astype(
+                np.int32
+            )
+
     statics = AutoscaleStatics(
         pg_slot_start=jnp.asarray(pg_slot_start),
         pg_slot_count=jnp.asarray(pg_slot_count),
@@ -220,7 +282,6 @@ def build_autoscale_statics(
         ca_slots=jnp.asarray(ca_slots),
         ca_slot_group=jnp.asarray(ca_slot_group),
         hpa_interval=pair(config.horizontal_pod_autoscaler.scan_interval),
-        ca_interval=pair(ca_config.scan_interval),
         hpa_tolerance=f64(hpa_tol),
         ca_threshold=f64(ca_thresh),
         d_hpa_up=pair(delays.as_to_ca_network_delay + d_pod_enqueue),
@@ -237,6 +298,19 @@ def build_autoscale_statics(
             + 4.0 * delays.as_to_ps_network_delay
             + delays.as_to_node_network_delay
         ),
+        ca_period=pair(ca_period_s),
+        ca_snap=pair(
+            delays.as_to_ca_network_delay + delays.as_to_ps_network_delay
+        ),
+        ca_finish_vis=pair(
+            delays.as_to_node_network_delay + delays.as_to_ps_network_delay
+        ),
+        ca_commit_vis=pair(
+            delays.sched_to_as_network_delay + delays.as_to_ps_network_delay
+        ),
+        pod_name_rank=jnp.asarray(pod_name_rank),
+        node_name_rank=jnp.asarray(node_name_rank),
+        ca_sd_order=jnp.asarray(ca_sd_order),
     )
     return statics, extra_cap_cpu, extra_cap_ram, extra_node_names
 
@@ -371,6 +445,26 @@ class BatchedSimulation:
                 "req_ram": pod_req_ram[:, :T],
                 "duration": pod_duration[:, :T],
             }
+            # Lexicographic pod-name ranks over the WHOLE trace (global pod
+            # coords): the window's device slice is refreshed on every slide
+            # (statics are traced arguments, so no recompile), keeping the
+            # name-ordered semantics (CA cache order, reschedule queue
+            # order) identical between sliding and full-resident runs.
+            BIG_RANK = np.int32(1 << 30)
+            self._pod_name_rank_full = np.full((C, P_full), BIG_RANK, np.int32)
+            _rank_cache: dict = {}
+            for ci, trace in enumerate(compiled_traces):
+                ranks = _rank_cache.get(id(trace))
+                if ranks is None:
+                    order_np = np.argsort(
+                        np.asarray(trace.pod_names, dtype=object), kind="stable"
+                    )
+                    ranks = np.empty(len(trace.pod_names), np.int32)
+                    ranks[order_np] = np.arange(
+                        len(trace.pod_names), dtype=np.int32
+                    )
+                    _rank_cache[id(trace)] = ranks
+                self._pod_name_rank_full[ci, : len(ranks)] = ranks
             # Device pod arrays: [window over plain slots | resident rings].
             pod_req_cpu = np.concatenate(
                 [pod_req_cpu[:, :pod_window], pod_req_cpu[:, T:]], axis=1
@@ -400,8 +494,12 @@ class BatchedSimulation:
                 ram_unit=ram_unit,
                 ca_slot_multiplier=ca_slot_multiplier,
                 pod_slot_offset=self._resident_shift,
+                sliding=pod_window is not None,
             )
             self.autoscale_statics = statics
+            # Sliding runs: install the initial windowed name-rank slice
+            # (build_autoscale_statics leaves ranks BIG under sliding).
+            self._refresh_name_ranks()
             if ca_on and extra_names:
                 node_cap_cpu = np.concatenate(
                     [node_cap_cpu, np.tile(extra_cpu, (C, 1))], axis=1
@@ -499,6 +597,28 @@ class BatchedSimulation:
 
                 auto = auto._replace(hpa_next=t_inf((C,)))
             self.state = self.state._replace(auto=auto)
+            # Seed the replica indices of the trace's INITIAL group replicas
+            # (created by slab events, which don't carry hpa_idx): the i-th
+            # reserved slot's first occupant is "{group}_{i}".
+            gid_np = np.asarray(self.autoscale_statics.pod_group_id)
+            if (gid_np >= 0).any():
+                start_np = np.asarray(self.autoscale_statics.pg_slot_start)
+                init_np = np.asarray(self.autoscale_statics.pg_initial)
+                P_dev = gid_np.shape[1]
+                gidc = np.clip(gid_np, 0, None)
+                off_np = (
+                    np.arange(P_dev, dtype=np.int32)[None, :]
+                    - np.take_along_axis(start_np, gidc, axis=1)
+                )
+                seeded = (gid_np >= 0) & (
+                    off_np < np.take_along_axis(init_np, gidc, axis=1)
+                )
+                hpa_idx0 = np.where(seeded, off_np, -1).astype(np.int32)
+                self.state = self.state._replace(
+                    pods=self.state.pods._replace(
+                        hpa_idx=jnp.asarray(hpa_idx0)
+                    )
+                )
         ev_win, ev_off = from_f64_np(ev_time, config.scheduling_cycle_interval)
         self.slab = TraceSlab.build(ev_win, ev_off, ev_kind, ev_slot)
         self._ev_time_np = ev_time  # host copy (f64) for completion checks
@@ -712,6 +832,30 @@ class BatchedSimulation:
             return 1 << 30
         return int(self._pod_create_win[:, L].min())
 
+    def _refresh_name_ranks(self) -> None:
+        """Re-slice the windowed pod-name ranks into the autoscale statics
+        after a window slide (device layout: [window over plain slots |
+        resident rings])."""
+        if self.autoscale_statics is None or self._full_pods is None:
+            return
+        W = self.pod_window
+        T = int(self.consts.trace_pod_bound)
+        full = self._pod_name_rank_full
+        C = full.shape[0]
+        BIG_RANK = np.int32(1 << 30)
+        seg = full[:, self._pod_base : self._pod_base + W]
+        if seg.shape[1] < W:
+            seg = np.concatenate(
+                [seg, np.full((C, W - seg.shape[1]), BIG_RANK, np.int32)],
+                axis=1,
+            )
+        dev = np.concatenate([seg, full[:, T:]], axis=1)
+        old = self.autoscale_statics.pod_name_rank
+        new = jax.device_put(jnp.asarray(dev), old.sharding)
+        self.autoscale_statics = self.autoscale_statics._replace(
+            pod_name_rank=new
+        )
+
     def _advance_pod_window(self) -> bool:
         """Shift the device pod window past the leading run of terminal pods
         (uniform shift across clusters), refilling the tail from the host
@@ -798,6 +942,7 @@ class BatchedSimulation:
             pods=new_pods, pod_base=self.state.pod_base + jnp.int32(s)
         )
         self._pod_base += s
+        self._refresh_name_ranks()
         return True
 
     def _step_idxs(self, idxs: np.ndarray) -> None:
@@ -972,6 +1117,27 @@ class BatchedSimulation:
         assert auto is not None, "autoscaling is not enabled"
         return to_host(auto.ca_count)[cluster]
 
+    def node_count_at(self, t: float, cluster: int = 0) -> int:
+        """Alive node count at absolute time t, resolving pending
+        create/remove effects with effect time <= t. The step applies an
+        effect when it next runs a window PAST the effect's time — an
+        implementation detail of the lazy window application — so a faithful
+        'how many nodes exist at t' read must resolve the scheduled effects
+        the state already carries (the batched equivalent of the scalar
+        api_server.node_count() sampled mid-window)."""
+        interval = self.config.scheduling_cycle_interval
+        win = int(t // interval)
+        off = t - win * interval
+        nodes = self.state.nodes
+        alive = to_host(nodes.alive)[cluster]
+        cw = to_host(nodes.create_time.win)[cluster]
+        co = to_host(nodes.create_time.off)[cluster]
+        rw = to_host(nodes.remove_time.win)[cluster]
+        ro = to_host(nodes.remove_time.off)[cluster]
+        due_create = (cw < win) | ((cw == win) & (co <= off))
+        due_remove = (rw < win) | ((rw == win) & (ro <= off))
+        return int(((alive | due_create) & ~due_remove).sum())
+
     # --- checkpoint / resume ------------------------------------------------
     # The whole simulation state is one pytree of arrays, so checkpointing is
     # a direct orbax save (SURVEY §5.4: absent in the reference — runs are
@@ -1016,6 +1182,7 @@ class BatchedSimulation:
         self.state = restored["state"]
         self.next_window_idx = int(restored["next_window_idx"])
         self._pod_base = int(np.asarray(self.state.pod_base)[0])
+        self._refresh_name_ranks()
         sidecar = os.path.abspath(path) + ".gauges.npz"
         if os.path.exists(sidecar):
             data = np.load(sidecar)

@@ -97,6 +97,11 @@ class PodArrays(NamedTuple):
     start_time: TPair
     finish_time: TPair  # +inf = no pending finish
     removal_time: TPair  # pending HPA scale-down effect; +inf = none
+    # HPA replica index of the slot's CURRENT occupant ("{group}_{idx}"
+    # names; -1 = not an HPA replica). Set at activation; the scale-down
+    # victim selection pops the lexicographically-smallest name from it
+    # (kube_horizontal_pod_autoscaler.rs:197-205).
+    hpa_idx: jnp.ndarray  # int32
 
 
 class EstArrays(NamedTuple):
@@ -159,15 +164,9 @@ class ClusterBatchState(NamedTuple):
     pod_base: jnp.ndarray  # (C,) int32
     last_flush_win: jnp.ndarray  # (C,) int32 last unschedulable-leftover flush window
     requeue_signal: jnp.ndarray  # (C,) bool: node-add/pod-finish since last cycle
-    # Conditional-move accounting (enable_unscheduled_pods_conditional_move,
-    # reference: src/core/scheduler/scheduler.rs:391-409,366-380): per-window
-    # budgets consumed by the resource-aware wake scans in prepare_cycle.
-    wake_node_signal: jnp.ndarray  # (C,) bool: a node was added since last cycle
-    wake_node_cpu: jnp.ndarray  # (C,) int64 summed allocatable of new nodes
-    wake_node_ram: jnp.ndarray  # (C,) int64
-    wake_freed_signal: jnp.ndarray  # (C,) bool: pod finish/removal freed resources
-    wake_freed_cpu: jnp.ndarray  # (C,) int64 summed freed requests
-    wake_freed_ram: jnp.ndarray  # (C,) int64
+    # (Conditional-move wake budgets are NOT state: they are intra-window
+    # WakeEvents threaded from event application to the same window's
+    # prepare_cycle — step._conditional_wake_exact.)
     nodes: NodeArrays
     pods: PodArrays
     metrics: MetricArrays
@@ -279,6 +278,7 @@ def fresh_pod_arrays(
         start_time=t_zeros((C, P)),
         finish_time=t_inf((C, P)),
         removal_time=t_inf((C, P)),
+        hpa_idx=jnp.full((C, P), -1, jnp.int32),
     )
 
 
@@ -329,12 +329,6 @@ def init_state(
         pod_base=jnp.zeros((C,), jnp.int32),
         last_flush_win=jnp.zeros((C,), jnp.int32),
         requeue_signal=jnp.zeros((C,), bool),
-        wake_node_signal=jnp.zeros((C,), bool),
-        wake_node_cpu=jnp.zeros((C,), jnp.int64),
-        wake_node_ram=jnp.zeros((C,), jnp.int64),
-        wake_freed_signal=jnp.zeros((C,), bool),
-        wake_freed_cpu=jnp.zeros((C,), jnp.int64),
-        wake_freed_ram=jnp.zeros((C,), jnp.int64),
         nodes=nodes,
         pods=pods,
         metrics=metrics,

@@ -139,6 +139,8 @@ def _apply_window_events(
     pallas_mesh=None,
     pallas_axis: str = "clusters",
     use_pallas_select: bool = False,
+    node_name_rank=None,
+    pod_name_rank=None,
 ) -> ClusterBatchState:
     """Apply every trace event with effect time STRICTLY before the cycle time
     W * interval, and resolve all pod finishes due in the window.
@@ -196,8 +198,12 @@ def _apply_window_events(
         return jnp.any(chunk_due(carry[0]))
 
     def chunk_body(carry):
-        (cursor, created, node_removal, pod_create, pod_create_seq,
-         pod_removal, n_creates) = carry
+        if conditional_move:
+            (cursor, created, node_removal, pod_create, pod_create_seq,
+             pod_removal, n_creates, node_create_rel) = carry
+        else:
+            (cursor, created, node_removal, pod_create, pod_create_seq,
+             pod_removal, n_creates) = carry
         offs = cursor[:, None] + jnp.arange(E, dtype=jnp.int32)[None, :]
         offs_c = jnp.clip(offs, 0, E_total - 1)
         # One packed gather instead of four (gather cost is per-index on TPU).
@@ -267,7 +273,7 @@ def _apply_window_events(
             pod_removal = pod_removal.at[rows, drop_slot(is_rp, P)].min(
                 jnp.where(is_rp, ev_rel, f32inf), mode="drop"
             )
-        return (
+        out = (
             cursor + valid.sum(axis=1, dtype=jnp.int32),
             created,
             node_removal,
@@ -276,25 +282,45 @@ def _apply_window_events(
             pod_removal,
             n_creates + is_cp.sum(axis=1, dtype=jnp.int32),
         )
+        if conditional_move:
+            # Node-add times feed the per-event wake scans (scalar
+            # on_add_node_to_cache runs once PER node at its visibility
+            # time; _conditional_wake_exact). Only built on the
+            # conditional-move path — an extra (C, N) scatter otherwise.
+            node_create_rel = node_create_rel.at[
+                rows, jnp.where(is_cn, ev_s, N)
+            ].min(jnp.where(is_cn, ev_rel, f32inf), mode="drop")
+            out = out + (node_create_rel,)
+        return out
 
-    (event_cursor, created, node_removal, pod_create, pod_create_seq,
-     pod_removal, n_creates) = jax.lax.while_loop(
-        chunk_cond,
-        chunk_body,
-        (
-            state.event_cursor,
-            jnp.zeros((C, N), bool),
-            jnp.full((C, N), INF, jnp.float32),
-            jnp.full((C, P), INF, jnp.float32),
-            jnp.zeros((C, P), jnp.int32),
-            jnp.full((C, P), INF, jnp.float32),
-            jnp.zeros((C,), jnp.int32),
-        ),
+    carry0 = (
+        state.event_cursor,
+        jnp.zeros((C, N), bool),
+        jnp.full((C, N), INF, jnp.float32),
+        jnp.full((C, P), INF, jnp.float32),
+        jnp.zeros((C, P), jnp.int32),
+        jnp.full((C, P), INF, jnp.float32),
+        jnp.zeros((C,), jnp.int32),
     )
+    if conditional_move:
+        carry0 = carry0 + (jnp.full((C, N), INF, jnp.float32),)
+    carry_out = jax.lax.while_loop(chunk_cond, chunk_body, carry0)
+    (event_cursor, created, node_removal, pod_create, pod_create_seq,
+     pod_removal, n_creates) = carry_out[:7]
+    node_create_rel = carry_out[7] if conditional_move else None
 
     # Pending autoscaler creations due this window (CA scale-up effects).
     pend_create = (nodes.create_time.win < W[:, None]) & ~nodes.alive
     created = created | pend_create
+    if conditional_move:
+        node_create_rel = jnp.minimum(
+            node_create_rel,
+            jnp.where(
+                pend_create,
+                _rel_seconds(nodes.create_time, base[:, None], interval),
+                f32inf,
+            ),
+        )
     node_create_time = t_where(pend_create, t_inf((C, N)), nodes.create_time)
     # Pending autoscaler removals due this window (CA scale-down effects).
     pend_rm_due = nodes.remove_time.win < W[:, None]
@@ -437,9 +463,44 @@ def _apply_window_events(
     phase = jnp.where(finishes, PHASE_SUCCEEDED, phase)
     finish_time = t_where(finishes, t_inf((C, P)), pods.finish_time)
 
-    # Reschedule pods of removed nodes (reference: scheduler.rs:336-364; slot
-    # order stands in for the scalar sorted-name order).
-    resched_rank = jnp.cumsum(rescheds, axis=1, dtype=jnp.int32) - 1
+    # Reschedule pods of removed nodes (reference: scheduler.rs:336-364).
+    # Queue order among same-window rescheds must match the scalar's event
+    # order: removal visibility time first, then — for same-time removals —
+    # the order the removal requests were EMITTED (the CA walks scale-down
+    # candidates in node-name order), then sorted pod names within a node.
+    # Name ranks come from the autoscale statics when available; slot order
+    # is the fallback (equal keys keep slot order under the stable sort).
+    def _resched_rank_exact():
+        big = jnp.int32(1 << 30)
+        node_c2 = jnp.clip(pods.node, 0, N - 1)
+        if node_name_rank is not None:
+            nr = node_name_rank[jnp.arange(C, dtype=jnp.int32)[:, None], node_c2]
+        else:
+            nr = node_c2
+        k1 = jnp.where(rescheds, pod_node_removal, f32inf)
+        k2 = jnp.where(rescheds, nr, big)
+        if pod_name_rank is not None:
+            k3 = jnp.where(rescheds, pod_name_rank, big)
+        else:
+            k3 = jnp.zeros((C, P), jnp.int32)
+        iota_pp = jnp.broadcast_to(
+            jnp.arange(P, dtype=jnp.int32)[None, :], (C, P)
+        )
+        _, _, _, inv = jax.lax.sort(
+            (k1, k2, k3, iota_pp), dimension=1, num_keys=3, is_stable=True
+        )
+        rank = (
+            jnp.zeros((C, P), jnp.int32)
+            .at[jnp.arange(C, dtype=jnp.int32)[:, None], inv]
+            .set(iota_pp)
+        )
+        return rank
+
+    resched_rank = jax.lax.cond(
+        rescheds.any(),
+        _resched_rank_exact,
+        lambda: jnp.cumsum(rescheds, axis=1, dtype=jnp.int32) - 1,
+    )
     resched_ts = t_norm(
         jnp.broadcast_to(base[:, None], (C, P)),
         jnp.where(rescheds, pod_node_removal, 0.0)
@@ -485,28 +546,27 @@ def _apply_window_events(
     any_created_node = created.any(axis=1)
     any_freed = (n_done > 0) | (n_removed_running > 0)
 
-    # Conditional-move budgets (consumed by prepare_cycle's wake scans when
-    # enable_unscheduled_pods_conditional_move is on; reference pools budgets
-    # per event, the batched path pools them per window): a new node
-    # contributes its full allocatable (= capacity at creation,
-    # scheduler.rs:393), a finished/removed pod its freed requests
-    # (scheduler.rs:366-380). int64: pooled sums over N/P slots can exceed
-    # int32 (e.g. thousands of 128 GiB nodes in one window) and the scalar
-    # oracle's budgets are unbounded Python ints. Only computed when the
-    # feature is on — the i64 reductions are emulated on TPU and nothing else
-    # reads these fields.
+    # Conditional-move wake events (consumed by prepare_cycle's per-event
+    # wake scans when enable_unscheduled_pods_conditional_move is on;
+    # _conditional_wake_exact replays the scalar's one-scan-per-event
+    # semantics): a new node contributes its full allocatable (= capacity at
+    # creation, scheduler.rs:393), a finished/removed pod its freed requests
+    # (scheduler.rs:366-380). Only built on the conditional-move path.
     if conditional_move:
-        wake_node_cpu = (created * nodes.cap_cpu.astype(jnp.int64)).sum(axis=1)
-        wake_node_ram = (created * nodes.cap_ram.astype(jnp.int64)).sum(axis=1)
-        wake_freed_cpu = jnp.where(freed, pods.req_cpu.astype(jnp.int64), 0).sum(axis=1)
-        wake_freed_ram = jnp.where(freed, pods.req_ram.astype(jnp.int64), 0).sum(axis=1)
+        wake_events = WakeEvents(
+            node_mask=created,
+            node_rel=jnp.where(created, node_create_rel, f32inf),
+            freed_mask=freed,
+            freed_rel=jnp.where(
+                finishes,
+                _rel_seconds(pods.finish_time, base[:, None], interval),
+                jnp.where(removed_running, pod_removal, f32inf),
+            ),
+        )
     else:
-        wake_node_cpu = jnp.zeros_like(state.wake_node_cpu)
-        wake_node_ram = jnp.zeros_like(state.wake_node_ram)
-        wake_freed_cpu = jnp.zeros_like(state.wake_freed_cpu)
-        wake_freed_ram = jnp.zeros_like(state.wake_freed_ram)
+        wake_events = None
 
-    return state._replace(
+    new_state = state._replace(
         nodes=nodes._replace(
             alive=alive,
             alloc_cpu=alloc_cpu,
@@ -530,38 +590,53 @@ def _apply_window_events(
         # Events of interest wake the unschedulable queue (flush-all policy,
         # reference: scheduler.rs:391-410,435-440,445-473).
         requeue_signal=state.requeue_signal | any_created_node | any_freed,
-        wake_node_signal=state.wake_node_signal | any_created_node,
-        wake_node_cpu=state.wake_node_cpu + wake_node_cpu,
-        wake_node_ram=state.wake_node_ram + wake_node_ram,
-        wake_freed_signal=state.wake_freed_signal | any_freed,
-        wake_freed_cpu=state.wake_freed_cpu + wake_freed_cpu,
-        wake_freed_ram=state.wake_freed_ram + wake_freed_ram,
         time=jnp.maximum(state.time, W),
     )
+    return new_state, wake_events
 
 
-def _conditional_wake(
-    state: ClusterBatchState, pods, stale: jnp.ndarray
+class WakeEvents(NamedTuple):
+    """This window's conditional-move wake events (intra-window lifetime:
+    built by _apply_window_events, consumed by the same window's
+    prepare_cycle). Rel times are float32 seconds from the window base."""
+
+    node_mask: jnp.ndarray  # (C, N) nodes created this window
+    node_rel: jnp.ndarray  # (C, N) creation effect rel seconds; +inf pad
+    freed_mask: jnp.ndarray  # (C, P) pods freed (finish/removal)
+    freed_rel: jnp.ndarray  # (C, P) free effect rel seconds; +inf pad
+
+
+def _conditional_wake_exact(
+    state: ClusterBatchState,
+    pods,
+    stale: jnp.ndarray,
+    wake: "WakeEvents",
 ) -> jnp.ndarray:
     """Resource-aware unschedulable wakes for
-    enable_unscheduled_pods_conditional_move, replicating the reference's two
-    greedy budget scans over the unschedulable queue in (insert_ts, name)
-    order — here (queue_ts, queue_seq) order:
+    enable_unscheduled_pods_conditional_move, replicating the reference's
+    one-greedy-scan-PER-EVENT semantics exactly: each node-add / freed event
+    runs its own budget scan over the unschedulable queue in (insert_ts,
+    name) order — here (queue_ts, queue_seq; park timestamps are distinct
+    within a cycle, so seq ties cannot occur) — at the event's effect time,
+    with pods moved by earlier events absent from later scans and pods
+    parked after an event's time invisible to it:
 
-    - Node added (reference: src/core/scheduler/scheduler.rs:391-409): a pod
-      that FITS the new node's allocatable consumes the budget and STAYS
-      parked; a pod that does not fit moves to the active queue. (That
-      inverted sense is the reference's actual behavior; preserved as-is.)
+    - Node added (reference: src/core/scheduler/scheduler.rs:391-409):
+      budget = the new node's allocatable (= capacity); a pod that FITS
+      consumes the budget and STAYS parked; a pod that does not fit moves to
+      the active queue. (That inverted sense is the reference's actual
+      behavior; preserved as-is.)
     - Resources freed by pod finish/removal (scheduler.rs:366-380,435-439,
-      462-468): greedy first-fit against the freed budget — a pod that fits
-      consumes the budget and MOVES.
+      462-468): budget = that pod's freed requests; greedy first-fit — a pod
+      that fits consumes the budget and MOVES.
 
-    Deviation (documented): the scalar path runs one scan per event at its
-    effect time; the batched path pools the budgets of all same-window events
-    into one scan pass of each kind.
+    Cost: one P-length scan per wake event, gated to windows that have
+    events and parked pods (rare outside contended conditional-move runs).
     """
     C, P = pods.phase.shape
+    N = wake.node_mask.shape[1]
     rows = jnp.arange(C, dtype=jnp.int32)[:, None]
+    rows1 = jnp.arange(C, dtype=jnp.int32)
     unsched = (pods.phase == PHASE_UNSCHEDULABLE) & ~stale
 
     u_t = t_where(unsched, pods.queue_ts, t_inf((C, P)))
@@ -570,35 +645,65 @@ def _conditional_wake(
     o_valid = unsched[rows, order]
     o_req_cpu = pods.req_cpu[rows, order]
     o_req_ram = pods.req_ram[rows, order]
+    # (No park-time-vs-event-time gate: every parked pod present at this
+    # window's prepare was parked microseconds after a PREVIOUS window
+    # boundary, so it predates all of this window's events except
+    # sub-microsecond pathologies.)
 
-    def scan_body(carry, xs):
-        node_cpu, node_ram, freed_cpu, freed_ram = carry
-        valid, req_cpu, req_ram = xs
-        # Scan 1: new-node budget — fits => consume + stay, else move.
-        node_scan = valid & state.wake_node_signal
-        fits_node = node_scan & (req_cpu <= node_cpu) & (req_ram <= node_ram)
-        node_cpu = node_cpu - jnp.where(fits_node, req_cpu, 0)
-        node_ram = node_ram - jnp.where(fits_node, req_ram, 0)
-        move_no_fit = node_scan & ~fits_node
-        # Scan 2: freed budget — fits => consume + move.
-        freed_scan = valid & state.wake_freed_signal
-        fits_freed = freed_scan & (req_cpu <= freed_cpu) & (req_ram <= freed_ram)
-        freed_cpu = freed_cpu - jnp.where(fits_freed, req_cpu, 0)
-        freed_ram = freed_ram - jnp.where(fits_freed, req_ram, 0)
-        return (node_cpu, node_ram, freed_cpu, freed_ram), move_no_fit | fits_freed
+    # Combined event axis (N node slots + P pod slots), sorted by effect
+    # time (stable; same-time events keep node-before-freed slab order —
+    # same-timestamp interleavings are FIFO in the scalar queue and the
+    # trace compiler emits creates before the finishes they enable).
+    f32inf = jnp.float32(INF)
+    ev_rel = jnp.concatenate([wake.node_rel, wake.freed_rel], axis=1)
+    ev_valid = jnp.concatenate([wake.node_mask, wake.freed_mask], axis=1)
+    ev_is_node = jnp.concatenate(
+        [jnp.ones((C, N), bool), jnp.zeros((C, P), bool)], axis=1
+    )
+    ev_cpu = jnp.concatenate(
+        [state.nodes.cap_cpu, pods.req_cpu], axis=1
+    )
+    ev_ram = jnp.concatenate(
+        [state.nodes.cap_ram, pods.req_ram], axis=1
+    )
+    key = jnp.where(ev_valid, ev_rel, f32inf)
+    _, s_valid, s_is_node, s_cpu, s_ram = jax.lax.sort(
+        (key, ev_valid, ev_is_node, ev_cpu, ev_ram),
+        dimension=1, num_keys=1, is_stable=True,
+    )
+    n_ev = jnp.max(ev_valid.sum(axis=1, dtype=jnp.int32))
 
-    _, move_sorted = jax.lax.scan(
-        scan_body,
-        (
-            state.wake_node_cpu,
-            state.wake_node_ram,
-            state.wake_freed_cpu,
-            state.wake_freed_ram,
-        ),
-        (o_valid.T, o_req_cpu.T, o_req_ram.T),
+    def ev_body(carry):
+        e, moved = carry
+        v_valid = jax.lax.dynamic_index_in_dim(s_valid, e, 1, keepdims=False)
+        v_is_node = jax.lax.dynamic_index_in_dim(s_is_node, e, 1, keepdims=False)
+        v_cpu = jax.lax.dynamic_index_in_dim(s_cpu, e, 1, keepdims=False)
+        v_ram = jax.lax.dynamic_index_in_dim(s_ram, e, 1, keepdims=False)
+
+        def pod_scan(c2, xs):
+            bud_cpu, bud_ram = c2
+            p_valid, rcpu, rram, m = xs
+            considered = p_valid & ~m & v_valid
+            fits = considered & (rcpu <= bud_cpu) & (rram <= bud_ram)
+            bud_cpu = bud_cpu - jnp.where(fits, rcpu, 0)
+            bud_ram = bud_ram - jnp.where(fits, rram, 0)
+            mv = jnp.where(v_is_node, considered & ~fits, fits)
+            return (bud_cpu, bud_ram), mv
+
+        (_, _), mv_sorted = jax.lax.scan(
+            pod_scan,
+            (v_cpu, v_ram),
+            (o_valid.T, o_req_cpu.T, o_req_ram.T, moved.T),
+        )
+        return e + jnp.int32(1), moved | mv_sorted.T
+
+    _, moved_sorted = jax.lax.while_loop(
+        lambda carry: carry[0] < n_ev,
+        ev_body,
+        (jnp.int32(0), jnp.zeros((C, P), bool)),
     )
     # Scatter sorted-order decisions back to slot positions.
-    return jnp.zeros((C, P), bool).at[rows, order].set(move_sorted.T)
+    return jnp.zeros((C, P), bool).at[rows, order].set(moved_sorted)
 
 
 class CycleCandidates(NamedTuple):
@@ -656,6 +761,7 @@ def prepare_queue(
     W: jnp.ndarray,
     consts: StepConstants,
     conditional_move: bool = False,
+    wake=None,
 ):
     """Queue preamble shared by every cycle path (sorted-scan, Pallas
     candidate kernel, Pallas selection kernel, RL): unschedulable wake/flush
@@ -688,12 +794,15 @@ def prepare_queue(
             & flush_now[:, None]
         )
         if conditional_move:
-            wake = _conditional_wake(state, pods, stale)
+            assert wake is not None, (
+                "conditional_move prepare needs this window's WakeEvents"
+            )
+            moves = _conditional_wake_exact(state, pods, stale, wake)
         else:
-            wake = state.requeue_signal[:, None] & (
+            moves = state.requeue_signal[:, None] & (
                 pods.phase == PHASE_UNSCHEDULABLE
             )
-        to_move = stale | wake
+        to_move = stale | moves
         return (
             jnp.where(to_move, PHASE_QUEUED, pods.phase),
             pods.attempts + to_move.astype(jnp.int32),
@@ -749,13 +858,14 @@ def prepare_cycle(
     consts: StepConstants,
     K: int,
     conditional_move: bool = False,
+    wake=None,
 ) -> CycleCandidates:
     """prepare_queue + queue sort + top-K compaction. W: (C,) int32 window
     index (cycle time T = W * interval)."""
     C, P = state.pods.phase.shape
     rows = jnp.arange(C, dtype=jnp.int32)[:, None]
     pods, last_flush_win, eligible = prepare_queue(
-        state, W, consts, conditional_move
+        state, W, consts, conditional_move, wake
     )
 
     # Queue order: (queue_ts, queue_seq).
@@ -874,12 +984,6 @@ def commit_cycle(
         ),
         metrics=metrics,
         requeue_signal=jnp.zeros_like(state.requeue_signal),
-        wake_node_signal=jnp.zeros_like(state.wake_node_signal),
-        wake_node_cpu=jnp.zeros_like(state.wake_node_cpu),
-        wake_node_ram=jnp.zeros_like(state.wake_node_ram),
-        wake_freed_signal=jnp.zeros_like(state.wake_freed_signal),
-        wake_freed_cpu=jnp.zeros_like(state.wake_freed_cpu),
-        wake_freed_ram=jnp.zeros_like(state.wake_freed_ram),
         last_flush_win=cc.last_flush_win,
         time=jnp.maximum(state.time, W),
     )
@@ -896,6 +1000,7 @@ def _run_scheduling_cycle(
     pallas_mesh=None,
     pallas_axis: str = "clusters",
     use_pallas_select: bool = False,
+    wake=None,
 ) -> ClusterBatchState:
     """One vectorized kube-scheduler cycle at window W for every cluster
     (scalar equivalent: reference scheduler.rs:246-333).
@@ -922,7 +1027,7 @@ def _run_scheduling_cycle(
         )
 
         pods, last_flush_win, eligible = prepare_queue(
-            state, W, consts, conditional_move
+            state, W, consts, conditional_move, wake
         )
         core = partial(
             fused_select_schedule_cycle,
@@ -947,7 +1052,7 @@ def _run_scheduling_cycle(
         )
         park_k = cand_valid & ~fitany_k
     elif use_pallas:
-        cc = prepare_cycle(state, W, consts, max_pods_per_cycle, conditional_move)
+        cc = prepare_cycle(state, W, consts, max_pods_per_cycle, conditional_move, wake)
         cand_valid, cand_req_cpu, cand_req_ram = cc.valid, cc.req_cpu, cc.req_ram
         # The (C, N)-heavy core runs as a fused VMEM kernel; the (C,)-shaped
         # timing/metric mechanics below replicate the scan path's float-op
@@ -967,7 +1072,7 @@ def _run_scheduling_cycle(
         )
         park_k = cand_valid & ~fitany_k
     else:
-        cc = prepare_cycle(state, W, consts, max_pods_per_cycle, conditional_move)
+        cc = prepare_cycle(state, W, consts, max_pods_per_cycle, conditional_move, wake)
         cand_valid, cand_req_cpu, cand_req_ram = cc.valid, cc.req_cpu, cc.req_ram
 
         def body(carry, xs):
@@ -1052,7 +1157,7 @@ def _window_body(
     use_pallas_select: bool = False,
 ) -> ClusterBatchState:
     W = jnp.broadcast_to(jnp.asarray(W, jnp.int32), state.time.shape)
-    state = _apply_window_events(
+    state, wake = _apply_window_events(
         state,
         slab,
         W,
@@ -1064,6 +1169,23 @@ def _window_body(
         pallas_mesh,
         pallas_axis,
         use_pallas_select,
+        node_name_rank=(
+            autoscale_statics.node_name_rank
+            if autoscale_statics is not None else None
+        ),
+        pod_name_rank=(
+            autoscale_statics.pod_name_rank
+            if autoscale_statics is not None else None
+        ),
+    )
+    # Pre-cycle shadows for the CA's early-snapshot case (a CA storage
+    # snapshot landing before this window's commit-visibility time must not
+    # see this cycle's assignments/parks — ca_pass docstring).
+    pre_cycle = (
+        state.pods.phase,
+        state.pods.attempts,
+        state.nodes.alloc_cpu,
+        state.nodes.alloc_ram,
     )
     state = _run_scheduling_cycle(
         state,
@@ -1076,6 +1198,7 @@ def _window_body(
         pallas_mesh,
         pallas_axis,
         use_pallas_select,
+        wake=wake,
     )
     if autoscale_statics is not None:
         # Autoscaler ticks due by this window run after the scheduling cycle
@@ -1093,6 +1216,7 @@ def _window_body(
             consts,
             max_ca_pods_per_cycle,
             max_pods_per_scale_down,
+            pre=pre_cycle,
         )
         state = state._replace(auto=auto)
     return state
@@ -1253,7 +1377,13 @@ def _next_interesting_window(
 
     if autoscale_statics is not None and state.auto is not None:
         auto = state.auto
-        ca_tick = amin(auto.ca_next.win)
+        # The CA cycle runs in the window containing its storage snapshot
+        # (drifting cadence; autoscale.ca_pass docstring).
+        ca_snap_t = t_add(
+            auto.ca_next, autoscale_statics.ca_snap,
+            jnp.float32(consts.scheduling_interval),
+        )
+        ca_tick = amin(ca_snap_t.win)
         hpa_tick = amin(auto.hpa_next.win)
         ca_can_act = parked_any | (auto.ca_count.sum() > 0)
         cand = jnp.minimum(cand, jnp.where(ca_can_act, ca_tick, big))
@@ -1295,9 +1425,16 @@ def _catch_up_bookkeeping(
                 t_add(hpa_next, autoscale_statics.hpa_interval, interval),
                 hpa_next,
             )
+            # Same due/advance arithmetic as ca_pass: the cycle belongs to
+            # the window containing its storage snapshot; the period is the
+            # drifting round-trip + scan (autoscale.ca_pass docstring).
+            T1 = TPair(win=wc + jnp.int32(1), off=jnp.zeros_like(ca_next.off))
+            ca_due = t_lt(
+                t_add(ca_next, autoscale_statics.ca_snap, interval), T1
+            )
             ca_next = t_where(
-                t_le(ca_next, T),
-                t_add(ca_next, autoscale_statics.ca_interval, interval),
+                ca_due,
+                t_add(ca_next, autoscale_statics.ca_period, interval),
                 ca_next,
             )
         return (w + jnp.int32(1), last_flush, hpa_next, ca_next)

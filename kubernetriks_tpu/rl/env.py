@@ -77,6 +77,7 @@ def policy_cycle(
     reward_size_weighted: bool = False,
     shaping_coef: float = 0.0,
     shaping_gamma: float = 0.99,
+    wake=None,
 ) -> Tuple[ClusterBatchState, Transition]:
     """One scheduling cycle (at window index W) where the policy picks nodes;
     returns the K per-cluster transitions. Action space = nodes, masked to
@@ -87,17 +88,24 @@ def policy_cycle(
     - reward_size_weighted: placements/parks pay req_cpu/node_cap instead of
       1 — capacity-weighted throughput, so stranding a full-node pod costs
       what a full node's worth of small pods earns.
-    - shaping_coef (alpha): potential-based shaping F = gamma*phi(s') -
-      phi(s) with phi = alpha * (count of whole-free alive nodes). Fragmenting
-      a pristine node is charged AT the decision that fragments it instead of
-      hundreds of decisions later when a large pod parks — potential-based,
-      so the optimal policy is unchanged (Ng/Harada/Russell 1999) but the
-      credit horizon collapses from O(rollout) to O(1)."""
+    - shaping_coef (alpha): reward shaping F = gamma*phi(s') - phi(s) with
+      phi = alpha * (count of whole-free alive nodes), applied per decision.
+      Fragmenting a pristine node is charged AT the decision that fragments
+      it instead of hundreds of decisions later when a large pod parks — the
+      credit horizon collapses from O(rollout) to O(1). NOTE: this is
+      potential-based (Ng/Harada/Russell 1999) only over the decision
+      subsequence; phi changes caused by environment transitions between
+      windows (pod finishes re-emptying nodes, CA scale-ups) carry no
+      compensating term, so a small bias against fragmenting pristine nodes
+      remains even where the trace would make it free. Measured on the
+      bimodal proof scenario this bias points toward the true optimum
+      (best-fit packing) and the trained greedy policy converges exactly to
+      it (scripts/train_rl_proof.py, docs/RL_LEARNING.json)."""
     C, P = state.pods.phase.shape
     N = state.nodes.alive.shape[1]
     rows1 = jnp.arange(C, dtype=jnp.int32)
 
-    cc = prepare_cycle(state, W, consts, K, conditional_move)
+    cc = prepare_cycle(state, W, consts, K, conditional_move, wake)
     alive = state.nodes.alive
 
     alive_count = alive.sum(axis=1, dtype=jnp.int32).astype(jnp.float32)
@@ -244,14 +252,29 @@ def rollout(
         st, rng = carry
         rng, sub = jax.random.split(rng)
         w_arr = jnp.broadcast_to(jnp.asarray(w, jnp.int32), st.time.shape)
-        st = _apply_window_events(
-            st, slab, w_arr, consts, max_events_per_window, conditional_move
+        st, wake = _apply_window_events(
+            st, slab, w_arr, consts, max_events_per_window, conditional_move,
+            node_name_rank=(
+                autoscale_statics.node_name_rank
+                if autoscale_statics is not None else None
+            ),
+            pod_name_rank=(
+                autoscale_statics.pod_name_rank
+                if autoscale_statics is not None else None
+            ),
+        )
+        pre_cycle = (
+            st.pods.phase,
+            st.pods.attempts,
+            st.nodes.alloc_cpu,
+            st.nodes.alloc_ram,
         )
         st, transition = policy_cycle(
             st, w_arr, consts, max_pods_per_cycle, policy_apply, params, sub,
             greedy=greedy, conditional_move=conditional_move,
             reward_size_weighted=reward_size_weighted,
             shaping_coef=shaping_coef, shaping_gamma=shaping_gamma,
+            wake=wake,
         )
         if autoscale_statics is not None:
             from kubernetriks_tpu.batched.autoscale import ca_pass, hpa_pass
@@ -261,6 +284,7 @@ def rollout(
             st, auto = ca_pass(
                 st, auto, autoscale_statics, w_arr, consts,
                 max_ca_pods_per_cycle, max_pods_per_scale_down,
+                pre=pre_cycle,
             )
             st = st._replace(auto=auto)
         return (st, rng), transition

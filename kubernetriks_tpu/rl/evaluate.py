@@ -119,3 +119,62 @@ def eval_kube(
     """Run the KubeScheduler batched path over the same windows (fresh sim)."""
     sim._dispatch_windows(np.asarray(window_idxs, np.int32))
     return _summary(sim.state, len(window_idxs), large_cpu)
+
+
+# --- The bimodal learning-proof scenario ------------------------------------
+# Probed across seeds (scripts/train_rl_proof.py header has the load math):
+# long-lived small pods load ~59% of a 16-node cluster; spread by
+# LeastAllocated they fragment every node below the full-node large-pod
+# request, packed they fit in ~10 nodes. Placement strategy decides the
+# large pods' fate: kube strands 4-7 pods/cluster, best-fit 0-2.
+PROOF_N_NODES = 16
+PROOF_NODE_CPU = 16_000
+PROOF_NODE_RAM = 32 * 1024**3
+PROOF_SMALL = dict(rate_per_second=0.25, cpu=2_000, ram=4 * 1024**3,
+                   duration_range=(250.0, 350.0))
+PROOF_LARGE = dict(rate_per_second=0.015, cpu=16_000, ram=32 * 1024**3,
+                   duration_range=(250.0, 350.0))
+PROOF_WINDOWS = 48        # x 10 s cycle interval = 480 s rollout
+PROOF_HORIZON = 475.0
+PROOF_MAX_PODS_PER_CYCLE = 16
+
+
+def make_proof_sim(seed_base: int, n_clusters: int, n_seeds: int = 8):
+    """Cluster batch for the learning proof, cycling over n_seeds distinct
+    trace seeds so the training signal does not hinge on one Poisson draw."""
+    from kubernetriks_tpu.batched.trace_compile import compile_cluster_trace
+    from kubernetriks_tpu.config import SimulationConfig
+    from kubernetriks_tpu.trace.generator import (
+        MergedWorkloadTrace,
+        PoissonWorkloadTrace,
+        UniformClusterTrace,
+    )
+
+    config = SimulationConfig.from_yaml(
+        "sim_name: rl_proof\nseed: 1\nscheduling_cycle_interval: 10.0"
+    )
+    cluster_events = UniformClusterTrace(
+        PROOF_N_NODES, cpu=PROOF_NODE_CPU, ram=PROOF_NODE_RAM
+    ).convert_to_simulator_events()
+    compiled = []
+    for k in range(min(n_seeds, n_clusters)):
+        seed = seed_base + 100 * k
+        workload = MergedWorkloadTrace(
+            PoissonWorkloadTrace(
+                horizon=PROOF_HORIZON, seed=seed, name_prefix="small",
+                **PROOF_SMALL,
+            ),
+            PoissonWorkloadTrace(
+                horizon=PROOF_HORIZON, seed=seed + 1, name_prefix="large",
+                **PROOF_LARGE,
+            ),
+        )
+        compiled.append(
+            compile_cluster_trace(
+                cluster_events, workload.convert_to_simulator_events(), config
+            )
+        )
+    traces = [compiled[i % len(compiled)] for i in range(n_clusters)]
+    return BatchedSimulation(
+        config, traces, max_pods_per_cycle=PROOF_MAX_PODS_PER_CYCLE
+    )

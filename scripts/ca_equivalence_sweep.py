@@ -1,0 +1,71 @@
+"""Sweep the randomized CA-equivalence scenario across seeds (default 1..60),
+comparing the batched node-count trajectory (node_count_at: pending effects
+resolved at the sample time) against the scalar oracle with NO shift and NO
+tolerance. The r4 exact-CA record: 0/60 divergent (2026-07-31); the test
+suite pins a subset (tests/test_random_ca_equivalence.py).
+
+Usage: python scripts/ca_equivalence_sweep.py [--conditional-move] [seed ...]"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "tests"))
+
+from test_random_ca_equivalence import (
+    CA_CONFIG_SUFFIX,
+    CLUSTER_TRACE,
+    make_workload,
+)
+
+from kubernetriks_tpu.batched.engine import build_batched_from_traces
+from kubernetriks_tpu.sim.simulator import KubernetriksSimulation
+from kubernetriks_tpu.test_util import default_test_simulation_config
+from kubernetriks_tpu.trace.generic import GenericClusterTrace, GenericWorkloadTrace
+
+
+def run_seed(seed, conditional_move=False):
+    suffix = CA_CONFIG_SUFFIX + (
+        "enable_unscheduled_pods_conditional_move: true\n" if conditional_move else ""
+    )
+    config = default_test_simulation_config(suffix)
+    workload = make_workload(seed)
+    scalar = KubernetriksSimulation(config)
+    scalar.initialize(
+        GenericClusterTrace.from_yaml(CLUSTER_TRACE),
+        GenericWorkloadTrace.from_yaml(workload),
+    )
+    batched = build_batched_from_traces(
+        config,
+        GenericClusterTrace.from_yaml(CLUSTER_TRACE).convert_to_simulator_events(),
+        GenericWorkloadTrace.from_yaml(workload).convert_to_simulator_events(),
+        n_clusters=1,
+    )
+    ts, tb = [], []
+    for t in np.arange(15.0, 800.0, 10.0):
+        scalar.step_until_time(float(t))
+        batched.step_until_time(float(t))
+        ts.append(scalar.api_server.node_count())
+        tb.append(batched.node_count_at(float(t)))
+    return ts, tb
+
+
+if __name__ == "__main__":
+    args = [a for a in sys.argv[1:]]
+    conditional = "--conditional-move" in args
+    args = [a for a in args if a != "--conditional-move"]
+    seeds = [int(a) for a in args] if args else list(range(1, 61))
+    bad = []
+    for seed in seeds:
+        ts, tb = run_seed(seed, conditional_move=conditional)
+        diff = [(i, s, b) for i, (s, b) in enumerate(zip(ts, tb)) if s != b]
+        status = "OK " if not diff else f"{len(diff):3d} div"
+        print(f"seed {seed:2d}: {status}" + (f"  first@{diff[0]}" if diff else ""))
+        if diff:
+            bad.append(seed)
+            if len(sys.argv) > 1:
+                print("  scalar ", ts)
+                print("  batched", tb)
+    print(f"\n{len(bad)}/{len(seeds)} divergent: {bad}")

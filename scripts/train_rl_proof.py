@@ -27,72 +27,22 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 import numpy as np
 
-from kubernetriks_tpu.batched.engine import build_batched_from_traces
-from kubernetriks_tpu.config import SimulationConfig
-from kubernetriks_tpu.rl.evaluate import eval_kube, eval_policy
-from kubernetriks_tpu.rl.ppo import PPOConfig, PPOTrainer
-from kubernetriks_tpu.trace.generator import (
-    MergedWorkloadTrace,
-    PoissonWorkloadTrace,
-    UniformClusterTrace,
+from kubernetriks_tpu.rl.evaluate import (
+    PROOF_LARGE,
+    PROOF_NODE_CPU,
+    PROOF_N_NODES,
+    PROOF_SMALL,
+    PROOF_WINDOWS,
+    eval_kube,
+    eval_policy,
+    make_proof_sim,
 )
+from kubernetriks_tpu.rl.ppo import PPOConfig, PPOTrainer
 
-# The contended bimodal scenario (probed across seeds so that packing is
-# feasible and spreading is not): 16 nodes x 16 cores. Long-lived small
-# pods load ~59% of capacity — spread by LeastAllocated that puts ~4-5
-# small pods on EVERY node, so the full-node large pods can never place
-# until churn briefly empties a node; packed tightly the smalls fit in
-# ~9-10 nodes and large pods place immediately. Placement strategy, not
-# capacity, decides the large pods' fate: across probe seeds the kube
-# baseline strands 4-7 pods per cluster where best-fit strands 0-2.
-N_NODES = 16
-NODE_CPU = 16_000
-NODE_RAM = 32 * 1024**3
-SMALL = dict(rate_per_second=0.25, cpu=2_000, ram=4 * 1024**3,
-             duration_range=(250.0, 350.0))
-LARGE = dict(rate_per_second=0.015, cpu=16_000, ram=32 * 1024**3,
-             duration_range=(250.0, 350.0))
-WINDOWS = 48          # x 10 s cycle interval = 480 s rollout
-HORIZON = 475.0
-MAX_PODS_PER_CYCLE = 16
+WINDOWS = PROOF_WINDOWS
 TRAIN_SEED_BASE = 11_000   # train traces: seeds base, base+100, ...
 HELDOUT_SEED_BASE = 91_000  # held-out eval traces (disjoint)
-N_TRACE_SEEDS = 8
-
-
-def make_sim(seed_base: int, n_clusters: int, n_seeds: int = N_TRACE_SEEDS):
-    """Batch of clusters cycling over n_seeds distinct trace seeds — the
-    training signal should not hinge on one Poisson draw's luck."""
-    from kubernetriks_tpu.batched.engine import BatchedSimulation
-    from kubernetriks_tpu.batched.trace_compile import compile_cluster_trace
-
-    config = SimulationConfig.from_yaml(
-        "sim_name: rl_proof\nseed: 1\nscheduling_cycle_interval: 10.0"
-    )
-    cluster = UniformClusterTrace(N_NODES, cpu=NODE_CPU, ram=NODE_RAM)
-    cluster_events = cluster.convert_to_simulator_events()
-    compiled = []
-    for k in range(min(n_seeds, n_clusters)):
-        seed = seed_base + 100 * k
-        workload = MergedWorkloadTrace(
-            PoissonWorkloadTrace(
-                horizon=HORIZON, seed=seed, name_prefix="small", **SMALL
-            ),
-            PoissonWorkloadTrace(
-                horizon=HORIZON, seed=seed + 1, name_prefix="large", **LARGE
-            ),
-        )
-        compiled.append(
-            compile_cluster_trace(
-                cluster_events,
-                workload.convert_to_simulator_events(),
-                config,
-            )
-        )
-    traces = [compiled[i % len(compiled)] for i in range(n_clusters)]
-    return BatchedSimulation(
-        config, traces, max_pods_per_cycle=MAX_PODS_PER_CYCLE
-    )
+make_sim = make_proof_sim
 
 
 def main() -> None:
@@ -108,7 +58,9 @@ def main() -> None:
     ap.add_argument("--gamma", type=float, default=0.995)
     ap.add_argument("--lam", type=float, default=0.97)
     ap.add_argument("--shaping", type=float, default=0.2)
-    ap.add_argument("--size-weighted", action="store_true", default=True)
+    ap.add_argument(
+        "--size-weighted", action=argparse.BooleanOptionalAction, default=True
+    )
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
@@ -132,12 +84,15 @@ def main() -> None:
         policy_kind=args.policy,
     )
 
+    # One held-out sim serves every policy eval: eval_policy rolls out from
+    # sim.state functionally (only eval_kube's dispatch mutates a sim).
+    heldout_sim = make_sim(HELDOUT_SEED_BASE, args.eval_clusters)
+
     def heldout_eval(apply=None, params=None):
-        sim = make_sim(HELDOUT_SEED_BASE, args.eval_clusters)
         return eval_policy(
-            sim, apply or trainer.policy_apply,
+            heldout_sim, apply or trainer.policy_apply,
             trainer.params if apply is None else params, windows,
-            jax.random.PRNGKey(123), greedy=True, large_cpu=LARGE["cpu"],
+            jax.random.PRNGKey(123), greedy=True, large_cpu=PROOF_LARGE["cpu"],
         )
 
     def bestfit_apply(params, obs):
@@ -149,7 +104,7 @@ def main() -> None:
 
     kube = eval_kube(
         make_sim(HELDOUT_SEED_BASE, args.eval_clusters), windows,
-        large_cpu=LARGE["cpu"],
+        large_cpu=PROOF_LARGE["cpu"],
     )
     bestfit = heldout_eval(bestfit_apply, None)
     untrained = heldout_eval()
@@ -184,8 +139,8 @@ def main() -> None:
     trained = heldout_eval()
     record = {
         "scenario": {
-            "nodes": N_NODES, "node_cpu": NODE_CPU,
-            "small": SMALL, "large": LARGE,
+            "nodes": PROOF_N_NODES, "node_cpu": PROOF_NODE_CPU,
+            "small": PROOF_SMALL, "large": PROOF_LARGE,
             "windows": WINDOWS, "train_seed_base": TRAIN_SEED_BASE,
             "heldout_seed_base": HELDOUT_SEED_BASE, "clusters": args.clusters,
             "policy": args.policy,

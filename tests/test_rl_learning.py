@@ -1,0 +1,86 @@
+"""Learning proof (VERDICT r3 item 1): PPO actually learns a placement
+strategy that beats both its own untrained init and the KubeScheduler
+baseline on the bimodal fragmentation scenario.
+
+The scenario (rl/evaluate.py make_proof_sim) is built so that placement
+strategy — not capacity — decides outcomes: LeastAllocatedResources
+(the kube default, reference src/scheduler/plugin.rs:33-63) spreads
+long-lived small pods over every node, fragmenting the cluster below the
+full-node large-pod request; best-fit packing leaves whole nodes free.
+The full 120-iteration record with the learning curve is
+docs/RL_LEARNING.json (scripts/train_rl_proof.py); this test runs a
+shortened training (the policy locks onto the packing optimum within a
+few iterations under potential-style shaping) and gates the claim.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from kubernetriks_tpu.rl.evaluate import (
+    PROOF_LARGE,
+    PROOF_WINDOWS,
+    eval_kube,
+    eval_policy,
+    make_proof_sim,
+)
+from kubernetriks_tpu.rl.ppo import PPOConfig, PPOTrainer
+
+TRAIN_SEED_BASE = 11_000
+HELDOUT_SEED_BASE = 91_000
+
+
+@pytest.mark.slow
+def test_ppo_learns_to_beat_kube_and_untrained():
+    windows = np.arange(PROOF_WINDOWS, dtype=np.int32)
+    train_sim = make_proof_sim(TRAIN_SEED_BASE, 32)
+    trainer = PPOTrainer(
+        train_sim,
+        windows_per_rollout=PROOF_WINDOWS,
+        config=PPOConfig(
+            learning_rate=3e-4,
+            gamma=0.995,
+            gae_lambda=0.97,
+            epochs_per_iteration=4,
+            reward_size_weighted=True,
+            shaping_coef=0.2,
+        ),
+        seed=0,
+    )
+
+    heldout = make_proof_sim(HELDOUT_SEED_BASE, 32)
+
+    def greedy_eval():
+        return eval_policy(
+            heldout, trainer.policy_apply, trainer.params, windows,
+            jax.random.PRNGKey(123), greedy=True, large_cpu=PROOF_LARGE["cpu"],
+        )
+
+    kube = eval_kube(
+        make_proof_sim(HELDOUT_SEED_BASE, 32), windows,
+        large_cpu=PROOF_LARGE["cpu"],
+    )
+    untrained = greedy_eval()
+    for it in trainer.train(16):
+        assert np.isfinite(it["policy_loss"])
+    trained = greedy_eval()
+
+    # vs the KubeScheduler baseline: the learned packing policy places the
+    # large pods LeastAllocated strands (kube ~29% across the probe seeds).
+    assert trained["large_placed_frac"] >= kube["large_placed_frac"] + 0.30, (
+        trained, kube,
+    )
+    assert (
+        trained["unschedulable_left_per_cluster"]
+        < kube["unschedulable_left_per_cluster"]
+    ), (trained, kube)
+    assert trained["placements_per_cluster"] > kube["placements_per_cluster"]
+
+    # vs its own untrained init (same architecture, same greedy readout):
+    # materially fewer park decisions and shorter queues.
+    assert trained["park_decisions_per_cluster"] <= (
+        0.7 * untrained["park_decisions_per_cluster"]
+    ), (trained, untrained)
+    assert trained["mean_queue_time_s"] < untrained["mean_queue_time_s"], (
+        trained, untrained,
+    )
