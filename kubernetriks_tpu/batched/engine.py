@@ -575,6 +575,22 @@ class BatchedSimulation:
                 self.n_nodes, self.n_pods, self.max_pods_per_cycle
             )
         )
+        # The r4 megakernel (selection + cycle + commit in one launch) is the
+        # default on the dense path when its larger VMEM footprint fits;
+        # KTPU_MEGAKERNEL=0 selects the two-kernel path (A/B measurement).
+        # Read at BUILD time and threaded as a jit-static, so toggling the
+        # env between engine builds takes effect without cache collisions.
+        from kubernetriks_tpu.ops.scheduler_kernel import (
+            select_commit_kernel_fits,
+        )
+
+        self.use_megakernel = (
+            self.use_pallas_select
+            and os.environ.get("KTPU_MEGAKERNEL", "1") != "0"
+            and select_commit_kernel_fits(
+                self.n_nodes, self.n_pods, self.max_pods_per_cycle
+            )
+        )
 
         self.state = init_state(
             C,
@@ -753,6 +769,7 @@ class BatchedSimulation:
                 pallas_mesh=self.mesh if self.use_pallas else None,
                 pallas_axis=self._batch_axis,
                 use_pallas_select=self.use_pallas_select,
+                use_megakernel=self.use_megakernel,
                 flush_windows=self._flush_windows,
             )
             self.next_window_idx = int(idxs[-1]) + 1
@@ -774,6 +791,7 @@ class BatchedSimulation:
             pallas_mesh=self.mesh if self.use_pallas else None,
             pallas_axis=self._batch_axis,
             use_pallas_select=self.use_pallas_select,
+            use_megakernel=self.use_megakernel,
         )
         if self.collect_gauges:
             self.state, gauges = out
@@ -1009,6 +1027,7 @@ class BatchedSimulation:
             pallas_mesh=self.mesh if self.use_pallas else None,
             pallas_axis=self._batch_axis,
             use_pallas_select=self.use_pallas_select,
+            use_megakernel=self.use_megakernel,
         )
         if self.collect_gauges:
             from kubernetriks_tpu.batched.step import gauge_snapshot

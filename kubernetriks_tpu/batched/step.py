@@ -879,6 +879,68 @@ def prepare_cycle(
     )
 
 
+
+def commit_scattered_tail(
+    state: ClusterBatchState,
+    pods,
+    last_flush_win,
+    W: jnp.ndarray,
+    consts: StepConstants,
+    alloc_cpu,
+    alloc_ram,
+    metrics,
+    phase,
+    node,
+    start_tmp,
+    park_tmp,
+) -> ClusterBatchState:
+    """Shared bottom half of the decision commit: reconstruct absolute
+    start/finish/park pairs from the scattered float32 second offsets
+    (+inf = untouched) and write the post-cycle state. Used by commit_cycle
+    and by the megakernel path (whose kernel already produced the scattered
+    phase/node/start/park arrays)."""
+    C, P = pods.phase.shape
+    interval = jnp.float32(consts.scheduling_interval)
+    f32inf = jnp.float32(INF)
+
+    started = start_tmp < f32inf
+    start_pair = t_norm(
+        jnp.broadcast_to(W[:, None], (C, P)),
+        jnp.where(started, start_tmp, 0.0),
+        interval,
+    )
+    service = pods.duration.win < 0
+    finish_pair = t_add(start_pair, pods.duration, interval)
+    start_time = t_where(started, start_pair, pods.start_time)
+    finish_time = t_where(
+        started,
+        t_where(service, t_inf((C, P)), finish_pair),
+        pods.finish_time,
+    )
+    parked = park_tmp < f32inf
+    park_pair = t_norm(
+        jnp.broadcast_to(W[:, None], (C, P)),
+        jnp.where(parked, park_tmp, 0.0),
+        interval,
+    )
+    queue_ts = t_where(parked, park_pair, pods.queue_ts)
+
+    return state._replace(
+        nodes=state.nodes._replace(alloc_cpu=alloc_cpu, alloc_ram=alloc_ram),
+        pods=pods._replace(
+            phase=phase,
+            queue_ts=queue_ts,
+            node=node,
+            start_time=start_time,
+            finish_time=finish_time,
+        ),
+        metrics=metrics,
+        requeue_signal=jnp.zeros_like(state.requeue_signal),
+        last_flush_win=last_flush_win,
+        time=jnp.maximum(state.time, W),
+    )
+
+
 def commit_cycle(
     state: ClusterBatchState,
     cc: CycleCandidates,
@@ -951,41 +1013,9 @@ def commit_cycle(
             .set(jnp.where(park_k, park_s_k, f32inf), mode="drop")
         )
 
-    started = start_tmp < f32inf
-    start_pair = t_norm(
-        jnp.broadcast_to(W[:, None], (C, P)),
-        jnp.where(started, start_tmp, 0.0),
-        interval,
-    )
-    service = pods.duration.win < 0
-    finish_pair = t_add(start_pair, pods.duration, interval)
-    start_time = t_where(started, start_pair, pods.start_time)
-    finish_time = t_where(
-        started,
-        t_where(service, t_inf((C, P)), finish_pair),
-        pods.finish_time,
-    )
-    parked = park_tmp < f32inf
-    park_pair = t_norm(
-        jnp.broadcast_to(W[:, None], (C, P)),
-        jnp.where(parked, park_tmp, 0.0),
-        interval,
-    )
-    queue_ts = t_where(parked, park_pair, pods.queue_ts)
-
-    return state._replace(
-        nodes=state.nodes._replace(alloc_cpu=alloc_cpu, alloc_ram=alloc_ram),
-        pods=pods._replace(
-            phase=phase,
-            queue_ts=queue_ts,
-            node=node,
-            start_time=start_time,
-            finish_time=finish_time,
-        ),
-        metrics=metrics,
-        requeue_signal=jnp.zeros_like(state.requeue_signal),
-        last_flush_win=cc.last_flush_win,
-        time=jnp.maximum(state.time, W),
+    return commit_scattered_tail(
+        state, pods, cc.last_flush_win, W, consts, alloc_cpu, alloc_ram,
+        metrics, phase, node, start_tmp, park_tmp,
     )
 
 
@@ -1001,6 +1031,7 @@ def _run_scheduling_cycle(
     pallas_axis: str = "clusters",
     use_pallas_select: bool = False,
     wake=None,
+    use_megakernel: bool = True,
 ) -> ClusterBatchState:
     """One vectorized kube-scheduler cycle at window W for every cluster
     (scalar equivalent: reference scheduler.rs:246-333).
@@ -1017,11 +1048,92 @@ def _run_scheduling_cycle(
     alive_count = alive.sum(axis=1, dtype=jnp.int32).astype(jnp.float32)
     pod_sched_time = jnp.float32(consts.time_per_node) * alive_count  # (C,)
 
-    if use_pallas and use_pallas_select:
-        # Fully fused path: queue selection happens IN-KERNEL by iterated
-        # lexicographic argmin, replacing the (C, P) 3-key sort + top-K
-        # gathers — the fixed per-window cost the sort path pays even on
-        # empty queues (see ops/scheduler_kernel.py).
+    if use_pallas and use_pallas_select and use_megakernel:
+        # MEGAKERNEL path: queue selection (iterated 3-key argmin), the
+        # fit/score/place cycle AND the decision commit run in ONE Pallas
+        # launch; the queue-time estimator folds in-kernel. Timing inputs
+        # are positional tables computed with cycle_timing's exact cumsum
+        # arithmetic (valid decisions form a position prefix, and cumsum
+        # outputs depend only on their input prefix, so the table values at
+        # valid positions are bit-identical to the masked ones).
+        from kubernetriks_tpu.ops.scheduler_kernel import (
+            fused_select_cycle_commit,
+        )
+
+        pods, last_flush_win, eligible = prepare_queue(
+            state, W, consts, conditional_move, wake
+        )
+        interval = jnp.float32(consts.scheduling_interval)
+        K = max_pods_per_cycle
+        waited_p = (
+            W[:, None] - pods.initial_attempt_ts.win
+        ).astype(jnp.float32) * interval - pods.initial_attempt_ts.off
+        full_dur = jnp.broadcast_to(pod_sched_time[:, None], (C, K))
+        cd_post = jnp.cumsum(full_dur, axis=1)
+        qpre_t = cd_post - full_dur
+        start_t = cd_post + jnp.float32(consts.delta_bind_start)
+        park_t = cd_post
+
+        core = partial(
+            fused_select_cycle_commit,
+            k_pods=K,
+            interpret=pallas_interpret,
+        )
+        if pallas_mesh is not None:
+            core = _shard_rowwise(core, 15, 7, pallas_mesh, pallas_axis)
+        (alloc_cpu, alloc_ram, phase, node, start_tmp, park_tmp, qstats) = core(
+            alive,
+            state.nodes.alloc_cpu,
+            state.nodes.alloc_ram,
+            eligible,
+            pods.queue_ts.win,
+            pods.queue_ts.off,
+            pods.queue_seq,
+            pods.req_cpu,
+            pods.req_ram,
+            waited_p,
+            pods.phase,
+            pods.node,
+            qpre_t,
+            start_t,
+            park_t,
+        )
+        # Metric merge from the in-kernel fold: queue_time estimator rows
+        # (count, total, total_sq, min, max); algo_latency adds the constant
+        # per-cluster pod_sched_time once per assignment.
+        n_assign = qstats[:, 0].astype(jnp.int32)
+        has = n_assign > 0
+        nf = qstats[:, 0]
+        m = state.metrics
+        qt, al = m.queue_time, m.algo_latency
+        metrics = m._replace(
+            scheduling_decisions=m.scheduling_decisions + n_assign,
+            queue_time=EstArrays(
+                count=qt.count + n_assign,
+                total=qt.total + qstats[:, 1],
+                total_sq=qt.total_sq + qstats[:, 2],
+                minimum=jnp.minimum(qt.minimum, qstats[:, 3]),
+                maximum=jnp.maximum(qt.maximum, qstats[:, 4]),
+            ),
+            algo_latency=EstArrays(
+                count=al.count + n_assign,
+                total=al.total + nf * pod_sched_time,
+                total_sq=al.total_sq + nf * pod_sched_time * pod_sched_time,
+                minimum=jnp.where(
+                    has, jnp.minimum(al.minimum, pod_sched_time), al.minimum
+                ),
+                maximum=jnp.where(
+                    has, jnp.maximum(al.maximum, pod_sched_time), al.maximum
+                ),
+            ),
+        )
+        return commit_scattered_tail(
+            state, pods, last_flush_win, W, consts, alloc_cpu, alloc_ram,
+            metrics, phase, node, start_tmp, park_tmp,
+        )
+    elif use_pallas and use_pallas_select:
+        # Two-kernel fallback (KTPU_MEGAKERNEL=0): in-kernel selection+cycle,
+        # commit as a second one-hot kernel — kept for A/B measurement.
         from kubernetriks_tpu.ops.scheduler_kernel import (
             fused_select_schedule_cycle,
         )
@@ -1155,6 +1267,7 @@ def _window_body(
     pallas_mesh=None,
     pallas_axis: str = "clusters",
     use_pallas_select: bool = False,
+    use_megakernel: bool = True,
 ) -> ClusterBatchState:
     W = jnp.broadcast_to(jnp.asarray(W, jnp.int32), state.time.shape)
     state, wake = _apply_window_events(
@@ -1199,6 +1312,7 @@ def _window_body(
         pallas_axis,
         use_pallas_select,
         wake=wake,
+        use_megakernel=use_megakernel,
     )
     if autoscale_statics is not None:
         # Autoscaler ticks due by this window run after the scheduling cycle
@@ -1276,6 +1390,7 @@ _STEP_STATICS = (
     "pallas_mesh",
     "pallas_axis",
     "use_pallas_select",
+    "use_megakernel",
 )
 
 
@@ -1296,6 +1411,7 @@ def window_step(
     pallas_mesh=None,
     pallas_axis: str = "clusters",
     use_pallas_select: bool = False,
+    use_megakernel: bool = True,
 ) -> ClusterBatchState:
     """Advance every cluster through scheduling-cycle window index W."""
     return _window_body(
@@ -1314,6 +1430,7 @@ def window_step(
         pallas_mesh,
         pallas_axis,
         use_pallas_select,
+        use_megakernel=use_megakernel,
     )
 
 
@@ -1481,6 +1598,7 @@ def run_windows_skip(
     pallas_mesh=None,
     pallas_axis: str = "clusters",
     use_pallas_select: bool = False,
+    use_megakernel: bool = True,
     flush_windows: int = 3,
 ):
     """run_windows with FAST-FORWARD over provably no-op windows: a dynamic
@@ -1513,6 +1631,7 @@ def run_windows_skip(
             pallas_mesh,
             pallas_axis,
             use_pallas_select,
+            use_megakernel=use_megakernel,
         )
         W_next = jnp.minimum(
             _next_interesting_window(
@@ -1549,6 +1668,7 @@ def run_windows(
     pallas_mesh=None,
     pallas_axis: str = "clusters",
     use_pallas_select: bool = False,
+    use_megakernel: bool = True,
 ):
     """Scan a whole sequence of scheduling-cycle windows on-device (the hot
     benchmark loop: no host round-trips between cycles). window_idxs: (Wn,)
@@ -1575,6 +1695,7 @@ def run_windows(
             pallas_mesh,
             pallas_axis,
             use_pallas_select,
+            use_megakernel=use_megakernel,
         )
         return new, (gauge_snapshot(new) if collect_gauges else None)
 

@@ -899,3 +899,260 @@ def fused_schedule_cycle(
         cpu_o[:N, :C].T,
         ram_o[:N, :C].T,
     )
+
+
+# --- round-4 megakernel: selection + cycle + commit in ONE launch -----------
+
+def select_commit_kernel_fits(n_nodes: int, n_pods: int, k_pods: int) -> bool:
+    """VMEM budget for the megakernel: ~5 node-shaped + 14 pod-shaped +
+    3 K-shaped blocks + the (8, LANE) stats block, double-buffered by
+    Mosaic (~2x block bytes)."""
+    Np = -(-n_nodes // _SUB) * _SUB
+    Pp = -(-n_pods // _SUB) * _SUB
+    Kp = -(-k_pods // _SUB) * _SUB
+    per_lane_bytes = 2 * (5 * Np + 14 * Pp + 3 * Kp + 8) * 4 * _LANE
+    return per_lane_bytes <= int(_SELECT_VMEM_LIMIT * 0.8)
+
+
+def _argmin_select(rem, qwin_ref, qoff_ref, qseq_ref, iota_p):
+    """ONE in-kernel definition of the per-lane lexicographic argmin over
+    (queue win, off-bits, seq) — the batched ActiveQueue's sorted order —
+    shared by _select_cycle_kernel and _select_cycle_commit_kernel (the
+    same dedup _fit_score_place provides for the decision core).
+    Returns (sel one-hot (Pp, LC), seli int, slot (1, LC), valid (1, LC))."""
+    i0 = jnp.int32(0)
+    neg1 = jnp.int32(-1)
+    bigi = jnp.int32(np.iinfo(np.int32).max)
+    w = jnp.where(rem, qwin_ref[:], bigi)
+    minw = jnp.min(w, axis=0, keepdims=True)
+    m1 = rem & (qwin_ref[:] == minw)
+    o = jnp.where(m1, qoff_ref[:], bigi)
+    mino = jnp.min(o, axis=0, keepdims=True)
+    m2 = m1 & (qoff_ref[:] == mino)
+    sq = jnp.where(m2, qseq_ref[:], bigi)
+    mins = jnp.min(sq, axis=0, keepdims=True)
+    sel = m2 & (qseq_ref[:] == mins)  # exactly one row per non-empty lane
+    seli = sel.astype(jnp.int32)
+    slot = jnp.max(jnp.where(sel, iota_p, neg1), axis=0, keepdims=True)
+    valid = slot >= i0
+    return sel, seli, slot, valid
+
+
+def _select_cycle_commit_kernel(
+    n_nodes: int,
+    k_pods: int,
+    alive_ref,      # (Np, LC) int32
+    alloc_cpu_ref,  # (Np, LC) int32
+    alloc_ram_ref,  # (Np, LC) int32
+    elig_ref,       # (Pp, LC) int32 0/1
+    qwin_ref,       # (Pp, LC) int32
+    qoff_ref,       # (Pp, LC) int32 (bitcast f32, non-negative)
+    qseq_ref,       # (Pp, LC) int32
+    preq_cpu_ref,   # (Pp, LC) int32
+    preq_ram_ref,   # (Pp, LC) int32
+    waited_ref,     # (Pp, LC) float32 queue wait at cycle start
+    phase_ref,      # (Pp, LC) int32
+    node_ref,       # (Pp, LC) int32
+    qpre_ref,       # (Kp, LC) float32 positional cd_pre table
+    start_ref,      # (Kp, LC) float32 positional start-offset table
+    park_ref,       # (Kp, LC) float32 positional park-offset table
+    cpu_out,        # (Np, LC) int32
+    ram_out,        # (Np, LC) int32
+    phase_out,      # (Pp, LC) int32
+    node_out,       # (Pp, LC) int32
+    start_out,      # (Pp, LC) float32 (+inf = untouched)
+    park_out,       # (Pp, LC) float32 (+inf = untouched)
+    stats_out,      # (8, LC) float32: count/total/total_sq/min/max of
+                    #   queue-time samples over assigned decisions
+    rem_ref,        # (Pp, LC) int32 scratch
+):
+    """The whole-window scheduling megakernel (VERDICT r3 item 2): queue
+    SELECTION (iterated 3-key argmin, _select_cycle_kernel), the
+    fit/score/place CYCLE, and the decision COMMIT (the per-pod phase/node/
+    start/park writes of _commit_kernel — the selection one-hot IS the
+    commit's scatter mask) run in one Pallas launch, plus the queue-time
+    estimator fold (the free kernel's stats pattern). Replaces two kernel
+    launches and the (C, K) timing/metric XLA glue between them.
+
+    Timing bit-exactness: the positional tables qpre/start/park are
+    computed OUTSIDE with the same cumsum cycle_timing uses on an all-valid
+    mask; valid decisions always form a position prefix, and cumsum outputs
+    depend only on their input prefix, so table values at valid positions
+    are bit-identical to cycle_timing's. waited is precomputed per pod with
+    candidates_from_slots' exact expression. Only the estimator SUMS
+    accumulate in loop order instead of XLA's tiled reduction — the
+    documented ulp-level metric tolerance (docs/PARITY.md)."""
+    i0 = jnp.int32(0)
+    i1 = jnp.int32(1)
+    neg1 = jnp.int32(-1)
+    bigi = jnp.int32(np.iinfo(np.int32).max)
+    f0 = jnp.float32(0.0)
+    f1 = jnp.float32(1.0)
+    finf = jnp.float32(np.inf)
+
+    cpu_out[:] = alloc_cpu_ref[:]
+    ram_out[:] = alloc_ram_ref[:]
+    phase_out[:] = phase_ref[:]
+    node_out[:] = node_ref[:]
+    start_out[:] = jnp.full_like(start_out, finf)
+    park_out[:] = jnp.full_like(park_out, finf)
+    stats_out[:] = jnp.zeros_like(stats_out)
+    stats_out[3:4, :] = stats_out[3:4, :] + finf
+    stats_out[4:5, :] = stats_out[4:5, :] - finf
+
+    alive = alive_ref[:] != i0
+    iota_n = jax.lax.broadcasted_iota(jnp.int32, alive.shape, 0)
+    node_ok = iota_n < jnp.int32(n_nodes)
+    rem_ref[:] = elig_ref[:]
+    iota_p = jax.lax.broadcasted_iota(jnp.int32, elig_ref.shape, 0)
+    depth = jnp.max(jnp.sum(elig_ref[:], axis=0, keepdims=True))
+    k_bound = jnp.minimum(depth, jnp.int32(k_pods))
+
+    def body(k):
+        rem = rem_ref[:] != i0
+        sel, seli, slot, valid = _argmin_select(
+            rem, qwin_ref, qoff_ref, qseq_ref, iota_p
+        )
+        rc = jnp.max(seli * preq_cpu_ref[:], axis=0, keepdims=True)
+        rr = jnp.max(seli * preq_ram_ref[:], axis=0, keepdims=True)
+
+        assign, any_fit, best, new_cpu, new_ram = _fit_score_place(
+            alive, node_ok, iota_n, cpu_out[:], ram_out[:], rc, rr, valid
+        )
+        cpu_out[:] = new_cpu
+        ram_out[:] = new_ram
+        park = valid & ~any_fit
+
+        # COMMIT: the selection one-hot is the scatter mask.
+        new_phase = jnp.where(
+            assign, jnp.int32(_PHASE_RUNNING), jnp.int32(_PHASE_UNSCHEDULABLE)
+        )
+        touched = assign | park
+        phase_out[:] = jnp.where(sel & touched, new_phase, phase_out[:])
+        node_out[:] = jnp.where(sel & assign, best, node_out[:])
+        start_s = start_ref[pl.ds(k, 1), :]
+        park_s = park_ref[pl.ds(k, 1), :]
+        start_out[:] = jnp.where(sel & assign, start_s, start_out[:])
+        park_out[:] = jnp.where(sel & park, park_s, park_out[:])
+
+        # Queue-time estimator fold over assigned decisions.
+        waited = jnp.max(
+            jnp.where(sel, waited_ref[:], -finf), axis=0, keepdims=True
+        )
+        qtime = waited + qpre_ref[pl.ds(k, 1), :]
+        stats_out[0:1, :] = stats_out[0:1, :] + jnp.where(assign, f1, f0)
+        stats_out[1:2, :] = stats_out[1:2, :] + jnp.where(assign, qtime, f0)
+        stats_out[2:3, :] = stats_out[2:3, :] + jnp.where(
+            assign, qtime * qtime, f0
+        )
+        stats_out[3:4, :] = jnp.minimum(
+            stats_out[3:4, :], jnp.where(assign, qtime, finf)
+        )
+        stats_out[4:5, :] = jnp.maximum(
+            stats_out[4:5, :], jnp.where(assign, qtime, -finf)
+        )
+
+        rem_ref[:] = jnp.where(sel, i0, rem_ref[:])
+
+    def loop_body(k):
+        body(k)
+        return k + i1
+
+    jax.lax.while_loop(lambda k: k < k_bound, loop_body, jnp.int32(0))
+
+
+@functools.partial(jax.jit, static_argnames=("k_pods", "interpret"))
+def fused_select_cycle_commit(
+    alive: jnp.ndarray,      # (C, N) bool
+    alloc_cpu: jnp.ndarray,  # (C, N) int32
+    alloc_ram: jnp.ndarray,  # (C, N) int32
+    eligible: jnp.ndarray,   # (C, P) bool
+    qwin: jnp.ndarray,       # (C, P) int32
+    qoff: jnp.ndarray,       # (C, P) float32 (non-negative)
+    qseq: jnp.ndarray,       # (C, P) int32
+    pod_req_cpu: jnp.ndarray,   # (C, P) int32
+    pod_req_ram: jnp.ndarray,   # (C, P) int32
+    waited: jnp.ndarray,     # (C, P) float32
+    phase: jnp.ndarray,      # (C, P) int32
+    node: jnp.ndarray,       # (C, P) int32
+    qpre_t: jnp.ndarray,     # (C, K) float32 positional cd_pre
+    start_t: jnp.ndarray,    # (C, K) float32 positional start offsets
+    park_t: jnp.ndarray,     # (C, K) float32 positional park offsets
+    k_pods: int,
+    interpret: bool = False,
+):
+    """Megakernel wrapper. Returns (alloc_cpu, alloc_ram, phase, node,
+    start_tmp (+inf untouched), park_tmp, qstats (C, 5))."""
+    C, N = alloc_cpu.shape
+    P = eligible.shape[1]
+    K = k_pods
+    Cp = -(-C // _LANE) * _LANE
+    Np = -(-N // _SUB) * _SUB
+    Pp = -(-P // _SUB) * _SUB
+    Kp = -(-K // _SUB) * _SUB
+
+    def prep(x, n_sub, fill):
+        return _pad_axis(_pad_axis(x.astype(jnp.int32).T, 0, n_sub, fill), 1, Cp, fill)
+
+    def prep_f(x, n_sub, fill):
+        return _pad_axis(
+            _pad_axis(x.astype(jnp.float32).T, 0, n_sub, fill), 1, Cp, fill
+        )
+
+    alive_p = prep(alive, Np, 0)
+    cpu_p = prep(alloc_cpu, Np, 0)
+    ram_p = prep(alloc_ram, Np, 0)
+    elig_p = prep(eligible, Pp, 0)
+    qwin_p = prep(qwin, Pp, 0)
+    qoff_p = prep(jax.lax.bitcast_convert_type(qoff, jnp.int32), Pp, 0)
+    qseq_p = prep(qseq, Pp, 0)
+    reqc_p = prep(pod_req_cpu, Pp, 0)
+    reqr_p = prep(pod_req_ram, Pp, 0)
+    waited_p = prep_f(waited, Pp, 0.0)
+    phase_p = prep(phase, Pp, 0)
+    node_p = prep(node, Pp, 0)
+    qpre_p = prep_f(qpre_t, Kp, 0.0)
+    start_p = prep_f(start_t, Kp, 0.0)
+    park_p = prep_f(park_t, Kp, 0.0)
+
+    node_spec = pl.BlockSpec((Np, _LANE), lambda i: (0, i), memory_space=pltpu.VMEM)
+    pod_spec = pl.BlockSpec((Pp, _LANE), lambda i: (0, i), memory_space=pltpu.VMEM)
+    cand_spec = pl.BlockSpec((Kp, _LANE), lambda i: (0, i), memory_space=pltpu.VMEM)
+    stat_spec = pl.BlockSpec((8, _LANE), lambda i: (0, i), memory_space=pltpu.VMEM)
+
+    kernel = functools.partial(_select_cycle_commit_kernel, N, K)
+    with jax.enable_x64(False):
+        (cpu_o, ram_o, phase_o, node_o, start_o, park_o, stats_o) = pl.pallas_call(
+            kernel,
+            grid=(Cp // _LANE,),
+            in_specs=[node_spec] * 3 + [pod_spec] * 9 + [cand_spec] * 3,
+            out_specs=[node_spec] * 2 + [pod_spec] * 4 + [stat_spec],
+            out_shape=[
+                jax.ShapeDtypeStruct((Np, Cp), jnp.int32),
+                jax.ShapeDtypeStruct((Np, Cp), jnp.int32),
+                jax.ShapeDtypeStruct((Pp, Cp), jnp.int32),
+                jax.ShapeDtypeStruct((Pp, Cp), jnp.int32),
+                jax.ShapeDtypeStruct((Pp, Cp), jnp.float32),
+                jax.ShapeDtypeStruct((Pp, Cp), jnp.float32),
+                jax.ShapeDtypeStruct((8, Cp), jnp.float32),
+            ],
+            scratch_shapes=[pltpu.VMEM((Pp, _LANE), jnp.int32)],
+            compiler_params=pltpu.CompilerParams(
+                vmem_limit_bytes=_SELECT_VMEM_LIMIT
+            ),
+            interpret=interpret,
+        )(
+            alive_p, cpu_p, ram_p, elig_p, qwin_p, qoff_p, qseq_p,
+            reqc_p, reqr_p, waited_p, phase_p, node_p,
+            qpre_p, start_p, park_p,
+        )
+
+    return (
+        cpu_o[:N, :C].T,
+        ram_o[:N, :C].T,
+        phase_o[:P, :C].T,
+        node_o[:P, :C].T,
+        start_o[:P, :C].T,
+        park_o[:P, :C].T,
+        stats_o[:5, :C].T,
+    )

@@ -383,12 +383,18 @@ def test_commit_kernel_matches_scatters():
     np.testing.assert_array_equal(np.asarray(got[3]), park_tmp)
 
 
-@pytest.mark.parametrize("seed", [3, 17])
-def test_random_trace_all_kernels_match_scan(seed):
+@pytest.mark.parametrize("seed,megakernel", [(3, "1"), (17, "1"), (17, "0")])
+def test_random_trace_all_kernels_match_scan(seed, megakernel, monkeypatch):
+    # Pin the megakernel choice regardless of ambient env (the engine reads
+    # KTPU_MEGAKERNEL at build time); the "0" case keeps the two-kernel
+    # fallback path covered.
+    monkeypatch.setenv("KTPU_MEGAKERNEL", megakernel)
     """Randomized full-sim equivalence with EVERY Pallas kernel forced on
-    (selection + free + event + commit, interpret mode) against the pure-XLA
-    scan path, over a trace with node churn and autoscalers — the strongest
-    single parity statement the suite makes about the kernel set."""
+    (the r4 MEGAKERNEL — selection + cycle + commit + queue-time estimator
+    fold in one launch — plus the free and event kernels, interpret mode)
+    against the pure-XLA scan path, over a trace with node churn and
+    autoscalers — the strongest single parity statement the suite makes
+    about the kernel set."""
     from kubernetriks_tpu.test_util import default_test_simulation_config
     from kubernetriks_tpu.trace.generic import GenericClusterTrace, GenericWorkloadTrace
 
