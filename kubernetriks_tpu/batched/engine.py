@@ -921,6 +921,20 @@ class BatchedSimulation:
         s = int(first_live.min())
         if s <= 0:
             return False
+        # Quantize the shift to a SMALL set of values: every distinct s is a
+        # distinct concatenate/refill shape, and each novel shape recompiles
+        # the 17-leaf pytree concat (measured ~7 s per novel slide through
+        # the tunnel — 400x the actual window step). Two main shapes (W/2
+        # and W/8) plus small powers of two as the forced-minimal fallback;
+        # sliding less than possible is harmless — the capacity check just
+        # triggers another slide sooner.
+        quantum = max(W // 8, 1)
+        if s >= W // 2 > 0:
+            s = W // 2
+        elif s >= quantum:
+            s = quantum
+        else:
+            s = 1 << (s.bit_length() - 1)
 
         C = phases.shape[0]
         refill_lo = win_lo + W
