@@ -240,14 +240,18 @@ def build_autoscale_statics(
     # first-fits re-placements in NAME order (info.nodes is name-sorted,
     # persistent_storage.sorted_nodes) — slot order differs once a name set
     # straddles a digit boundary ("g_10" < "g_2") or trace names interleave.
-    N_total = n_trace_nodes + S
+    # The node axis only gains the S reserved CA slots when the engine
+    # actually appends them (CA on with named groups) — the rank array must
+    # match the axis exactly (a stale +S here broadcast-crashed HPA-only
+    # configs with >1 node; N=1 configs masked it via size-1 broadcasting).
+    N_total = n_trace_nodes + (S if extra_node_names else 0)
     node_name_rank = np.full((C, N_total), BIG_RANK, np.int32)
     ca_sd_order = np.tile(np.arange(S, dtype=np.int32), (C, 1))
     for ci, trace in enumerate(compiled_traces):
         names = list(trace.node_names[:n_trace_nodes]) + extra_node_names
         ranks = _ranks_for(("node", id(trace)), names)
         node_name_rank[ci, : len(ranks)] = ranks
-        if S:
+        if extra_node_names:
             ca_ranks = node_name_rank[ci, n_trace_nodes:]
             ca_sd_order[ci] = np.argsort(ca_ranks, kind="stable").astype(
                 np.int32
@@ -931,6 +935,8 @@ class BatchedSimulation:
         quantum = max(W // 8, 1)
         if s >= W // 2 > 0:
             s = W // 2
+        elif s >= W // 4 > 0:
+            s = W // 4
         elif s >= quantum:
             s = quantum
         else:

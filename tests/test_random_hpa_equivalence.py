@@ -170,3 +170,46 @@ def test_random_hpa_scale_down_identities_match_scalar(seed):
     assert removed_batched == removed_scalar, (
         f"seed {seed}\nscalar  {removed_scalar}\nbatched {removed_batched}"
     )
+
+
+def test_hpa_only_multi_node_cluster_runs():
+    """r4 regression: HPA-only configs (CA off) with MORE THAN ONE node
+    crashed at trace time — node_name_rank carried the CA slot-reserve
+    padding (+S) even when the engine appended no CA slots, and every
+    existing HPA-only batched test used a single node, where the size-1
+    node axis silently broadcast against the oversized rank array."""
+    config = default_test_simulation_config()
+    config.horizontal_pod_autoscaler.enabled = True
+    config.horizontal_pod_autoscaler.kube_horizontal_pod_autoscaler_config = (
+        KubeHorizontalPodAutoscalerConfig()
+    )
+    multi_node_cluster = """
+events:
+- timestamp: 5.0
+  event_type:
+    !CreateNode
+      node:
+        metadata: {name: node_00}
+        status: {capacity: {cpu: 64000, ram: 68719476736}}
+- timestamp: 5.0
+  event_type:
+    !CreateNode
+      node:
+        metadata: {name: node_01}
+        status: {capacity: {cpu: 64000, ram: 68719476736}}
+- timestamp: 5.0
+  event_type:
+    !CreateNode
+      node:
+        metadata: {name: node_02}
+        status: {capacity: {cpu: 64000, ram: 68719476736}}
+"""
+    batched = build_batched_from_traces(
+        config,
+        GenericClusterTrace.from_yaml(multi_node_cluster).convert_to_simulator_events(),
+        GenericWorkloadTrace.from_yaml(make_workload(17)).convert_to_simulator_events(),
+        n_clusters=2,
+    )
+    batched.step_until_time(700.0)
+    c = batched.metrics_summary()["counters"]
+    assert c["total_scaled_up_pods"] > 0, "HPA never acted"
