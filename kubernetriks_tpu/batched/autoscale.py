@@ -552,8 +552,22 @@ def _ca_scale_up(
         return (planned, plan_seq, palloc_cpu, palloc_ram, g_planned, total, counter), None
 
     carry0 = (planned0, plan_seq0, palloc_cpu0, palloc_ram0, g_planned0, total0, counter0)
-    (planned, _, _, _, g_planned, _, _), _ = jax.lax.scan(
-        body, carry0, (cvalid.T, creq_cpu.T, creq_ram.T)
+    # Early exit at the deepest lane's cache count: the bin-pack is
+    # sequential over K_up candidate positions, but typical caches hold a
+    # handful of pods — iterating all K_up steps cost ~K_up sequential
+    # (C, S) passes per due window.
+    k_bound = jnp.minimum(
+        jnp.max(cvalid.sum(axis=1, dtype=jnp.int32)), jnp.int32(K_up)
+    )
+
+    def loop_body(lcarry):
+        k, carry = lcarry
+        xs_k = (cvalid[:, k], creq_cpu[:, k], creq_ram[:, k])
+        carry, _ = body(carry, xs_k)
+        return (k + jnp.int32(1), carry)
+
+    _, (planned, _, _, _, g_planned, _, _) = jax.lax.while_loop(
+        lambda lc: lc[0] < k_bound, loop_body, (jnp.int32(0), carry0)
     )
     return planned, g_planned
 

@@ -928,8 +928,8 @@ class BatchedSimulation:
         # Quantize the shift to a SMALL set of values: every distinct s is a
         # distinct concatenate/refill shape, and each novel shape recompiles
         # the 17-leaf pytree concat (measured ~7 s per novel slide through
-        # the tunnel — 400x the actual window step). Two main shapes (W/2
-        # and W/8) plus small powers of two as the forced-minimal fallback;
+        # the tunnel — 400x the actual window step). Three main shapes (W/2,
+        # W/4, W/8) plus small powers of two as the forced-minimal fallback;
         # sliding less than possible is harmless — the capacity check just
         # triggers another slide sooner.
         quantum = max(W // 8, 1)
