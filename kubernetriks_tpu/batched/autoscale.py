@@ -61,6 +61,7 @@ total removals, keeping current = tail - head.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import NamedTuple, Tuple
 
 import jax
@@ -583,6 +584,10 @@ def _ca_scale_down(
     alloc_ram_v: jnp.ndarray,
     snap: TPair,
     interval,
+    use_pallas: bool = False,
+    pallas_interpret: bool = False,
+    pallas_mesh=None,
+    pallas_axis: str = "clusters",
 ):
     """Threshold + simulated-re-placement scale-down
     (reference: kube_cluster_autoscaler.rs:242-290). Returns
@@ -659,6 +664,69 @@ def _ca_scale_down(
     )
     col_k = jnp.arange(K_sd, dtype=jnp.int32)[None, :]
 
+    # Candidate walk order and liveness, shared by both paths: CA slots in
+    # node-name order, alive where allocated (the kernel derives its walk
+    # bound from cand_alive; the XLA path bounds its while_loop the same way).
+    slot_perm = jnp.take_along_axis(st.ca_slots, st.ca_sd_order, axis=1)
+    slotc_perm = jnp.clip(slot_perm, 0, N - 1)
+    cand_alive = (slot_perm >= 0) & nodes.alive[rows, slotc_perm]
+
+    if use_pallas:
+        from kubernetriks_tpu.ops.autoscale_kernel import fused_ca_scale_down
+
+        # Pre-gather the per-candidate pod tables in name order — cheap
+        # vectorized XLA gathers — so the kernel walks VMEM-resident tiles
+        # and never touches the (C, P) pod axis.
+        cnt_perm = jnp.where(
+            slot_perm >= 0, seg_count[rows, slotc_perm], 0
+        )
+        seg_pos = jnp.clip(seg_start[rows, slotc_perm], 0, P - 1)  # (C, S)
+        take = jnp.clip(
+            seg_pos[:, :, None] + jnp.arange(K_sd, dtype=jnp.int32)[None, None, :],
+            0,
+            P - 1,
+        ).reshape(C, S * K_sd)
+        pod_order = jnp.take_along_axis(porder, take, axis=1)  # (C, S*K)
+        pr_cpu = jnp.take_along_axis(pods.req_cpu, pod_order, axis=1)
+        pr_ram = jnp.take_along_axis(pods.req_ram, pod_order, axis=1)
+        pv0 = (
+            jnp.arange(K_sd, dtype=jnp.int32)[None, None, :]
+            < cnt_perm[:, :, None]
+        ).reshape(C, S * K_sd)
+        not_pending = is_inf(nodes.remove_time)
+        thresh = jnp.broadcast_to(
+            st.ca_threshold.astype(jnp.float32), (C,)
+        )[:, None]
+
+        core = partial(fused_ca_scale_down, k_sd=K_sd, interpret=pallas_interpret)
+        if pallas_mesh is not None:
+            from kubernetriks_tpu.batched.step import _shard_rowwise
+
+            core = _shard_rowwise(core, 15, 1, pallas_mesh, pallas_axis)
+        removed_perm = core(
+            branch[:, None],
+            thresh,
+            nodes.alive,
+            not_pending,
+            nodes.cap_cpu,
+            nodes.cap_ram,
+            alloc_cpu_v,
+            alloc_ram_v,
+            st.node_name_rank,
+            slot_perm,
+            cand_alive,
+            cnt_perm,
+            pr_cpu,
+            pr_ram,
+            pv0,
+        )
+        # Back from name-order positions to CA-slot indices (ca_sd_order is
+        # a permutation, so .set() touches each slot exactly once).
+        removed = (
+            jnp.zeros((C, S), bool).at[rows, st.ca_sd_order].set(removed_perm)
+        )
+        return _per_group(removed, st, rows, Gn)
+
     def outer(carry, s):
         valloc_cpu, valloc_ram = carry
         # The scalar walks candidates in NODE-NAME order (info.nodes is
@@ -689,7 +757,11 @@ def _ca_scale_down(
                 off=nodes.remove_time.off[rows1, slotc],
             )
         )
-        eligible = alive_here & not_pending & (util < st.ca_threshold)
+        # f32 compare on both sides: the Mosaic kernel path has no f64, so
+        # the XLA path casts the threshold down too — bit-identical paths.
+        eligible = alive_here & not_pending & (
+            util < st.ca_threshold.astype(jnp.float32)
+        )
 
         # Pods assigned to this node (storage assignments include in-flight
         # bindings, matching PHASE_RUNNING): the K_sd-slice of this node's
@@ -750,12 +822,8 @@ def _ca_scale_down(
     # permutation, so bound the walk by the LAST alive candidate's position
     # in permuted order (zero iterations before the first scale-up; dead /
     # unallocated slots inside the bound no-op through the alive_here gate).
-    slot_perm = jnp.take_along_axis(st.ca_slots, st.ca_sd_order, axis=1)
-    alive_perm = (slot_perm >= 0) & nodes.alive[
-        rows, jnp.clip(slot_perm, 0, N - 1)
-    ]
     iota_s = jnp.arange(S, dtype=jnp.int32)[None, :]
-    s_bound = jnp.max(jnp.where(alive_perm, iota_s + 1, 0)).astype(jnp.int32)
+    s_bound = jnp.max(jnp.where(cand_alive, iota_s + 1, 0)).astype(jnp.int32)
     _, _, _, removed = jax.lax.while_loop(
         lambda carry: carry[0] < s_bound,
         loop_body,
@@ -766,9 +834,15 @@ def _ca_scale_down(
             jnp.zeros((C, S), bool),
         ),
     )
+    return _per_group(removed, st, rows, Gn)
+
+
+def _per_group(removed, st, rows, Gn):
+    """(removed (C, S) bool, per-group removal counts (C, Gn)) — the
+    shared aggregation tail of both scale-down paths."""
     group_c = jnp.where(removed, st.ca_slot_group, Gn)
     removed_per_group = (
-        jnp.zeros((C, Gn + 1), jnp.int32)
+        jnp.zeros(group_c.shape[:1] + (Gn + 1,), jnp.int32)
         .at[rows, group_c]
         .add(removed.astype(jnp.int32))[:, :Gn]
     )
@@ -784,6 +858,10 @@ def ca_pass(
     K_up: int,
     K_sd: int,
     pre=None,
+    use_pallas: bool = False,
+    pallas_interpret: bool = False,
+    pallas_mesh=None,
+    pallas_axis: str = "clusters",
 ) -> Tuple[ClusterBatchState, AutoscaleState]:
     """One masked cluster-autoscaler cycle (scalar equivalent:
     cluster_autoscaler.py cycle; AUTO info policy: scale up iff the
@@ -855,6 +933,10 @@ def ca_pass(
         lambda: _ca_scale_down(
             state, auto, st, down_branch, K_sd,
             phase_v, alloc_cpu_v, alloc_ram_v, snap, interval,
+            use_pallas=use_pallas,
+            pallas_interpret=pallas_interpret,
+            pallas_mesh=pallas_mesh,
+            pallas_axis=pallas_axis,
         ),
         lambda: (jnp.zeros((C, S), bool), jnp.zeros((C, Gn), jnp.int32)),
     )

@@ -122,7 +122,8 @@ def _shard_rowwise(core, n_in: int, n_out: int, mesh, axis: str):
         core,
         mesh=mesh,
         in_specs=(row,) * n_in,
-        out_specs=(row,) * n_out,
+        # A kernel returning one bare array (not a 1-tuple) needs a bare spec.
+        out_specs=(row,) * n_out if n_out > 1 else row,
         check_vma=False,
     )
 
@@ -1319,9 +1320,15 @@ def _window_body(
         # (the scalar snapshot lands between cycles; SURVEY.md §3.5); their
         # effects land at composed future times via the pending-effect arrays.
         from kubernetriks_tpu.batched.autoscale import ca_pass, hpa_pass
+        from kubernetriks_tpu.ops.autoscale_kernel import ca_down_kernel_fits
 
         auto = state.auto
         state, auto = hpa_pass(state, auto, autoscale_statics, W, consts)
+        ca_kernel_on = use_pallas and ca_down_kernel_fits(
+            state.nodes.alive.shape[1],
+            autoscale_statics.ca_slots.shape[1],
+            max_pods_per_scale_down,
+        )
         state, auto = ca_pass(
             state,
             auto,
@@ -1331,6 +1338,10 @@ def _window_body(
             max_ca_pods_per_cycle,
             max_pods_per_scale_down,
             pre=pre_cycle,
+            use_pallas=ca_kernel_on,
+            pallas_interpret=pallas_interpret,
+            pallas_mesh=pallas_mesh,
+            pallas_axis=pallas_axis,
         )
         state = state._replace(auto=auto)
     return state

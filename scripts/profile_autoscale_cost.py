@@ -1,0 +1,129 @@
+"""Attribute the composed-path window cost to the autoscaler passes.
+
+Times steady-state window stepping on the attached chip for the composed
+bench scenario (bench.py run_composed) across pod-axis sizes P, in three
+variants at each P:
+  - auto  : HPA + CA enabled (the composed configuration)
+  - noauto: identical trace/shapes with autoscalers disabled in config
+            (autoscale_statics=None -> no hpa_pass/ca_pass in the step)
+The (auto - noauto) delta at each P is the autoscaler-pass cost and its
+scaling with the device pod axis — the round-5 target named in
+docs/DESIGN.md §2.
+
+Usage: python scripts/profile_autoscale_cost.py [P ...]
+"""
+
+import sys
+import time
+
+import numpy as np
+
+
+def build(pod_window, autoscalers):
+    from kubernetriks_tpu.batched.engine import build_batched_from_traces
+    from kubernetriks_tpu.config import SimulationConfig
+    from kubernetriks_tpu.trace.generator import (
+        PoissonWorkloadTrace,
+        UniformClusterTrace,
+    )
+    from kubernetriks_tpu.trace.generic import GenericWorkloadTrace
+
+    auto_yaml = (
+        """
+horizontal_pod_autoscaler:
+  enabled: true
+cluster_autoscaler:
+  enabled: true
+  scan_interval: 10.0
+  max_node_count: 32
+  node_groups:
+  - node_template:
+      metadata: {name: ca_node}
+      status: {capacity: {cpu: 64000, ram: 137438953472}}
+"""
+        if autoscalers
+        else ""
+    )
+    config = SimulationConfig.from_yaml(
+        "sim_name: prof\nseed: 1\nscheduling_cycle_interval: 10.0\n" + auto_yaml
+    )
+    cluster = UniformClusterTrace(32, cpu=64000, ram=128 * 1024**3)
+    plain = PoissonWorkloadTrace(
+        rate_per_second=1.5,
+        horizon=1000.0,
+        seed=3,
+        cpu=16000,
+        ram=32 * 1024**3,
+        duration_range=(30.0, 120.0),
+        name_prefix="plain",
+    )
+    workload = plain.convert_to_simulator_events()
+    if autoscalers:
+        group = GenericWorkloadTrace.from_yaml(
+            """
+events:
+- timestamp: 49.5
+  event_type:
+    !CreatePodGroup
+      pod_group:
+        name: grp
+        initial_pod_count: 8
+        max_pod_count: 64
+        pod_template:
+          metadata: {name: grp}
+          spec:
+            resources:
+              requests: {cpu: 8000, ram: 17179869184}
+              limits: {cpu: 8000, ram: 17179869184}
+        target_resources_usage: {cpu_utilization: 0.5}
+        resources_usage_model_config:
+          cpu_config:
+            model_name: pod_group
+            config: |
+              - duration: 300.0
+                total_load: 4.0
+              - duration: 300.0
+                total_load: 24.0
+              - duration: 400.0
+                total_load: 2.0
+"""
+        ).convert_to_simulator_events()
+        workload = sorted(workload + group, key=lambda e: e[0])
+    return build_batched_from_traces(
+        config,
+        cluster.convert_to_simulator_events(),
+        workload,
+        n_clusters=256,
+        max_pods_per_cycle=64,
+        pod_window=pod_window,
+        use_pallas=True,
+    )
+
+
+def measure(pod_window, autoscalers):
+    sim = build(pod_window, autoscalers)
+    sim.step_until_time(590.0)  # warm: HPA burst + slides compiled
+    _ = int(np.asarray(sim.state.metrics.scheduling_decisions).sum())
+    t0 = time.perf_counter()
+    end = 790.0
+    while end <= 1200.0:
+        sim.step_until_time(end)
+        end += 200.0
+    decisions = int(np.asarray(sim.state.metrics.scheduling_decisions).sum())
+    dt = time.perf_counter() - t0
+    n_windows = (1200 - 590) / 10.0
+    return dt / n_windows * 1e3, decisions  # ms/window
+
+
+def main():
+    ps = [int(a) for a in sys.argv[1:]] or [512, 1024, 2048, None]
+    print(f"{'P':>8} {'auto ms/win':>12} {'noauto ms/win':>14} {'delta':>8}")
+    for p in ps:
+        a, _ = measure(p, True)
+        b, _ = measure(p, False)
+        label = p if p is not None else "resident"
+        print(f"{label!s:>8} {a:12.2f} {b:14.2f} {a - b:8.2f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,115 @@
+"""Micro-attribution of the autoscaler-pass window cost.
+
+Builds the composed profile scenario, steps to steady state, captures the
+live state, then times jitted hpa_pass / ca_pass (and their due vs
+not-due branches) in isolation on the chip.
+
+Usage: python scripts/profile_autoscale_micro.py [pod_window]
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from profile_autoscale_cost import build
+
+
+def timeit(f, *args, n=30):
+    out = f(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = f(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / n * 1e3
+
+
+def main():
+    from kubernetriks_tpu.batched.autoscale import (
+        _ca_scale_down,
+        _ca_scale_up,
+        ca_pass,
+        hpa_pass,
+    )
+    from kubernetriks_tpu.batched.timerep import TPair, t_add, t_le, t_lt
+
+    pod_window = int(sys.argv[1]) if len(sys.argv) > 1 else 512
+    sim = build(pod_window, True)
+    sim.step_until_time(600.0)
+    jax.block_until_ready(sim.state.time)
+
+    state = sim.state
+    auto = state.auto
+    st = sim.autoscale_statics
+    consts = sim.consts
+    C = state.pods.phase.shape[0]
+    K_up, K_sd = sim.max_ca_pods_per_cycle, sim.max_pods_per_scale_down
+    print(
+        f"shapes: C={C} P={state.pods.phase.shape[1]} "
+        f"N={state.nodes.alive.shape[1]} S={st.ca_slots.shape[1]} "
+        f"K_up={K_up} K_sd={K_sd}"
+    )
+
+    # Window indices: one where CA is due, one where it is not.
+    interval = float(np.asarray(consts.scheduling_interval))
+    snap = t_add(auto.ca_next, st.ca_snap, jnp.float32(interval))
+    w_due = int(np.asarray(snap.win).max())
+    # A window where NOTHING is due: before every next tick.
+    w_before = int(np.asarray(snap.win).min()) - 2
+    print(f"w_due={w_due} w_before={w_before}")
+
+    mkW = lambda w: jnp.full((C,), w, jnp.int32)
+
+    hpa_j = jax.jit(lambda s, a, W: hpa_pass(s, a, st, W, consts))
+    pre = (
+        state.pods.phase,
+        state.pods.attempts,
+        state.nodes.alloc_cpu,
+        state.nodes.alloc_ram,
+    )
+    ca_j = jax.jit(
+        lambda s, a, W: ca_pass(s, a, st, W, consts, K_up, K_sd, pre=pre)
+    )
+    ca_k = jax.jit(
+        lambda s, a, W: ca_pass(
+            s, a, st, W, consts, K_up, K_sd, pre=pre, use_pallas=True
+        )
+    )
+
+    print(f"hpa_pass due      : {timeit(hpa_j, state, auto, mkW(w_due)):8.3f} ms")
+    print(f"hpa_pass not due  : {timeit(hpa_j, state, auto, mkW(w_before)):8.3f} ms")
+    print(f"ca_pass  due      : {timeit(ca_j, state, auto, mkW(w_due)):8.3f} ms")
+    print(f"ca_pass  not due  : {timeit(ca_j, state, auto, mkW(w_before)):8.3f} ms")
+    print(f"ca_pass kern due  : {timeit(ca_k, state, auto, mkW(w_due)):8.3f} ms")
+    print(f"ca_pass kern !due : {timeit(ca_k, state, auto, mkW(w_before)):8.3f} ms")
+
+    # Direct bodies (no cond wrapper).
+    branch = jnp.ones((C,), bool)
+    up_j = jax.jit(
+        lambda s, a: _ca_scale_up(
+            s, a, st, branch, K_up, s.pods.phase, s.pods.attempts
+        )
+    )
+    snap_pair = TPair(
+        win=jnp.full((C,), w_due, jnp.int32), off=jnp.zeros((C,), jnp.float32)
+    )
+    down_j = jax.jit(
+        lambda s, a: _ca_scale_down(
+            s, a, st, branch, K_sd,
+            s.pods.phase, s.nodes.alloc_cpu, s.nodes.alloc_ram,
+            snap_pair, jnp.float32(interval),
+        )
+    )
+    print(f"_ca_scale_up body : {timeit(up_j, state, auto):8.3f} ms")
+    print(f"_ca_scale_down bod: {timeit(down_j, state, auto):8.3f} ms")
+
+    n_ca = int(np.asarray(auto.ca_count).sum())
+    ph = np.asarray(state.pods.phase)
+    print(f"live CA nodes total={n_ca}, unsched={(ph == 3).sum()}")
+
+
+if __name__ == "__main__":
+    main()
