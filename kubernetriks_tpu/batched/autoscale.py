@@ -456,6 +456,10 @@ def _ca_scale_up(
     K_up: int,
     phase_v: jnp.ndarray,
     attempts_v: jnp.ndarray,
+    use_pallas: bool = False,
+    pallas_interpret: bool = False,
+    pallas_mesh=None,
+    pallas_axis: str = "clusters",
 ):
     """Bin-packing scale-up over the unscheduled-pod cache
     (reference: kube_cluster_autoscaler.rs:190-240). Returns
@@ -492,6 +496,33 @@ def _ca_scale_up(
     cvalid = in_cache[rows, order] & branch[:, None]
     creq_cpu = pods.req_cpu[rows, order]
     creq_ram = pods.req_ram[rows, order]
+
+    from kubernetriks_tpu.ops.autoscale_kernel import (
+        ca_up_kernel_fits,
+        fused_ca_scale_up,
+    )
+
+    if use_pallas and ca_up_kernel_fits(S, Gn, K_up):
+        core = partial(
+            fused_ca_scale_up, n_slots=S, interpret=pallas_interpret
+        )
+        if pallas_mesh is not None:
+            from kubernetriks_tpu.batched.step import _shard_rowwise
+
+            core = _shard_rowwise(core, 11, 2, pallas_mesh, pallas_axis)
+        return core(
+            st.ca_max_nodes[:, None],
+            auto.ca_count,
+            auto.ca_cursor,
+            st.ng_max_count,
+            st.ng_slot_count,
+            st.ng_tmpl_cpu,
+            st.ng_tmpl_ram,
+            st.ng_ca_start,
+            cvalid,
+            creq_cpu,
+            creq_ram,
+        )
 
     planned0 = jnp.zeros((C, S), bool)
     plan_seq0 = jnp.full((C, S), _BIG_I32, jnp.int32)
@@ -671,9 +702,12 @@ def _ca_scale_down(
     slotc_perm = jnp.clip(slot_perm, 0, N - 1)
     cand_alive = (slot_perm >= 0) & nodes.alive[rows, slotc_perm]
 
-    if use_pallas:
-        from kubernetriks_tpu.ops.autoscale_kernel import fused_ca_scale_down
+    from kubernetriks_tpu.ops.autoscale_kernel import (
+        ca_down_kernel_fits,
+        fused_ca_scale_down,
+    )
 
+    if use_pallas and ca_down_kernel_fits(N, S, K_sd):
         # Pre-gather the per-candidate pod tables in name order — cheap
         # vectorized XLA gathers — so the kernel walks VMEM-resident tiles
         # and never touches the (C, P) pod axis.
@@ -923,7 +957,13 @@ def ca_pass(
     Gn = st.ng_ca_start.shape[1]
     planned, planned_per_group = jax.lax.cond(
         up_branch.any(),
-        lambda: _ca_scale_up(state, auto, st, up_branch, K_up, phase_v, attempts_v),
+        lambda: _ca_scale_up(
+            state, auto, st, up_branch, K_up, phase_v, attempts_v,
+            use_pallas=use_pallas,
+            pallas_interpret=pallas_interpret,
+            pallas_mesh=pallas_mesh,
+            pallas_axis=pallas_axis,
+        ),
         lambda: (jnp.zeros((C, S), bool), jnp.zeros((C, Gn), jnp.int32)),
     )
     removed, removed_per_group = jax.lax.cond(

@@ -1320,15 +1320,9 @@ def _window_body(
         # (the scalar snapshot lands between cycles; SURVEY.md §3.5); their
         # effects land at composed future times via the pending-effect arrays.
         from kubernetriks_tpu.batched.autoscale import ca_pass, hpa_pass
-        from kubernetriks_tpu.ops.autoscale_kernel import ca_down_kernel_fits
 
         auto = state.auto
         state, auto = hpa_pass(state, auto, autoscale_statics, W, consts)
-        ca_kernel_on = use_pallas and ca_down_kernel_fits(
-            state.nodes.alive.shape[1],
-            autoscale_statics.ca_slots.shape[1],
-            max_pods_per_scale_down,
-        )
         state, auto = ca_pass(
             state,
             auto,
@@ -1338,7 +1332,8 @@ def _window_body(
             max_ca_pods_per_cycle,
             max_pods_per_scale_down,
             pre=pre_cycle,
-            use_pallas=ca_kernel_on,
+            # Each CA kernel gates on its own VMEM fits-check inside.
+            use_pallas=use_pallas,
             pallas_interpret=pallas_interpret,
             pallas_mesh=pallas_mesh,
             pallas_axis=pallas_axis,

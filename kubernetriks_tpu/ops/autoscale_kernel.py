@@ -42,9 +42,9 @@ _VMEM_LIMIT = 100 * 1024 * 1024
 
 
 def ca_down_kernel_fits(n_nodes: int, n_slots: int, k_sd: int) -> bool:
-    """VMEM fits-check: 9 node tiles (7 in + 2 out working allocatables),
-    4 slot tiles, 3 (S*K) pod tables, meta — double-buffered by Mosaic,
-    ~40% headroom against the raised scoped limit."""
+    """VMEM fits-check: 9 node tiles (7 in + 2 scratch working
+    allocatables), 4 slot tiles, 3 (S*K) pod tables, meta — double-buffered
+    by Mosaic, ~40% headroom against the raised scoped limit."""
     np_pad = -(-n_nodes // _SUB) * _SUB
     sp_pad = -(-n_slots // _SUB) * _SUB
     skp = -(-(n_slots * k_sd) // _SUB) * _SUB
@@ -69,8 +69,8 @@ def _ca_down_kernel(
     prr_ref,         # (SKp, LC) int32 pod req ram
     pv0_ref,         # (SKp, LC) int32 0/1 pod-slot valid (k < cnt)
     removed_out,     # (Sp, LC) int32
-    vcpu_out,        # (Np, LC) int32 (working space; caller discards)
-    vram_out,        # (Np, LC) int32
+    vcpu_s,          # (Np, LC) int32 VMEM scratch: working virtual allocatable
+    vram_s,          # (Np, LC) int32 VMEM scratch
 ):
     i0 = jnp.int32(0)
     i1 = jnp.int32(1)
@@ -83,8 +83,8 @@ def _ca_down_kernel(
 
     alive = alive_ref[:] != i0  # (Np, LC)
     iota_n = jax.lax.broadcasted_iota(jnp.int32, alive.shape, 0)
-    vcpu_out[:] = vcpu_ref[:]
-    vram_out[:] = vram_ref[:]
+    vcpu_s[:] = vcpu_ref[:]
+    vram_s[:] = vram_ref[:]
     removed_out[:] = jnp.zeros_like(removed_out)
 
     # Walk bound: position after the LAST alive candidate in name order
@@ -105,10 +105,10 @@ def _ca_down_kernel(
         cap_c = jnp.max(ohi * cap_cpu_ref[:], axis=0, keepdims=True)
         cap_r = jnp.max(ohi * cap_ram_ref[:], axis=0, keepdims=True)
         vc_at = jnp.max(
-            jnp.where(oh, vcpu_out[:], -bigi), axis=0, keepdims=True
+            jnp.where(oh, vcpu_s[:], -bigi), axis=0, keepdims=True
         )
         vr_at = jnp.max(
-            jnp.where(oh, vram_out[:], -bigi), axis=0, keepdims=True
+            jnp.where(oh, vram_s[:], -bigi), axis=0, keepdims=True
         )
         used_c = (cap_c - vc_at).astype(jnp.float32)
         used_r = (cap_r - vr_at).astype(jnp.float32)
@@ -120,8 +120,8 @@ def _ca_down_kernel(
         cnt = cnt_ref[pl.ds(s, 1), :]  # (1, LC)
         attempt = eligible & (cnt <= Ki)  # overflow: conservatively skip
 
-        vc = vcpu_out[:]
-        vr = vram_out[:]
+        vc = vcpu_s[:]
+        vr = vram_s[:]
         ok = attempt
         for k in range(k_sd):  # static unroll; K_sd is small (default 8)
             row = pl.ds(s * Ki + jnp.int32(k), 1)
@@ -149,8 +149,8 @@ def _ca_down_kernel(
         # Commit on success, roll back otherwise; commits persist across
         # later candidates (reference :141-156).
         success = ok  # attempt folded in at init
-        vcpu_out[:] = jnp.where(success, vc, vcpu_out[:])
-        vram_out[:] = jnp.where(success, vr, vram_out[:])
+        vcpu_s[:] = jnp.where(success, vc, vcpu_s[:])
+        vram_s[:] = jnp.where(success, vr, vram_s[:])
         removed_out[pl.ds(s, 1), :] = success.astype(jnp.int32)
 
     def loop_body(s):
@@ -232,15 +232,15 @@ def fused_ca_scale_down(
     sk_spec = pl.BlockSpec((SKp, _LANE), lambda i: (0, i), memory_space=pltpu.VMEM)
 
     with jax.enable_x64(False):
-        removed_o, _, _ = pl.pallas_call(
+        removed_o = pl.pallas_call(
             functools.partial(_ca_down_kernel, k_sd),
             grid=(Cp // _LANE,),
             in_specs=[meta_spec] + [node_spec] * 7 + [slot_spec] * 3 + [sk_spec] * 3,
-            out_specs=[slot_spec, node_spec, node_spec],
-            out_shape=[
-                jax.ShapeDtypeStruct((Sp, Cp), jnp.int32),
-                jax.ShapeDtypeStruct((Np, Cp), jnp.int32),
-                jax.ShapeDtypeStruct((Np, Cp), jnp.int32),
+            out_specs=slot_spec,
+            out_shape=jax.ShapeDtypeStruct((Sp, Cp), jnp.int32),
+            scratch_shapes=[
+                pltpu.VMEM((Np, _LANE), jnp.int32),
+                pltpu.VMEM((Np, _LANE), jnp.int32),
             ],
             compiler_params=pltpu.CompilerParams(
                 vmem_limit_bytes=_VMEM_LIMIT
@@ -249,3 +249,195 @@ def fused_ca_scale_down(
         )(*args)
 
     return removed_o[:S, :C].T != 0
+
+
+def ca_up_kernel_fits(n_slots: int, n_groups: int, k_up: int) -> bool:
+    """VMEM fits-check for the scale-up kernel: 3 slot tiles (planned +
+    2 working allocatables) + plan_seq scratch, 8 group tiles, 3 (K_up)
+    candidate tables, meta — double-buffered, ~40% headroom."""
+    sp_pad = -(-n_slots // _SUB) * _SUB
+    gp_pad = -(-n_groups // _SUB) * _SUB
+    kp_pad = -(-k_up // _SUB) * _SUB
+    resident = (4 * sp_pad + 8 * gp_pad + 3 * kp_pad + 2 * _SUB) * _LANE * 4
+    return 2 * resident <= int(0.8 * _VMEM_LIMIT)
+
+
+def _ca_up_kernel(
+    meta_ref,      # (8, LC) int32: row0 ca_max_nodes
+    count_ref,     # (Gp, LC) int32 live CA nodes per group
+    cursor_ref,    # (Gp, LC) int32 next reserved slot offset per group
+    gmax_ref,      # (Gp, LC) int32 group max count (<0 unbounded; pad 0)
+    gslots_ref,    # (Gp, LC) int32 reserved slots per group (pad 0)
+    tmplc_ref,     # (Gp, LC) int32 template cpu
+    tmplr_ref,     # (Gp, LC) int32 template ram
+    gstart_ref,    # (Gp, LC) int32 first CA slot of group
+    cvalid_ref,    # (Kp, LC) int32 0/1 cache candidate valid (a prefix)
+    crc_ref,       # (Kp, LC) int32 candidate req cpu
+    crr_ref,       # (Kp, LC) int32 candidate req ram
+    planned_out,   # (Sp, LC) int32
+    gpl_out,       # (Gp, LC) int32 planned per group
+    seq_ref,       # (Sp, LC) int32 scratch: plan order
+    pcpu_ref,      # (Sp, LC) int32 scratch: virtual allocatable cpu
+    pram_ref,      # (Sp, LC) int32 scratch: virtual allocatable ram
+    scal_ref,      # (8, LC) int32 scratch: row0 total, row1 counter
+):
+    """First-fit bin-packing scale-up over the name-ordered unscheduled
+    cache (reference: kube_cluster_autoscaler.rs:190-240), one in-kernel
+    loop instead of the XLA while_loop's K_up sequential (C, S) passes.
+    Same decision order as the XLA body: fit into already-planned nodes in
+    plan order, else open a node from the FIRST group that accepts the pod
+    (min-index over the eligibility mask == lax.argmax over bool); the new
+    node joins at FULL template allocatable (the triggering pod is NOT
+    packed into it — reference quirk, kube_cluster_autoscaler.rs:210-218)."""
+    i0 = jnp.int32(0)
+    i1 = jnp.int32(1)
+    bigi = jnp.int32(_BIG_I32)
+
+    planned_out[:] = jnp.zeros_like(planned_out)
+    gpl_out[:] = jnp.zeros_like(gpl_out)
+    seq_ref[:] = jnp.zeros_like(seq_ref) + bigi
+    pcpu_ref[:] = jnp.zeros_like(pcpu_ref)
+    pram_ref[:] = jnp.zeros_like(pram_ref)
+    scal_ref[:] = jnp.zeros_like(scal_ref)
+    # total0 = live CA nodes, ALL groups (max_node_count bounds CA-owned
+    # nodes only — reference quirk, kube_cluster_autoscaler.rs:62-80).
+    scal_ref[0:1, :] = jnp.sum(count_ref[:], axis=0, keepdims=True)
+
+    max_nodes = meta_ref[0:1, :]
+    iota_s = jax.lax.broadcasted_iota(jnp.int32, planned_out.shape, 0)
+    iota_g = jax.lax.broadcasted_iota(jnp.int32, gpl_out.shape, 0)
+
+    # Candidates are a per-lane prefix of the name-ordered cache sort, so
+    # the deepest lane's count bounds the loop (same as the XLA k_bound).
+    k_bound = jnp.max(jnp.sum(cvalid_ref[:], axis=0, keepdims=True))
+
+    def candidate(k):
+        row = pl.ds(k, 1)
+        valid = cvalid_ref[row, :] != i0  # (1, LC)
+        rc = crc_ref[row, :]
+        rr = crr_ref[row, :]
+
+        # First-fit into already-planned nodes, in plan (seq) order.
+        fit = (
+            (planned_out[:] != i0)
+            & (rc <= pcpu_ref[:])
+            & (rr <= pram_ref[:])
+        )
+        minseq = jnp.min(jnp.where(fit, seq_ref[:], bigi), axis=0, keepdims=True)
+        any_fit = minseq < bigi
+        use = valid & any_fit
+        place = fit & (seq_ref[:] == minseq) & use
+        pcpu_ref[:] = pcpu_ref[:] - jnp.where(place, rc, i0)
+        pram_ref[:] = pram_ref[:] - jnp.where(place, rr, i0)
+
+        # Else open a node from the first fitting group. Padding group rows
+        # have gslots == 0, so cursor + gpl < gslots excludes them.
+        total = scal_ref[0:1, :]
+        counter = scal_ref[1:2, :]
+        can_open = valid & ~any_fit & (total < max_nodes)
+        gcount = count_ref[:] + gpl_out[:]
+        g_ok = (
+            ((gmax_ref[:] < i0) | (gcount < gmax_ref[:]))
+            & (cursor_ref[:] + gpl_out[:] < gslots_ref[:])
+            & (rc <= tmplc_ref[:])
+            & (rr <= tmplr_ref[:])
+        )
+        first_g = jnp.min(jnp.where(g_ok, iota_g, bigi), axis=0, keepdims=True)
+        open_ = can_open & (first_g < bigi)
+        g_oh = (iota_g == first_g) & open_  # (Gp, LC)
+        g_ohi = g_oh.astype(jnp.int32)
+        s_new = jnp.sum(
+            g_ohi * (gstart_ref[:] + cursor_ref[:] + gpl_out[:]),
+            axis=0,
+            keepdims=True,
+        )
+        tc = jnp.sum(g_ohi * tmplc_ref[:], axis=0, keepdims=True)
+        tr = jnp.sum(g_ohi * tmplr_ref[:], axis=0, keepdims=True)
+        s_oh = (iota_s == s_new) & open_  # (Sp, LC)
+        planned_out[:] = jnp.where(s_oh, i1, planned_out[:])
+        seq_ref[:] = jnp.where(s_oh, counter, seq_ref[:])
+        pcpu_ref[:] = jnp.where(s_oh, tc, pcpu_ref[:])
+        pram_ref[:] = jnp.where(s_oh, tr, pram_ref[:])
+        gpl_out[:] = gpl_out[:] + g_ohi
+        opi = open_.astype(jnp.int32)
+        scal_ref[0:1, :] = total + opi
+        scal_ref[1:2, :] = counter + opi
+
+    def loop_body(k):
+        candidate(k)
+        return k + i1
+
+    jax.lax.while_loop(lambda k: k < k_bound, loop_body, jnp.int32(0))
+
+
+@functools.partial(jax.jit, static_argnames=("n_slots", "interpret"))
+def fused_ca_scale_up(
+    max_nodes: jnp.ndarray,  # (C, 1) int32 global CA node quota
+    ca_count: jnp.ndarray,   # (C, Gn) int32
+    ca_cursor: jnp.ndarray,  # (C, Gn) int32
+    ng_max: jnp.ndarray,     # (C, Gn) int32 (<0 unbounded)
+    ng_slots: jnp.ndarray,   # (C, Gn) int32
+    ng_tmpl_cpu: jnp.ndarray,  # (C, Gn) int32
+    ng_tmpl_ram: jnp.ndarray,  # (C, Gn) int32
+    ng_start: jnp.ndarray,   # (C, Gn) int32
+    cvalid: jnp.ndarray,     # (C, K) bool/int32
+    creq_cpu: jnp.ndarray,   # (C, K) int32
+    creq_ram: jnp.ndarray,   # (C, K) int32
+    n_slots: int = 0,
+    interpret: bool = False,
+):
+    """Returns (planned (C, S) bool, planned_per_group (C, Gn) int32)."""
+    C, Gn = ca_count.shape
+    K = cvalid.shape[1]
+    S = n_slots
+    Cp = -(-C // _LANE) * _LANE
+    Sp = -(-S // _SUB) * _SUB
+    Gp = -(-Gn // _SUB) * _SUB
+    Kp = -(-K // _SUB) * _SUB
+
+    def prep(x, n_sub, fill):
+        return _pad_axis(_pad_axis(x.T, 0, n_sub, fill), 1, Cp, fill)
+
+    meta_p = prep(max_nodes.astype(jnp.int32), _SUB, 0)
+    args = (
+        meta_p,
+        prep(ca_count.astype(jnp.int32), Gp, 0),
+        prep(ca_cursor.astype(jnp.int32), Gp, 0),
+        prep(ng_max.astype(jnp.int32), Gp, 0),
+        prep(ng_slots.astype(jnp.int32), Gp, 0),
+        prep(ng_tmpl_cpu.astype(jnp.int32), Gp, 0),
+        prep(ng_tmpl_ram.astype(jnp.int32), Gp, 0),
+        prep(ng_start.astype(jnp.int32), Gp, 0),
+        prep(cvalid.astype(jnp.int32), Kp, 0),
+        prep(creq_cpu.astype(jnp.int32), Kp, 0),
+        prep(creq_ram.astype(jnp.int32), Kp, 0),
+    )
+
+    meta_spec = pl.BlockSpec((_SUB, _LANE), lambda i: (0, i), memory_space=pltpu.VMEM)
+    slot_spec = pl.BlockSpec((Sp, _LANE), lambda i: (0, i), memory_space=pltpu.VMEM)
+    group_spec = pl.BlockSpec((Gp, _LANE), lambda i: (0, i), memory_space=pltpu.VMEM)
+    k_spec = pl.BlockSpec((Kp, _LANE), lambda i: (0, i), memory_space=pltpu.VMEM)
+
+    with jax.enable_x64(False):
+        planned_o, gpl_o = pl.pallas_call(
+            _ca_up_kernel,
+            grid=(Cp // _LANE,),
+            in_specs=[meta_spec] + [group_spec] * 7 + [k_spec] * 3,
+            out_specs=[slot_spec, group_spec],
+            out_shape=[
+                jax.ShapeDtypeStruct((Sp, Cp), jnp.int32),
+                jax.ShapeDtypeStruct((Gp, Cp), jnp.int32),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((Sp, _LANE), jnp.int32),
+                pltpu.VMEM((Sp, _LANE), jnp.int32),
+                pltpu.VMEM((Sp, _LANE), jnp.int32),
+                pltpu.VMEM((_SUB, _LANE), jnp.int32),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                vmem_limit_bytes=_VMEM_LIMIT
+            ),
+            interpret=interpret,
+        )(*args)
+
+    return planned_o[:S, :C].T != 0, gpl_o[:Gn, :C].T

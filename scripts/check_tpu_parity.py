@@ -81,6 +81,100 @@ def main() -> int:
         f"OK: Mosaic selection+candidate kernels == scan path over "
         f"{decisions} decisions (state exact, metrics within ulp)"
     )
+
+    # The CA autoscaler kernels (ops/autoscale_kernel.py): a composed
+    # HPA+CA churn scenario with the kernels compiled by Mosaic ON THE CHIP
+    # must equal the XLA while_loop walks bit-for-bit. The sliding pod
+    # window (device-resident slide path) rides along.
+    from kubernetriks_tpu.trace.generic import GenericWorkloadTrace
+
+    auto_config = SimulationConfig.from_yaml(
+        """
+sim_name: tpu_parity_auto
+seed: 9
+scheduling_cycle_interval: 10.0
+horizontal_pod_autoscaler:
+  enabled: true
+cluster_autoscaler:
+  enabled: true
+  scan_interval: 10.0
+  max_node_count: 24
+  node_groups:
+  - node_template:
+      metadata: {name: ca_node}
+      status: {capacity: {cpu: 16000, ram: 34359738368}}
+"""
+    )
+    group = GenericWorkloadTrace.from_yaml(
+        """
+events:
+- timestamp: 19.5
+  event_type:
+    !CreatePodGroup
+      pod_group:
+        name: grp
+        initial_pod_count: 2
+        max_pod_count: 16
+        pod_template:
+          metadata: {name: grp}
+          spec:
+            resources:
+              requests: {cpu: 3000, ram: 6442450944}
+              limits: {cpu: 3000, ram: 6442450944}
+        target_resources_usage: {cpu_utilization: 0.5}
+        resources_usage_model_config:
+          cpu_config:
+            model_name: pod_group
+            config: |
+              - duration: 120.0
+                total_load: 1.0
+              - duration: 120.0
+                total_load: 7.0
+              - duration: 160.0
+                total_load: 0.5
+"""
+    ).convert_to_simulator_events()
+    churn = PoissonWorkloadTrace(
+        rate_per_second=1.0, horizon=400.0, seed=13, cpu=4000,
+        ram=8 * 1024**3, duration_range=(20.0, 90.0), name_prefix="plain",
+    ).convert_to_simulator_events()
+    auto_workload = sorted(churn + group, key=lambda e: e[0])
+    auto_cluster = UniformClusterTrace(
+        8, cpu=16000, ram=32 * 1024**3
+    ).convert_to_simulator_events()
+
+    def build_auto(pallas):
+        return build_batched_from_traces(
+            auto_config,
+            auto_cluster,
+            auto_workload,
+            n_clusters=256,
+            max_pods_per_cycle=16,
+            pod_window=256,
+            use_pallas=pallas,
+        )
+
+    xla_sim = build_auto(False)
+    ker_sim = build_auto(True)
+    for sim in (xla_sim, ker_sim):
+        sim.step_until_time(600.0)
+        jax.block_until_ready(sim.state.time)
+    bad = compare_states(xla_sim.state, ker_sim.state)
+    for key in bad:
+        print(f"MISMATCH (CA kernels) at {key}")
+    counters = xla_sim.metrics_summary()["counters"]
+    if bad:
+        print(f"FAIL: CA kernels: {len(bad)} mismatching leaves")
+        return 1
+    assert counters["total_scaled_up_nodes"] > 0, "CA never scaled up"
+    assert counters["total_scaled_down_nodes"] > 0, "CA never scaled down"
+    assert xla_sim._pod_base > 0, "pod window never slid"
+    print(
+        f"OK: Mosaic CA scale-up/scale-down kernels == XLA walks "
+        f"({counters['total_scaled_up_nodes']} node scale-ups, "
+        f"{counters['total_scaled_down_nodes']} scale-downs, "
+        f"sliding window active)"
+    )
     return 0
 
 

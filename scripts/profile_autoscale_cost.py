@@ -13,8 +13,11 @@ docs/DESIGN.md §2.
 Usage: python scripts/profile_autoscale_cost.py [P ...]
 """
 
+import os
 import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
@@ -111,7 +114,7 @@ def measure(pod_window, autoscalers):
         end += 200.0
     decisions = int(np.asarray(sim.state.metrics.scheduling_decisions).sum())
     dt = time.perf_counter() - t0
-    n_windows = (1200 - 590) / 10.0
+    n_windows = (1190 - 590) / 10.0  # timed loop ends at 1190 (1390 > 1200)
     return dt / n_windows * 1e3, decisions  # ms/window
 
 

@@ -7,8 +7,12 @@ not-due branches) in isolation on the chip.
 Usage: python scripts/profile_autoscale_micro.py [pod_window]
 """
 
+import os
 import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import jax
 import jax.numpy as jnp

@@ -540,12 +540,20 @@ events:
         # Pin the test to the kernel path: if the fits-heuristic ever says
         # no at these shapes, this test degrades to ref-vs-ref and proves
         # nothing — fail loudly instead.
-        from kubernetriks_tpu.ops.autoscale_kernel import ca_down_kernel_fits
+        from kubernetriks_tpu.ops.autoscale_kernel import (
+            ca_down_kernel_fits,
+            ca_up_kernel_fits,
+        )
 
         assert ca_down_kernel_fits(
             ker.state.nodes.alive.shape[1],
             ker.autoscale_statics.ca_slots.shape[1],
             ker.max_pods_per_scale_down,
+        )
+        assert ca_up_kernel_fits(
+            ker.autoscale_statics.ca_slots.shape[1],
+            ker.autoscale_statics.ng_ca_start.shape[1],
+            ker.max_ca_pods_per_cycle,
         )
         for until in (100.0, 250.0, 500.0):
             ref.step_until_time(until)
