@@ -974,11 +974,16 @@ class BatchedSimulation:
             if sub >= target:
                 return
             if not self._advance_pod_window():
-                raise RuntimeError(
-                    f"pod_window={self.pod_window} is too small: window "
-                    f"{sub + 1} needs pod slots beyond the device window and "
-                    "no leading pod is terminal yet"
-                )
+                # The live-pod span outgrew the window (no leading pod is
+                # terminal): grow the window in place instead of failing —
+                # dense stretches of a long trace adapt automatically.
+                if not self._grow_pod_window():
+                    raise RuntimeError(
+                        f"pod_window={self.pod_window} is too small: window "
+                        f"{sub + 1} needs pod slots beyond the device window "
+                        "and no leading pod is terminal yet, and the window "
+                        "already covers the whole plain trace segment"
+                    )
 
     def _pod_capacity_window(self) -> int:
         """Largest window index dispatchable before a pod creation would land
@@ -1025,7 +1030,6 @@ class BatchedSimulation:
             PHASE_REMOVED,
             PHASE_SUCCEEDED,
         )
-        from kubernetriks_tpu.batched.state import duration_pair_np
 
         def slice_pad(arr, start, width, fill):
             """arr[:, start:start+width], right-padded with fill past the
@@ -1113,35 +1117,7 @@ class BatchedSimulation:
                 )
             return True
 
-        C = self._pod_create_win.shape[0]
-        refill_lo = win_lo + W
-        full = self._full_pods
-
-        def payload(arr, fill):
-            return slice_pad(arr, refill_lo, s, fill)
-
-        # The refill slots are pristine pod slots — built by the SAME
-        # constructor init_state uses, so windowed and full-resident runs
-        # can never drift on fresh-slot defaults.
-        from kubernetriks_tpu.batched.state import fresh_pod_arrays
-
-        refill = fresh_pod_arrays(
-            C,
-            s,
-            payload(full["req_cpu"], 0),
-            payload(full["req_ram"], 0),
-            duration_pair_np(
-                payload(full["duration"], -1.0),
-                self.config.scheduling_cycle_interval,
-            ),
-        )
-        if self.mesh is not None:
-            # Keep the refill columns C-sharded so the concatenation below
-            # composes shard-local slices instead of pulling the state off
-            # the mesh.
-            refill = jax.device_put(
-                refill, self._state_shardings(self._sharding, refill)
-            )
+        refill = self._make_refill(win_lo + W, s)
         new_pods = jax.tree.map(
             lambda a, b: jnp.concatenate([a[:, s:W], b, a[:, W:]], axis=1),
             self.state.pods,
@@ -1152,6 +1128,129 @@ class BatchedSimulation:
         )
         self._pod_base += s
         self._refresh_name_ranks()
+        return True
+
+    def _make_refill(self, start: int, width: int):
+        """Pristine pod slots for global plain slots [start, start + width)
+        — built by the SAME constructor init_state uses (windowed,
+        full-resident and grown runs can never drift on fresh-slot
+        defaults), sliced from the host payload with right-padding past the
+        trace, and C-sharded under a mesh so downstream concatenations
+        compose shard-local slices. Shared by the host slide path and
+        _grow_pod_window."""
+        from kubernetriks_tpu.batched.state import (
+            duration_pair_np,
+            fresh_pod_arrays,
+        )
+
+        full = self._full_pods
+        C = self._pod_create_win.shape[0]
+
+        def seg(arr, fill):
+            out = arr[:, start : start + width]
+            if out.shape[1] < width:
+                pad = np.full(
+                    (arr.shape[0], width - out.shape[1]), fill, arr.dtype
+                )
+                out = np.concatenate([out, pad], axis=1)
+            return out
+
+        refill = fresh_pod_arrays(
+            C,
+            width,
+            seg(full["req_cpu"], 0),
+            seg(full["req_ram"], 0),
+            duration_pair_np(
+                seg(full["duration"], -1.0),
+                self.config.scheduling_cycle_interval,
+            ),
+        )
+        if self.mesh is not None:
+            refill = jax.device_put(
+                refill, self._state_shardings(self._sharding, refill)
+            )
+        return refill
+
+    def _grow_pod_window(self) -> bool:
+        """Double the sliding window IN PLACE when a dense stretch of the
+        trace outgrows it (peak live-pod span > pod_window, so no slide is
+        possible): insert fresh plain-pod slots between the window segment
+        and the resident ring tail, re-point the segment mapping
+        (consts.resident_shift moves right), and rebuild the windowed
+        name-rank/group statics and the device slide payload. Bit-exact:
+        window slots [0, new_W) cover global plain slots
+        [pod_base, pod_base + new_W) with the SAME fresh-slot constructor
+        the initial build uses, and the inserted slots' create events are
+        still pending (the capacity check never dispatched a window needing
+        them). Shapes change, so the step recompiles once per growth.
+        Returns False when the window already spans the whole plain
+        segment."""
+        W = self.pod_window
+        T = int(self.consts.trace_pod_bound)
+        if W is None or W >= T:
+            return False
+        new_W = min(2 * W, T)
+        insert = new_W - W
+        base = self._pod_base
+        C = self._pod_create_win.shape[0]
+        refill = self._make_refill(base + W, insert)
+        new_pods = jax.tree.map(
+            lambda a, b: jnp.concatenate([a[:, :W], b, a[:, W:]], axis=1),
+            self.state.pods,
+            refill,
+        )
+        self.state = self.state._replace(pods=new_pods)
+        self.pod_window = new_W
+        self._resident_shift = T - new_W
+        self.consts = self.consts._replace(
+            resident_shift=np.int32(self._resident_shift)
+        )
+        if self.autoscale_statics is not None:
+            st = self.autoscale_statics
+            # The resident ring tail moved right by `insert` device slots:
+            # group ids gain `insert` no-group window slots before the tail,
+            # ring start indices shift right (padding groups have
+            # slot_count 0; their start is only read through real gids).
+            pgi = st.pod_group_id
+            gap = jnp.full((C, insert), -1, jnp.int32)
+            if self.mesh is not None:
+                gap = jax.device_put(
+                    gap, self._state_shardings(self._sharding, gap)
+                )
+            self.autoscale_statics = st._replace(
+                pod_group_id=jnp.concatenate(
+                    [pgi[:, :W], gap, pgi[:, W:]], axis=1
+                ),
+                pg_slot_start=st.pg_slot_start + jnp.int32(insert),
+            )
+            self._refresh_name_ranks()  # rebuilds windowed ranks at new_W
+        self._init_device_slide()  # re-pad the payload to T + new_W
+        # Kernel VMEM fits-gates depend on the device pod-axis width.
+        self.n_pods += insert
+        from kubernetriks_tpu.ops.scheduler_kernel import (
+            select_commit_kernel_fits,
+            select_kernel_fits,
+        )
+
+        self.use_pallas_select = (
+            self.use_pallas_select
+            and select_kernel_fits(
+                self.n_nodes, self.n_pods, self.max_pods_per_cycle
+            )
+        )
+        self.use_megakernel = (
+            self.use_megakernel
+            and self.use_pallas_select
+            and select_commit_kernel_fits(
+                self.n_nodes, self.n_pods, self.max_pods_per_cycle
+            )
+        )
+        import logging
+
+        logging.getLogger(__name__).info(
+            "pod_window grew %d -> %d at window base %d (live span outgrew "
+            "the window)", W, new_W, base,
+        )
         return True
 
     def _step_idxs(self, idxs: np.ndarray) -> None:
@@ -1367,6 +1466,20 @@ class BatchedSimulation:
         from kubernetriks_tpu.checkpoint import ckpt_save
 
         ckpt_save(path, self._ckpt_payload())
+        # The window can GROW mid-run (_grow_pod_window), changing the pod
+        # arrays' shapes — record it so load_checkpoint can grow a freshly
+        # built engine to match before restoring.
+        meta_path = os.path.abspath(path) + ".meta.json"
+        if self.pod_window is not None:
+            import json
+
+            with open(meta_path, "w") as fh:
+                json.dump({"pod_window": int(self.pod_window)}, fh)
+        elif os.path.exists(meta_path):
+            # A full-resident save over a previously windowed checkpoint
+            # must not leave the stale meta to mislead a later windowed
+            # load (same shadowing rule as the gauges sidecar below).
+            os.remove(meta_path)
         sidecar = os.path.abspath(path) + ".gauges.npz"
         if self._gauge_windows:
             np.savez(
@@ -1388,6 +1501,20 @@ class BatchedSimulation:
         unsharded; re-apply device placement for mesh runs if needed."""
         from kubernetriks_tpu.checkpoint import ckpt_restore
 
+        meta_path = os.path.abspath(path) + ".meta.json"
+        if os.path.exists(meta_path):
+            import json
+
+            with open(meta_path) as fh:
+                saved_window = json.load(fh).get("pod_window")
+            if saved_window is not None and self.pod_window is not None:
+                while self.pod_window < saved_window:
+                    if not self._grow_pod_window():
+                        break
+                assert self.pod_window == saved_window, (
+                    f"checkpoint was saved at pod_window={saved_window}; "
+                    f"this engine is at {self.pod_window} and cannot match"
+                )
         restored = ckpt_restore(path, self._ckpt_payload())
         self.state = restored["state"]
         self.next_window_idx = int(restored["next_window_idx"])
