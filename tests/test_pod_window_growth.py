@@ -40,14 +40,15 @@ def _long_running_workload(n_pods=200, duration=600.0):
     ).convert_to_simulator_events()
 
 
-def _build(workload, **kwargs):
+def _build(workload, n_clusters=N_CLUSTERS, hpa=False, **kwargs):
     config = default_test_simulation_config()
+    config.horizontal_pod_autoscaler.enabled = hpa
     cluster = UniformClusterTrace(4, cpu=16000, ram=32 * 1024**3)
     return build_batched_from_traces(
         config,
         cluster.convert_to_simulator_events(),
         workload,
-        n_clusters=N_CLUSTERS,
+        n_clusters=n_clusters,
         max_pods_per_cycle=16,
         **kwargs,
     )
@@ -111,22 +112,9 @@ events:
         key=lambda e: e[0],
     )
 
-    def build(**kw):
-        config = default_test_simulation_config()
-        config.horizontal_pod_autoscaler.enabled = True
-        cluster = UniformClusterTrace(4, cpu=16000, ram=32 * 1024**3)
-        return build_batched_from_traces(
-            config,
-            cluster.convert_to_simulator_events(),
-            workload,
-            n_clusters=N_CLUSTERS,
-            max_pods_per_cycle=16,
-            **kw,
-        )
-
-    ref = build()
+    ref = _build(workload, hpa=True)
     ref.step_until_time(1000.0)
-    sim = build(pod_window=64)
+    sim = _build(workload, hpa=True, pod_window=64)
     sim.step_until_time(1000.0)
     assert sim.pod_window > 64, "the window never grew"
     rc, sc = ref.metrics_summary()["counters"], sim.metrics_summary()["counters"]
@@ -169,3 +157,62 @@ def test_host_slide_fallback_matches_resident():
     assert sim.pod_window == 64, "expected slides, not growth"
     assert sim._pod_base > 0, "window never slid"
     assert sim.metrics_summary()["counters"] == ref.metrics_summary()["counters"]
+
+
+def test_window_growth_under_mesh():
+    """Growth on a C-sharded mesh: the inserted slots and the moved
+    autoscale statics (HPA ring) stay shard-local on the 'clusters' axis,
+    and the grown run equals the unsharded resident run."""
+    import jax
+    from jax.sharding import Mesh
+
+    devices = jax.devices()
+    if len(devices) < 4:
+        import pytest
+
+        pytest.skip("needs >= 4 virtual devices")
+    mesh = Mesh(np.array(devices[:4]), ("clusters",))
+
+    group = GenericWorkloadTrace.from_yaml(
+        """
+events:
+- timestamp: 5.5
+  event_type:
+    !CreatePodGroup
+      pod_group:
+        name: grp
+        initial_pod_count: 2
+        max_pod_count: 4
+        pod_template:
+          metadata: {name: grp}
+          spec:
+            resources:
+              requests: {cpu: 100, ram: 104857600}
+              limits: {cpu: 100, ram: 104857600}
+        target_resources_usage: {cpu_utilization: 0.5}
+        resources_usage_model_config:
+          cpu_config:
+            model_name: pod_group
+            config: |
+              - duration: 300.0
+                total_load: 1.8
+              - duration: 300.0
+                total_load: 0.4
+"""
+    ).convert_to_simulator_events()
+    workload = sorted(
+        _long_running_workload(n_pods=120, duration=400.0) + group,
+        key=lambda e: e[0],
+    )
+
+    ref = _build(workload, n_clusters=4, hpa=True)
+    ref.step_until_time(900.0)
+    sim = _build(workload, n_clusters=4, hpa=True, pod_window=32, mesh=mesh)
+    sim.step_until_time(900.0)
+    assert sim.pod_window > 32, "the window never grew"
+    # Still C-sharded (not merely present on 4 devices as replicas).
+    for arr in (sim.state.pods.phase, sim.autoscale_statics.pod_group_id):
+        assert arr.sharding.spec[0] == "clusters", arr.sharding
+    rc, sc = ref.metrics_summary()["counters"], sim.metrics_summary()["counters"]
+    assert rc == sc
+    assert sc["total_scaled_up_pods"] > 0, "the HPA ring never activated"
