@@ -489,12 +489,12 @@ class BatchedSimulation:
         ) = pad_and_batch(compiled_traces)
 
         if pod_window is not None:
-            if mesh is not None:
-                assert not is_cross_process(mesh), (
-                    "pod_window requires a single-process mesh: the window "
-                    "shift reads pod phases and rebuilds the pod arrays on "
-                    "the host, which needs every shard addressable"
-                )
+            # Cross-process meshes are supported through the device-resident
+            # slide path: the shift amount is a replicated scalar (readable
+            # on every process), slices/concats run SPMD, and the payload is
+            # placed with put_global. Only the HOST fallback path needs
+            # every shard addressable — __init__ refuses cross-process
+            # builds whose payload exceeds the device budget (below).
             P_full = pod_req_cpu.shape[1]
             # T: first resident (pod-group ring) slot; the window slides over
             # plain slots [0, T) only.
@@ -767,6 +767,19 @@ class BatchedSimulation:
                     self._state_shardings(sharding, self.autoscale_statics),
                 )
         self._init_device_slide()
+        if (
+            self.pod_window is not None
+            and self.mesh is not None
+            and is_cross_process(self.mesh)
+            and self._device_slide is None
+        ):
+            raise ValueError(
+                "pod_window on a cross-process mesh requires the "
+                "device-resident slide payload, but this trace exceeds its "
+                "memory budget — raise _DEVICE_SLIDE_BUDGET_BYTES, enlarge "
+                "pod_window, or drop to a single-process mesh (the host "
+                "slide path needs every shard addressable)"
+            )
 
     def _init_device_slide(self) -> None:
         """Upload the slide payload (pod requests, durations, create
@@ -821,9 +834,12 @@ class BatchedSimulation:
             row = NamedSharding(
                 self._sharding.mesh, PartitionSpec(self._batch_axis, None)
             )
-            payload = jax.device_put(
-                payload, {k: row for k in payload}
+            put = (
+                put_global
+                if is_cross_process(self._sharding.mesh)
+                else jax.device_put
             )
+            payload = put(payload, {k: row for k in payload})
         self._device_slide = payload
 
     def _state_shardings(self, sharding, tree):
@@ -1013,7 +1029,12 @@ class BatchedSimulation:
             )
         dev = np.concatenate([seg, full[:, T:]], axis=1)
         old = self.autoscale_statics.pod_name_rank
-        new = jax.device_put(jnp.asarray(dev), old.sharding)
+        put = (
+            put_global
+            if (self.mesh is not None and is_cross_process(self.mesh))
+            else jax.device_put
+        )
+        new = put(jnp.asarray(dev), old.sharding)
         self.autoscale_statics = self.autoscale_statics._replace(
             pod_name_rank=new
         )
@@ -1166,9 +1187,8 @@ class BatchedSimulation:
             ),
         )
         if self.mesh is not None:
-            refill = jax.device_put(
-                refill, self._state_shardings(self._sharding, refill)
-            )
+            put = put_global if is_cross_process(self.mesh) else jax.device_put
+            refill = put(refill, self._state_shardings(self._sharding, refill))
         return refill
 
     def _grow_pod_window(self) -> bool:
@@ -1214,9 +1234,12 @@ class BatchedSimulation:
             pgi = st.pod_group_id
             gap = jnp.full((C, insert), -1, jnp.int32)
             if self.mesh is not None:
-                gap = jax.device_put(
-                    gap, self._state_shardings(self._sharding, gap)
+                put = (
+                    put_global
+                    if is_cross_process(self.mesh)
+                    else jax.device_put
                 )
+                gap = put(gap, self._state_shardings(self._sharding, gap))
             self.autoscale_statics = st._replace(
                 pod_group_id=jnp.concatenate(
                     [pgi[:, :W], gap, pgi[:, W:]], axis=1

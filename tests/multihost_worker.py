@@ -86,6 +86,61 @@ def main(pid: int, port: str) -> None:
     assert counters["scheduling_decisions"] > 0
     print(f"ENGINE_OK {pid} {counters['scheduling_decisions']}", flush=True)
 
+    # Sliding pod window ACROSS processes: device-resident slides (the
+    # shift amount is a replicated scalar every process reads identically)
+    # plus an in-place window growth, vs an unsharded local reference.
+    from kubernetriks_tpu.trace.generic import GenericWorkloadTrace
+
+    # 30 long-running head pods force growth (16 -> 128 < the 160-slot
+    # plain segment); once they finish, the short tail slides the grown
+    # window (so BOTH cross-process growth and cross-process slides run).
+    slide_workload = GenericWorkloadTrace.from_yaml(
+        "events:"
+        + "".join(
+            f"""
+- timestamp: {1 + i}
+  event_type:
+    !CreatePod
+      pod:
+        metadata: {{name: p_{i:03d}}}
+        spec:
+          resources:
+            requests: {{cpu: 100, ram: 104857600}}
+            limits: {{cpu: 100, ram: 104857600}}
+          running_duration: {100.0 if i < 30 else 15.0}
+"""
+            for i in range(160)
+        )
+    ).convert_to_simulator_events()
+
+    def build_sliding(**kw):
+        return build_batched_from_traces(
+            config,
+            cluster.convert_to_simulator_events(),
+            slide_workload,
+            n_clusters=16,
+            max_pods_per_cycle=8,
+            **kw,
+        )
+
+    ref = build_sliding()  # local, unsharded, full-resident
+    ref.step_until_time(400.0)
+    ssim = build_sliding(mesh=mesh, pod_window=16)
+    assert ssim._device_slide is not None
+    assert not ssim.state.pods.phase.is_fully_addressable
+    ssim.step_until_time(400.0)
+    # The 40 long-running head pods forced growth past 16; the short tail
+    # then slid the grown window.
+    assert ssim.pod_window > 16, "window never grew"
+    assert ssim._pod_base > 0, "window never slid"
+    sc = ssim.metrics_summary()["counters"]
+    assert sc == ref.metrics_summary()["counters"], (
+        sc, ref.metrics_summary()["counters"],
+    )
+    print(
+        f"SLIDING_OK {pid} {ssim.pod_window} {ssim._pod_base}", flush=True
+    )
+
 
 if __name__ == "__main__":
     main(int(sys.argv[1]), sys.argv[2])

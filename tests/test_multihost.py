@@ -74,10 +74,13 @@ def test_two_process_cross_process_branches():
         assert p.returncode == 0, f"worker {i} failed:\n{out[-4000:]}"
         assert f"ROUNDTRIP_OK {i}" in out
         assert f"ENGINE_OK {i}" in out
-    # Both processes computed identical global metrics.
-    d0 = [l for l in outs[0].splitlines() if l.startswith("ENGINE_OK")][0].split()[2]
-    d1 = [l for l in outs[1].splitlines() if l.startswith("ENGINE_OK")][0].split()[2]
-    assert d0 == d1
+        assert f"SLIDING_OK {i}" in out
+    # Both processes computed identical global metrics, and the sliding
+    # window grew/slid identically on both.
+    for tag in ("ENGINE_OK", "SLIDING_OK"):
+        l0 = [l for l in outs[0].splitlines() if l.startswith(tag)][0].split()[2:]
+        l1 = [l for l in outs[1].splitlines() if l.startswith(tag)][0].split()[2:]
+        assert l0 == l1, (tag, l0, l1)
 
 
 def test_put_global_matches_device_put():
