@@ -197,28 +197,71 @@ def hpa_pass(
     st: AutoscaleStatics,
     W: jnp.ndarray,
     consts: StepConstants,
+    seg=None,
 ) -> Tuple[ClusterBatchState, AutoscaleState]:
     """One masked HPA cycle at window W for every due cluster
     (scalar equivalent: horizontal_pod_autoscaler.py run cycle +
-    kube_horizontal_pod_autoscaler.py formula)."""
+    kube_horizontal_pod_autoscaler.py formula).
+
+    seg: optional STATIC (lo, hi) device-slot bounds covering every pod-group
+    slot (engine._hpa_seg). The pass only ever touches group slots, so the
+    body — including its (C, P) victim sort — runs on the [lo, hi) slice,
+    and the not-due `lax.cond` identity branch carries (C, hi-lo) slices
+    instead of the full pod arrays (the cond materializes its carry through
+    both branches; with the full state that copy cost more than the
+    amortized body — the §3 "empty-cycle skip" lesson, docs/DESIGN.md)."""
+    pods = state.pods
+    C, P = pods.phase.shape
+    lo, hi = (0, P) if seg is None else seg
+    sliced = (lo, hi) != (0, P)
+    sub = (
+        jax.tree.map(lambda a: a[:, lo:hi], pods) if sliced else pods
+    )
     due_any = t_le(
         auto.hpa_next, TPair(win=W, off=jnp.zeros_like(auto.hpa_next.off))
     ).any()
-    return jax.lax.cond(
+
+    zeros = jnp.zeros((C,), jnp.int32)
+    sub2, auto2, up_s, down_s, clamp_s, n_up = jax.lax.cond(
         due_any,
-        lambda: _hpa_pass_body(state, auto, st, W, consts),
-        lambda: (state, auto),
+        lambda: _hpa_pass_body(
+            sub, state.queue_seq_counter, auto, st, W, consts, lo
+        ),
+        lambda: (sub, auto, zeros, zeros, zeros, zeros),
     )
+    if sliced:
+        pods2 = jax.tree.map(
+            lambda full, s: full.at[:, lo:hi].set(s), pods, sub2
+        )
+    else:
+        pods2 = sub2
+    metrics = state.metrics
+    metrics = metrics._replace(
+        scaled_up_pods=metrics.scaled_up_pods + up_s,
+        scaled_down_pods=metrics.scaled_down_pods + down_s,
+        hpa_reserve_clamped=metrics.hpa_reserve_clamped + clamp_s,
+    )
+    state = state._replace(
+        pods=pods2,
+        metrics=metrics,
+        queue_seq_counter=state.queue_seq_counter + n_up,
+    )
+    return state, auto2
 
 
 def _hpa_pass_body(
-    state: ClusterBatchState,
+    pods,
+    queue_seq_counter: jnp.ndarray,
     auto: AutoscaleState,
     st: AutoscaleStatics,
     W: jnp.ndarray,
     consts: StepConstants,
-) -> Tuple[ClusterBatchState, AutoscaleState]:
-    pods, metrics = state.pods, state.metrics
+    lo: int = 0,
+):
+    """HPA cycle body over the pod-slot slice [lo, lo+P) of the device pod
+    axis (P here = slice width; pod_group_id indexes align via lo). Returns
+    (pods', auto', scaled_up (C,), scaled_down (C,), reserve_clamped (C,),
+    n_activated (C,)) — the caller owns the metrics fold and writeback."""
     C, P = pods.phase.shape
     Gp = st.pg_slot_start.shape[1]
     interval = jnp.float32(consts.scheduling_interval)
@@ -234,7 +277,7 @@ def _hpa_pass_body(
 
     # Group membership and running counts (running = bound AND started by T,
     # mirroring node_component.running_pods at collection time).
-    gid = st.pod_group_id
+    gid = st.pod_group_id[:, lo : lo + P]
     gid_c = jnp.where(gid >= 0, gid, Gp)
     started = t_le(
         pods.start_time,
@@ -303,7 +346,8 @@ def _hpa_pass_body(
     up0 = jnp.minimum(jnp.maximum(delta, 0), count_g - current)
     down = jnp.minimum(jnp.maximum(-delta, 0), current)
 
-    slot_start_p = st.pg_slot_start[rows, gid_c]  # (C, P); garbage where gid<0
+    # Group slot starts in SLICE coords ((C, P); garbage where gid<0).
+    slot_start_p = st.pg_slot_start[rows, gid_c] - jnp.int32(lo)
     in_group = gid >= 0
     tail_p = auto.hpa_tail[rows, gid_c]
 
@@ -345,7 +389,7 @@ def _hpa_pass_body(
     phase = jnp.where(activate, PHASE_QUEUED, pods.phase)
     queue_ts = t_where(activate, enq_p, pods.queue_ts)
     queue_seq = jnp.where(
-        activate, state.queue_seq_counter[:, None] + rank, pods.queue_seq
+        activate, queue_seq_counter[:, None] + rank, pods.queue_seq
     )
     initial_attempt_ts = t_where(activate, enq_p, pods.initial_attempt_ts)
     attempts = jnp.where(activate, 1, pods.attempts)
@@ -418,10 +462,6 @@ def _hpa_pass_body(
         deactivate, t_min(removal_time, rem_p), removal_time
     )
 
-    metrics = metrics._replace(
-        scaled_up_pods=metrics.scaled_up_pods + up.sum(axis=1, dtype=jnp.int32),
-        scaled_down_pods=metrics.scaled_down_pods + down.sum(axis=1, dtype=jnp.int32),
-    )
     auto = auto._replace(
         hpa_head=auto.hpa_head + down,
         hpa_tail=auto.hpa_tail + up,
@@ -429,23 +469,31 @@ def _hpa_pass_body(
             due, t_add(auto.hpa_next, st.hpa_interval, interval), auto.hpa_next
         ),
     )
-    state = state._replace(
-        pods=pods._replace(
-            phase=phase,
-            queue_ts=queue_ts,
-            queue_seq=queue_seq,
-            initial_attempt_ts=initial_attempt_ts,
-            attempts=attempts,
-            removal_time=removal_time,
-            node=node,
-            start_time=start_time,
-            finish_time=finish_time,
-            hpa_idx=hpa_idx,
-        ),
-        metrics=metrics,
-        queue_seq_counter=state.queue_seq_counter + n_up,
+    pods = pods._replace(
+        phase=phase,
+        queue_ts=queue_ts,
+        queue_seq=queue_seq,
+        initial_attempt_ts=initial_attempt_ts,
+        attempts=attempts,
+        removal_time=removal_time,
+        node=node,
+        start_time=start_time,
+        finish_time=finish_time,
+        hpa_idx=hpa_idx,
     )
-    return state, auto
+    return (
+        pods,
+        auto,
+        up.sum(axis=1, dtype=jnp.int32),
+        down.sum(axis=1, dtype=jnp.int32),
+        # Replicas the formula wanted (delta, already clamped to the exact
+        # scalar max_pod_count bound) but the reserve could not seat —
+        # either up0's slot_count-current clamp or the no-reusable-slot
+        # clamp. The scalar would have created them: nonzero = divergence,
+        # surfaced loudly by engine.check_autoscaler_bounds().
+        (jnp.maximum(delta, 0) - up).sum(axis=1, dtype=jnp.int32),
+        n_up,
+    )
 
 
 def _ca_scale_up(
@@ -463,8 +511,10 @@ def _ca_scale_up(
 ):
     """Bin-packing scale-up over the unscheduled-pod cache
     (reference: kube_cluster_autoscaler.rs:190-240). Returns
-    (planned (C,S) bool, planned_per_group (C,Gn)). phase_v/attempts_v are
-    the storage-visible views supplied by ca_pass."""
+    (planned (C,S) bool, planned_per_group (C,Gn), reserve_starved (C,) —
+    open attempts blocked ONLY by the consumed slot reserve, the
+    silent-divergence case engine.check_autoscaler_bounds raises on).
+    phase_v/attempts_v are the storage-visible views supplied by ca_pass."""
     pods = state.pods
     C, P = pods.phase.shape
     S = st.ca_slots.shape[1]
@@ -509,8 +559,8 @@ def _ca_scale_up(
         if pallas_mesh is not None:
             from kubernetriks_tpu.batched.step import _shard_rowwise
 
-            core = _shard_rowwise(core, 11, 2, pallas_mesh, pallas_axis)
-        return core(
+            core = _shard_rowwise(core, 11, 3, pallas_mesh, pallas_axis)
+        planned_k, g_planned_k, starved_k = core(
             st.ca_max_nodes[:, None],
             auto.ca_count,
             auto.ca_cursor,
@@ -523,6 +573,7 @@ def _ca_scale_up(
             creq_cpu,
             creq_ram,
         )
+        return planned_k, g_planned_k, starved_k[:, 0]
 
     planned0 = jnp.zeros((C, S), bool)
     plan_seq0 = jnp.full((C, S), _BIG_I32, jnp.int32)
@@ -532,9 +583,13 @@ def _ca_scale_up(
     total0 = auto.ca_count.sum(axis=1)  # CA counts only (reference quirk:
     # max_node_count bounds CA-owned nodes, kube_cluster_autoscaler.rs:62-80)
     counter0 = jnp.zeros((C,), jnp.int32)
+    starved0 = jnp.zeros((C,), jnp.int32)
 
     def body(carry, xs):
-        planned, plan_seq, palloc_cpu, palloc_ram, g_planned, total, counter = carry
+        (
+            planned, plan_seq, palloc_cpu, palloc_ram, g_planned, total,
+            counter, starved,
+        ) = carry
         valid, rcpu, rram = xs
 
         # First-fit into already-planned nodes, in plan order; fitting pods
@@ -562,6 +617,19 @@ def _ca_scale_up(
         g_found = g_ok.any(axis=1)
         g = jax.lax.argmax(g_ok, 1, jnp.int32)
         open_ = can_open & g_found
+        # Reserve starvation: a group would accept this pod (quota headroom
+        # + template fit) but its never-reclaimed slot reserve is consumed
+        # (autoscale.py "Remaining bounded deviations") — counted so the
+        # engine can raise loudly instead of silently diverging.
+        g_ok_nc = (
+            ((st.ng_max_count < 0) | (gcount < st.ng_max_count))
+            & (st.ng_slot_count > 0)
+            & (rcpu[:, None] <= st.ng_tmpl_cpu)
+            & (rram[:, None] <= st.ng_tmpl_ram)
+        )
+        starved = starved + (
+            can_open & ~g_found & g_ok_nc.any(axis=1)
+        ).astype(jnp.int32)
         s_new = (
             st.ng_ca_start[rows1, g]
             + auto.ca_cursor[rows1, g]
@@ -581,9 +649,15 @@ def _ca_scale_up(
         g_planned = g_planned.at[rows1, jnp.where(open_, g, Gn)].add(1, mode="drop")
         total = total + open_.astype(jnp.int32)
         counter = counter + open_.astype(jnp.int32)
-        return (planned, plan_seq, palloc_cpu, palloc_ram, g_planned, total, counter), None
+        return (
+            planned, plan_seq, palloc_cpu, palloc_ram, g_planned, total,
+            counter, starved,
+        ), None
 
-    carry0 = (planned0, plan_seq0, palloc_cpu0, palloc_ram0, g_planned0, total0, counter0)
+    carry0 = (
+        planned0, plan_seq0, palloc_cpu0, palloc_ram0, g_planned0, total0,
+        counter0, starved0,
+    )
     # Early exit at the deepest lane's cache count: the bin-pack is
     # sequential over K_up candidate positions, but typical caches hold a
     # handful of pods — iterating all K_up steps cost ~K_up sequential
@@ -598,10 +672,10 @@ def _ca_scale_up(
         carry, _ = body(carry, xs_k)
         return (k + jnp.int32(1), carry)
 
-    _, (planned, _, _, _, g_planned, _, _) = jax.lax.while_loop(
+    _, (planned, _, _, _, g_planned, _, _, starved) = jax.lax.while_loop(
         lambda lc: lc[0] < k_bound, loop_body, (jnp.int32(0), carry0)
     )
-    return planned, g_planned
+    return planned, g_planned, starved
 
 
 def _ca_scale_down(
@@ -955,7 +1029,7 @@ def ca_pass(
     # to replicated scalars, so the conds hold under a C-sharded mesh.
     S = st.ca_slots.shape[1]
     Gn = st.ng_ca_start.shape[1]
-    planned, planned_per_group = jax.lax.cond(
+    planned, planned_per_group, up_starved = jax.lax.cond(
         up_branch.any(),
         lambda: _ca_scale_up(
             state, auto, st, up_branch, K_up, phase_v, attempts_v,
@@ -964,7 +1038,11 @@ def ca_pass(
             pallas_mesh=pallas_mesh,
             pallas_axis=pallas_axis,
         ),
-        lambda: (jnp.zeros((C, S), bool), jnp.zeros((C, Gn), jnp.int32)),
+        lambda: (
+            jnp.zeros((C, S), bool),
+            jnp.zeros((C, Gn), jnp.int32),
+            jnp.zeros((C,), jnp.int32),
+        ),
     )
     removed, removed_per_group = jax.lax.cond(
         # ca_count (live CA nodes) rather than ca_cursor (ever allocated):
@@ -1007,6 +1085,7 @@ def ca_pass(
     metrics = metrics._replace(
         scaled_up_nodes=metrics.scaled_up_nodes + planned.sum(axis=1, dtype=jnp.int32),
         scaled_down_nodes=metrics.scaled_down_nodes + removed.sum(axis=1, dtype=jnp.int32),
+        ca_reserve_starved=metrics.ca_reserve_starved + up_starved,
     )
     auto = auto._replace(
         ca_count=auto.ca_count + planned_per_group - removed_per_group,

@@ -577,9 +577,6 @@ class BatchedSimulation:
                 sliding=pod_window is not None,
             )
             self.autoscale_statics = statics
-            # Sliding runs: install the initial windowed name-rank slice
-            # (build_autoscale_statics leaves ranks BIG under sliding).
-            self._refresh_name_ranks()
             if ca_on and extra_names:
                 node_cap_cpu = np.concatenate(
                     [node_cap_cpu, np.tile(extra_cpu, (C, 1))], axis=1
@@ -683,12 +680,32 @@ class BatchedSimulation:
             pod_duration,
             interval=config.scheduling_cycle_interval,
         )
+        # Static (lo, hi) device-slot bounds covering every pod-group slot:
+        # the HPA pass only touches group slots, so its body (victim sort
+        # included) and its not-due cond carry run on this slice instead of
+        # the full (C, P) pod axis (autoscale.hpa_pass). (0, 0) = the HPA
+        # can never act (off, no groups, or empty reserves) — the step skips
+        # the pass entirely and hpa_next parks at +inf below to match.
+        self._hpa_seg = (0, 0)
+        if self.autoscale_statics is not None and (
+            hpa_on and any(c.pod_groups for c in compiled_traces)
+        ):
+            starts = np.asarray(self.autoscale_statics.pg_slot_start)
+            counts = np.asarray(self.autoscale_statics.pg_slot_count)
+            gmask = counts > 0
+            if gmask.any():
+                seg_lo = max(int(starts[gmask].min()), 0)
+                seg_hi = min(int((starts + counts)[gmask].max()), self.n_pods)
+                self._hpa_seg = (
+                    (seg_lo, seg_hi) if seg_hi > seg_lo else (0, 0)
+                )
         if self.autoscale_statics is not None:
             auto = init_autoscale_state(self.autoscale_statics)
-            # With the HPA off (or no pod groups in the trace), park its tick
-            # at +inf so hpa_pass's due-cond never fires — CA-only runs skip
-            # the whole (C, P) HPA body every window.
-            if not (hpa_on and any(c.pod_groups for c in compiled_traces)):
+            # When the step skips hpa_pass (seg == (0, 0)), park its tick at
+            # +inf so everything that reads hpa_next (fast-forward's
+            # _next_interesting_window, _catch_up_bookkeeping) agrees the
+            # HPA never fires.
+            if self._hpa_seg == (0, 0):
                 from kubernetriks_tpu.batched.timerep import t_inf
 
                 auto = auto._replace(hpa_next=t_inf((C,)))
@@ -742,6 +759,11 @@ class BatchedSimulation:
         # events/s log, reference: src/simulator.rs:363-368).
         self.profile_dir: Optional[str] = None
         self.log_throughput = False
+        # Raise at readout when a documented autoscaler work bound was
+        # crossed (HPA reserve clamp, CA slot-reserve exhaustion) instead of
+        # silently reporting a diverged trajectory. Opt out for exploratory
+        # runs with strict_autoscaler_bounds = False.
+        self.strict_autoscaler_bounds = True
 
         self.mesh = mesh
         self._batch_axis = batch_axis
@@ -766,6 +788,11 @@ class BatchedSimulation:
                     self.autoscale_statics,
                     self._state_shardings(sharding, self.autoscale_statics),
                 )
+        # Sliding runs: install the initial windowed name-rank slice
+        # (build_autoscale_statics leaves ranks BIG under sliding). Must run
+        # AFTER self.mesh is assigned and the statics carry their final
+        # sharding — _refresh_name_ranks re-puts with old.sharding.
+        self._refresh_name_ranks()
         self._init_device_slide()
         if (
             self.pod_window is not None
@@ -926,6 +953,7 @@ class BatchedSimulation:
                 use_pallas_select=self.use_pallas_select,
                 use_megakernel=self.use_megakernel,
                 flush_windows=self._flush_windows,
+                hpa_seg=self._hpa_seg,
             )
             self.next_window_idx = int(idxs[-1]) + 1
             return
@@ -947,6 +975,7 @@ class BatchedSimulation:
             pallas_axis=self._batch_axis,
             use_pallas_select=self.use_pallas_select,
             use_megakernel=self.use_megakernel,
+            hpa_seg=self._hpa_seg,
         )
         if self.collect_gauges:
             self.state, gauges = out
@@ -1211,6 +1240,26 @@ class BatchedSimulation:
             return False
         new_W = min(2 * W, T)
         insert = new_W - W
+        # Cross-process meshes REQUIRE the device-resident slide payload
+        # (the host path calls to_host on non-addressable shards); check the
+        # grown payload against the budget BEFORE mutating anything, so the
+        # raise leaves the engine consistent (same predicate as
+        # _init_device_slide).
+        if (
+            self.mesh is not None
+            and is_cross_process(self.mesh)
+            and self._full_pods is not None
+        ):
+            C_full, T_full = self._full_pods["req_cpu"].shape
+            n_i32 = 5 + (1 if self.autoscale_statics is not None else 0)
+            if C_full * (T_full + new_W) * 4 * n_i32 > _DEVICE_SLIDE_BUDGET_BYTES:
+                raise ValueError(
+                    "pod_window growth on a cross-process mesh would push "
+                    "the device-resident slide payload past its memory "
+                    "budget — raise _DEVICE_SLIDE_BUDGET_BYTES, start with "
+                    "a larger pod_window, or drop to a single-process mesh "
+                    "(the host slide path needs every shard addressable)"
+                )
         base = self._pod_base
         C = self._pod_create_win.shape[0]
         refill = self._make_refill(base + W, insert)
@@ -1246,8 +1295,16 @@ class BatchedSimulation:
                 ),
                 pg_slot_start=st.pg_slot_start + jnp.int32(insert),
             )
+            if self._hpa_seg != (0, 0):
+                lo, hi = self._hpa_seg
+                self._hpa_seg = (lo + insert, hi + insert)
             self._refresh_name_ranks()  # rebuilds windowed ranks at new_W
         self._init_device_slide()  # re-pad the payload to T + new_W
+        assert not (
+            self.mesh is not None
+            and is_cross_process(self.mesh)
+            and self._device_slide is None
+        ), "pre-mutation budget check above must match _init_device_slide"
         # Kernel VMEM fits-gates depend on the device pod-axis width.
         self.n_pods += insert
         from kubernetriks_tpu.ops.scheduler_kernel import (
@@ -1341,6 +1398,7 @@ class BatchedSimulation:
             pallas_axis=self._batch_axis,
             use_pallas_select=self.use_pallas_select,
             use_megakernel=self.use_megakernel,
+            hpa_seg=self._hpa_seg,
         )
         if self.collect_gauges:
             from kubernetriks_tpu.batched.step import gauge_snapshot
@@ -1383,11 +1441,72 @@ class BatchedSimulation:
 
     # --- readout ------------------------------------------------------------
 
-    def metrics_summary(self) -> Dict:
-        """Cross-cluster reduction into the scalar printer's shape. On a
-        cross-process mesh the metric arrays allgather over DCN first."""
+    def check_autoscaler_bounds(self) -> None:
+        """Raise loudly when a documented autoscaler work bound was crossed
+        and the trajectory has (or is about to) diverge from the scalar
+        semantics (autoscale.py "Remaining bounded deviations"):
+
+        - HPA reserve clamp: an HPA cycle wanted more replicas than the
+          group's reserve had reusable slots for. The scalar
+          (kube_horizontal_pod_autoscaler.rs:157-181) would have created
+          them — counts are already wrong.
+        - CA reserve starvation: a scale-up cycle wanted to open a node for
+          a cache pod — quota headroom and a fitting template existed — but
+          the group's ca_slot_multiplier x max_count slot reserve was
+          consumed (slots are never reclaimed, the batched analog of the
+          reference's pre-sized component pool, src/simulator.rs:212-230 —
+          but the reference RECLAIMS components on scale-down,
+          node_component_pool.rs:60-77, so long churn never exhausts it
+          there). The pod silently stays unscheduled where the scalar would
+          have provisioned a node.
+
+        Both are EXACT observed-divergence counters folded inside the
+        passes (autoscale.py), not state heuristics: a run that merely
+        consumed its reserve without unmet demand does not raise.
+        """
+        if self.autoscale_statics is None or not self.strict_autoscaler_bounds:
+            return
         from kubernetriks_tpu.parallel.multihost import to_host
 
+        clamped = np.asarray(to_host(self.state.metrics.hpa_reserve_clamped))
+        if clamped.sum() > 0:
+            worst = int(clamped.argmax())
+            raise RuntimeError(
+                f"HPA slot reserve exhausted: {int(clamped.sum())} wanted "
+                f"replica(s) across {int((clamped > 0).sum())} cluster(s) "
+                f"(worst: cluster {worst}, {int(clamped[worst])}) could not "
+                "be activated because no reusable slot remained in the pod "
+                "group's reserve — the scalar path would have created them, "
+                "so reported replica counts have diverged. Enlarge the "
+                "group's slot reserve (trace compile pg_slot_count) or "
+                "lower max_pods churn; set strict_autoscaler_bounds=False "
+                "to read the diverged metrics anyway."
+            )
+        starved = np.asarray(to_host(self.state.metrics.ca_reserve_starved))
+        if starved.sum() > 0:
+            worst = int(starved.argmax())
+            raise RuntimeError(
+                f"CA slot reserve exhausted: {int(starved.sum())} "
+                f"scale-up attempt(s) across {int((starved > 0).sum())} "
+                f"cluster(s) (worst: cluster {worst}, "
+                f"{int(starved[worst])}) found quota headroom and a "
+                "fitting node-group template but no reserved slot left — "
+                "scaled-up slots are never reclaimed, so the demand "
+                "silently starved where the scalar path would have "
+                "provisioned a node. Raise ca_slot_multiplier (build arg) "
+                "to widen the reserve, or set "
+                "strict_autoscaler_bounds=False to accept the starved "
+                "trajectory."
+            )
+
+    def metrics_summary(self) -> Dict:
+        """Cross-cluster reduction into the scalar printer's shape. On a
+        cross-process mesh the metric arrays allgather over DCN first.
+        Raises via check_autoscaler_bounds when a documented autoscaler
+        work bound was crossed (divergence would otherwise be silent)."""
+        from kubernetriks_tpu.parallel.multihost import to_host
+
+        self.check_autoscaler_bounds()
         m = jax.tree.map(to_host, self.state.metrics)
 
         def est(e):
@@ -1534,10 +1653,13 @@ class BatchedSimulation:
                 while self.pod_window < saved_window:
                     if not self._grow_pod_window():
                         break
-                assert self.pod_window == saved_window, (
-                    f"checkpoint was saved at pod_window={saved_window}; "
-                    f"this engine is at {self.pod_window} and cannot match"
-                )
+                if self.pod_window != saved_window:
+                    # Not an assert: under python -O the mismatch would
+                    # surface later as an opaque ckpt_restore shape error.
+                    raise ValueError(
+                        f"checkpoint was saved at pod_window={saved_window}; "
+                        f"this engine is at {self.pod_window} and cannot match"
+                    )
         restored = ckpt_restore(path, self._ckpt_payload())
         self.state = restored["state"]
         self.next_window_idx = int(restored["next_window_idx"])

@@ -147,6 +147,17 @@ class MetricArrays(NamedTuple):
     scaled_down_pods: jnp.ndarray  # int32 (HPA)
     scaled_up_nodes: jnp.ndarray  # int32 (CA)
     scaled_down_nodes: jnp.ndarray  # int32 (CA)
+    # Replicas an HPA cycle wanted but could not activate because the
+    # group's slot reserve had no reusable slot (autoscale.py "Remaining
+    # bounded deviations"); nonzero means the run diverged from the scalar
+    # trajectory and the engine raises loudly at readout
+    # (engine.check_autoscaler_bounds) instead of reporting wrong counts.
+    hpa_reserve_clamped: jnp.ndarray  # int32
+    # CA scale-up open attempts blocked ONLY by the consumed (never
+    # reclaimed) slot reserve while the group had quota headroom and a
+    # fitting template — the CA-side silent divergence, same loud-readout
+    # treatment.
+    ca_reserve_starved: jnp.ndarray  # int32
     queue_time: EstArrays
     algo_latency: EstArrays
     pod_duration: EstArrays
@@ -318,6 +329,8 @@ def init_state(
         scaled_down_pods=jnp.zeros((C,), jnp.int32),
         scaled_up_nodes=jnp.zeros((C,), jnp.int32),
         scaled_down_nodes=jnp.zeros((C,), jnp.int32),
+        hpa_reserve_clamped=jnp.zeros((C,), jnp.int32),
+        ca_reserve_starved=jnp.zeros((C,), jnp.int32),
         queue_time=EstArrays.zeros((C,)),
         algo_latency=EstArrays.zeros((C,)),
         pod_duration=EstArrays.zeros((C,)),

@@ -1269,6 +1269,7 @@ def _window_body(
     pallas_axis: str = "clusters",
     use_pallas_select: bool = False,
     use_megakernel: bool = True,
+    hpa_seg=None,
 ) -> ClusterBatchState:
     W = jnp.broadcast_to(jnp.asarray(W, jnp.int32), state.time.shape)
     state, wake = _apply_window_events(
@@ -1322,7 +1323,13 @@ def _window_body(
         from kubernetriks_tpu.batched.autoscale import ca_pass, hpa_pass
 
         auto = state.auto
-        state, auto = hpa_pass(state, auto, autoscale_statics, W, consts)
+        # hpa_seg: STATIC (lo, hi) group-slot bounds (engine._hpa_seg) so
+        # the HPA body and its not-due cond carry only the group slice;
+        # (0, 0) = no group slots anywhere, skip the pass entirely.
+        if hpa_seg != (0, 0):
+            state, auto = hpa_pass(
+                state, auto, autoscale_statics, W, consts, seg=hpa_seg
+            )
         state, auto = ca_pass(
             state,
             auto,
@@ -1397,6 +1404,7 @@ _STEP_STATICS = (
     "pallas_axis",
     "use_pallas_select",
     "use_megakernel",
+    "hpa_seg",
 )
 
 
@@ -1418,6 +1426,7 @@ def window_step(
     pallas_axis: str = "clusters",
     use_pallas_select: bool = False,
     use_megakernel: bool = True,
+    hpa_seg=None,
 ) -> ClusterBatchState:
     """Advance every cluster through scheduling-cycle window index W."""
     return _window_body(
@@ -1437,6 +1446,7 @@ def window_step(
         pallas_axis,
         use_pallas_select,
         use_megakernel=use_megakernel,
+        hpa_seg=hpa_seg,
     )
 
 
@@ -1606,6 +1616,7 @@ def run_windows_skip(
     use_pallas_select: bool = False,
     use_megakernel: bool = True,
     flush_windows: int = 3,
+    hpa_seg=None,
 ):
     """run_windows with FAST-FORWARD over provably no-op windows: a dynamic
     while_loop executes only interesting windows (see
@@ -1638,6 +1649,7 @@ def run_windows_skip(
             pallas_axis,
             use_pallas_select,
             use_megakernel=use_megakernel,
+            hpa_seg=hpa_seg,
         )
         W_next = jnp.minimum(
             _next_interesting_window(
@@ -1675,6 +1687,7 @@ def run_windows(
     pallas_axis: str = "clusters",
     use_pallas_select: bool = False,
     use_megakernel: bool = True,
+    hpa_seg=None,
 ):
     """Scan a whole sequence of scheduling-cycle windows on-device (the hot
     benchmark loop: no host round-trips between cycles). window_idxs: (Wn,)
@@ -1702,6 +1715,7 @@ def run_windows(
             pallas_axis,
             use_pallas_select,
             use_megakernel=use_megakernel,
+            hpa_seg=hpa_seg,
         )
         return new, (gauge_snapshot(new) if collect_gauges else None)
 

@@ -108,12 +108,15 @@ def build_batched_simulation(
     n_clusters: int,
     max_pods_per_cycle: int = 0,
     pod_window: int = 0,
+    **engine_kwargs,
 ):
     """Build a BatchedSimulation from the config's trace source.
 
     Alibaba + native feeder: CSVs parse natively into dense arrays and
     compile via compile_from_arrays — no per-event Python objects on the
     multi-million-row pod axis. Otherwise: the object-based trace path.
+    engine_kwargs pass through to the BatchedSimulation constructor
+    (e.g. ca_slot_multiplier, use_pallas, mesh).
     """
     from kubernetriks_tpu.batched.engine import (
         BatchedSimulation,
@@ -132,6 +135,7 @@ def build_batched_simulation(
     kwargs = {"max_pods_per_cycle": max_pods_per_cycle or 256}
     if pod_window:
         kwargs["pod_window"] = pod_window
+    kwargs.update(engine_kwargs)
 
     trace_config = config.trace_config
     alibaba = trace_config.alibaba_cluster_trace_v2017 if trace_config else None

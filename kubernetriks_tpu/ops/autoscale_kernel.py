@@ -276,6 +276,7 @@ def _ca_up_kernel(
     crr_ref,       # (Kp, LC) int32 candidate req ram
     planned_out,   # (Sp, LC) int32
     gpl_out,       # (Gp, LC) int32 planned per group
+    starved_out,   # (8, LC) int32 row0: reserve-starved open attempts
     seq_ref,       # (Sp, LC) int32 scratch: plan order
     pcpu_ref,      # (Sp, LC) int32 scratch: virtual allocatable cpu
     pram_ref,      # (Sp, LC) int32 scratch: virtual allocatable ram
@@ -295,6 +296,7 @@ def _ca_up_kernel(
 
     planned_out[:] = jnp.zeros_like(planned_out)
     gpl_out[:] = jnp.zeros_like(gpl_out)
+    starved_out[:] = jnp.zeros_like(starved_out)
     seq_ref[:] = jnp.zeros_like(seq_ref) + bigi
     pcpu_ref[:] = jnp.zeros_like(pcpu_ref)
     pram_ref[:] = jnp.zeros_like(pram_ref)
@@ -344,6 +346,23 @@ def _ca_up_kernel(
         )
         first_g = jnp.min(jnp.where(g_ok, iota_g, bigi), axis=0, keepdims=True)
         open_ = can_open & (first_g < bigi)
+        # Reserve starvation: a group would accept this pod (quota headroom
+        # + template fit) but its never-reclaimed slot reserve is consumed
+        # — the silent-divergence case engine.check_autoscaler_bounds
+        # surfaces loudly (same predicate as the XLA path).
+        g_ok_nc = (
+            ((gmax_ref[:] < i0) | (gcount < gmax_ref[:]))
+            & (gslots_ref[:] > i0)
+            & (rc <= tmplc_ref[:])
+            & (rr <= tmplr_ref[:])
+        )
+        any_nc = (
+            jnp.max(jnp.where(g_ok_nc, i1, i0), axis=0, keepdims=True) > i0
+        )
+        starved = can_open & ~(first_g < bigi) & any_nc
+        starved_out[0:1, :] = (
+            starved_out[0:1, :] + starved.astype(jnp.int32)
+        )
         g_oh = (iota_g == first_g) & open_  # (Gp, LC)
         g_ohi = g_oh.astype(jnp.int32)
         s_new = jnp.sum(
@@ -386,7 +405,9 @@ def fused_ca_scale_up(
     n_slots: int = 0,
     interpret: bool = False,
 ):
-    """Returns (planned (C, S) bool, planned_per_group (C, Gn) int32)."""
+    """Returns (planned (C, S) bool, planned_per_group (C, Gn) int32,
+    reserve_starved (C, 1) int32 — open attempts blocked ONLY by the
+    consumed slot reserve)."""
     C, Gn = ca_count.shape
     K = cvalid.shape[1]
     S = n_slots
@@ -419,14 +440,15 @@ def fused_ca_scale_up(
     k_spec = pl.BlockSpec((Kp, _LANE), lambda i: (0, i), memory_space=pltpu.VMEM)
 
     with jax.enable_x64(False):
-        planned_o, gpl_o = pl.pallas_call(
+        planned_o, gpl_o, starved_o = pl.pallas_call(
             _ca_up_kernel,
             grid=(Cp // _LANE,),
             in_specs=[meta_spec] + [group_spec] * 7 + [k_spec] * 3,
-            out_specs=[slot_spec, group_spec],
+            out_specs=[slot_spec, group_spec, meta_spec],
             out_shape=[
                 jax.ShapeDtypeStruct((Sp, Cp), jnp.int32),
                 jax.ShapeDtypeStruct((Gp, Cp), jnp.int32),
+                jax.ShapeDtypeStruct((_SUB, Cp), jnp.int32),
             ],
             scratch_shapes=[
                 pltpu.VMEM((Sp, _LANE), jnp.int32),
@@ -440,4 +462,5 @@ def fused_ca_scale_up(
             interpret=interpret,
         )(*args)
 
-    return planned_o[:S, :C].T != 0, gpl_o[:Gn, :C].T
+    # starved as (C, 1) so shard_map's uniform (axis, None) out_specs apply.
+    return planned_o[:S, :C].T != 0, gpl_o[:Gn, :C].T, starved_o[0:1, :C].T

@@ -129,7 +129,7 @@ def main(pid: int, port: str) -> None:
     assert ssim._device_slide is not None
     assert not ssim.state.pods.phase.is_fully_addressable
     ssim.step_until_time(400.0)
-    # The 40 long-running head pods forced growth past 16; the short tail
+    # The 30 long-running head pods forced growth past 16; the short tail
     # then slid the grown window.
     assert ssim.pod_window > 16, "window never grew"
     assert ssim._pod_base > 0, "window never slid"

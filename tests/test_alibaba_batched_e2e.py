@@ -121,7 +121,12 @@ def test_alibaba_replay_batched_with_cluster_autoscaler(tmp_path):
         max_nodes=64, node_name="alibaba_ca_node",
     )
 
-    batched = build_batched_simulation(config, n_clusters=2)
+    # ca_slot_multiplier=4: this contended trace churns 156 node opens per
+    # cluster (measured), past the default 2 x 64 reserve — the strict
+    # reserve check (engine.check_autoscaler_bounds) would raise. The wider
+    # reserve keeps the batched trajectory reference-faithful (the scalar
+    # pool reclaims components and never starves).
+    batched = build_batched_simulation(config, n_clusters=2, ca_slot_multiplier=4)
     batched.run_to_completion(max_time=1e6)
     bm = batched.metrics_summary()
 
@@ -137,7 +142,7 @@ def test_alibaba_replay_batched_with_cluster_autoscaler(tmp_path):
 
 
 def _assert_windowed_matches_full(config, machines, tasks, instances,
-                                  pod_window, n_clusters=1):
+                                  pod_window, n_clusters=1, **build_kwargs):
     """Run the same compiled trace full-resident and through a sliding pod
     window; the window must actually slide and every terminal counter and
     timing stat must match."""
@@ -150,14 +155,14 @@ def _assert_windowed_matches_full(config, machines, tasks, instances,
     compiled = compile_from_arrays(ca, wa, config)
 
     full = BatchedSimulation(
-        config, [compiled] * n_clusters, max_pods_per_cycle=64
+        config, [compiled] * n_clusters, max_pods_per_cycle=64, **build_kwargs
     )
     full.run_to_completion(max_time=1e6)
     fm = full.metrics_summary()
 
     windowed = BatchedSimulation(
         config, [compiled] * n_clusters, max_pods_per_cycle=64,
-        pod_window=pod_window,
+        pod_window=pod_window, **build_kwargs,
     )
     assert windowed.n_pods == pod_window < full.n_pods
     windowed.run_to_completion(max_time=1e6)
@@ -190,7 +195,10 @@ def test_sliding_pod_window_with_autoscaler_and_failures(tmp_path):
         tmp_path, n_machines=8, n_tasks=160, error_fraction=0.25, seed=31,
         max_nodes=32, node_name="win_ca_node",
     )
+    # ca_slot_multiplier=4: churn past the default reserve (see the replay
+    # test above) — widened so the strict reserve check stays quiet.
     fm = _assert_windowed_matches_full(
-        config, machines, tasks, instances, pod_window=192
+        config, machines, tasks, instances, pod_window=192,
+        ca_slot_multiplier=4,
     )
     assert fm["counters"]["total_scaled_up_nodes"] > 0

@@ -72,6 +72,10 @@ def _build(mixed_traces, **kwargs):
         list(workload),
         n_clusters=N_CLUSTERS,
         max_pods_per_cycle=16,
+        # This scenario churns 22 CA node opens (measured) past the default
+        # 2 x 10 reserve; the wider reserve keeps the composed run
+        # reference-faithful under the strict reserve check.
+        ca_slot_multiplier=4,
         **kwargs,
     )
 
