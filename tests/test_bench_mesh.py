@@ -1,0 +1,25 @@
+"""Smoke for scripts/bench_mesh.py: the one-command mesh benchmark runs
+end to end on the suite's 8-device virtual CPU mesh and reports a sane
+JSON record (the runnable form of the README's v5e-8 projection — see
+bench_mesh.py docstring)."""
+
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts")
+)
+
+import bench_mesh
+
+
+def test_bench_mesh_smoke_runs_on_virtual_mesh():
+    result = bench_mesh.run_mesh(
+        8, clusters_per_device=2, n_nodes=8,
+        horizon=200.0, warm_until=50.0, chunk=50.0,
+    )
+    assert result["devices"] == 8
+    assert result["platform"] == "cpu"
+    assert result["decisions"] > 0
+    assert result["value"] > 0
+    assert "8-device mesh, 16x8-node clusters" in result["metric"]
