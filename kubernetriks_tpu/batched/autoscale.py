@@ -528,6 +528,21 @@ def _ca_scale_up(
     in_cache = (phase_v == PHASE_UNSCHEDULABLE) | (
         (phase_v == PHASE_QUEUED) & (attempts_v >= 2)
     )
+
+    from kubernetriks_tpu.ops.autoscale_kernel import (
+        ca_up_kernel_fits,
+        fused_ca_scale_up,
+    )
+
+    # NOTE (r5, measured dead end): moving the candidate ordering in-kernel
+    # (an iterated 4-key argmin over (P, 128) VMEM pod tiles, mirroring the
+    # scheduler's selection kernel) REGRESSED the composed bench 182k ->
+    # 176k decisions/s: with a deep cache the loop runs all K_up=64 serial
+    # sweeps of 7 (P, 128) tiles (~9.6 ms/window in the xplane profile)
+    # while the XLA 4-key sort below costs ~0.06 ms — the scheduler kernel's
+    # early-exit win does not transfer because CA backlogs keep k_bound
+    # pegged at K_up. See docs/DESIGN.md §3.
+
     # The storage snapshot is NAME-sorted (scale_up_info, reference
     # persistent_storage.rs:137-146) and bin-packing consumes it in that
     # order. pod_name_rank carries the static lexicographic ranks (BIG for
@@ -546,11 +561,6 @@ def _ca_scale_up(
     cvalid = in_cache[rows, order] & branch[:, None]
     creq_cpu = pods.req_cpu[rows, order]
     creq_ram = pods.req_ram[rows, order]
-
-    from kubernetriks_tpu.ops.autoscale_kernel import (
-        ca_up_kernel_fits,
-        fused_ca_scale_up,
-    )
 
     if use_pallas and ca_up_kernel_fits(S, Gn, K_up):
         core = partial(
@@ -712,7 +722,6 @@ def _ca_scale_down(
     rows1 = jnp.arange(C, dtype=jnp.int32)
     rows = rows1[:, None]
     col_n = jnp.arange(N, dtype=jnp.int32)[None, :]
-    iota_p = jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32)[None, :], (C, P))
 
     snap_p = _broadcast_pair(snap, (C, P))
     # Running pod whose finish notification reached storage by snap: gone.
@@ -732,7 +741,9 @@ def _ca_scale_down(
     vis_removed = (phase_v == PHASE_RUNNING) & t_le(pods.removal_time, snap_p)
     vis_gone = vis_gone | vis_removed
 
-    # Virtual allocatables as the storage sees them.
+    # Virtual allocatables as the storage sees them. ONE stacked scatter-add
+    # for cpu+ram: XLA's TPU scatter lowering costs per-index, so halving
+    # the index count halves the dominant cost (xplane-measured r5).
     node_c = jnp.clip(pods.node, 0, N - 1)
     d_cpu = jnp.where(vis_gone, pods.req_cpu, 0) - jnp.where(
         vis_back, pods.req_cpu, 0
@@ -741,31 +752,40 @@ def _ca_scale_down(
         vis_back, pods.req_ram, 0
     )
     touched = vis_gone | vis_back
-    alloc_cpu_v = alloc_cpu_v.at[rows, jnp.where(touched, node_c, N)].add(
-        d_cpu, mode="drop"
+    alloc_v = (
+        jnp.stack([alloc_cpu_v, alloc_ram_v], axis=-1)
+        .at[rows, jnp.where(touched, node_c, N)]
+        .add(jnp.stack([d_cpu, d_ram], axis=-1), mode="drop")
     )
-    alloc_ram_v = alloc_ram_v.at[rows, jnp.where(touched, node_c, N)].add(
-        d_ram, mode="drop"
-    )
+    alloc_cpu_v = alloc_v[..., 0]
+    alloc_ram_v = alloc_v[..., 1]
 
     # Group storage-visible running pods by assigned node ONCE (a per-slot
     # (C, P) mask + argsort made the pass O(S * P log P) per window — fatal
     # at trace scale); each node's pods become a contiguous segment of
-    # `porder`, located by a scatter-min first-index and scatter-add count.
+    # `porder`. The pod requests ride the sort as VALUES, so the per-
+    # candidate tables below slice sorted arrays instead of gathering
+    # through pod_order (one fewer (C, S*K_sd) gather). Segment starts and
+    # counts come from rank-count reductions over the sorted keys — a
+    # fused (C, P, N) compare+sum — instead of the serial per-index
+    # scatter-min/scatter-add pair (~2.3 ms/window at the composed shape).
     on_any = ((phase_v == PHASE_RUNNING) & ~vis_gone) | vis_back
     key_node = jnp.where(on_any, pods.node, jnp.int32(N))
-    key_sorted, porder = jax.lax.sort(
-        (key_node, iota_p), dimension=1, num_keys=1, is_stable=True
+    key_sorted, rc_sorted, rr_sorted = jax.lax.sort(
+        (key_node, pods.req_cpu, pods.req_ram),
+        dimension=1,
+        num_keys=1,
+        is_stable=True,
     )
-    seg_start = (
-        jnp.full((C, N), P, jnp.int32)
-        .at[rows, jnp.where(key_sorted < N, key_sorted, N)]
-        .min(iota_p, mode="drop")
+    # seg_start[n] = #pods on nodes < n = first sorted position of node n's
+    # segment (for a pod-less node this lands on the next segment instead
+    # of the old scatter-min's P sentinel — all consumers mask by
+    # seg_count == 0 first, so the value is never read).
+    seg_start = (key_sorted[:, :, None] < col_n[:, None, :]).sum(
+        axis=1, dtype=jnp.int32
     )
-    seg_count = (
-        jnp.zeros((C, N), jnp.int32)
-        .at[rows, jnp.where(on_any, jnp.clip(key_node, 0, N - 1), N)]
-        .add(on_any.astype(jnp.int32), mode="drop")
+    seg_count = (key_sorted[:, :, None] == col_n[:, None, :]).sum(
+        axis=1, dtype=jnp.int32
     )
     col_k = jnp.arange(K_sd, dtype=jnp.int32)[None, :]
 
@@ -782,9 +802,10 @@ def _ca_scale_down(
     )
 
     if use_pallas and ca_down_kernel_fits(N, S, K_sd):
-        # Pre-gather the per-candidate pod tables in name order — cheap
-        # vectorized XLA gathers — so the kernel walks VMEM-resident tiles
-        # and never touches the (C, P) pod axis.
+        # Per-candidate pod tables in name order, via ONE stacked gather
+        # from the sort-carried request values (gather cost is per-index on
+        # TPU; the old porder->req double indirection paid three (C, S*K)
+        # gathers — xplane-measured ~4 ms/window at the composed shape).
         cnt_perm = jnp.where(
             slot_perm >= 0, seg_count[rows, slotc_perm], 0
         )
@@ -794,9 +815,13 @@ def _ca_scale_down(
             0,
             P - 1,
         ).reshape(C, S * K_sd)
-        pod_order = jnp.take_along_axis(porder, take, axis=1)  # (C, S*K)
-        pr_cpu = jnp.take_along_axis(pods.req_cpu, pod_order, axis=1)
-        pr_ram = jnp.take_along_axis(pods.req_ram, pod_order, axis=1)
+        pr = jnp.take_along_axis(
+            jnp.stack([rc_sorted, rr_sorted], axis=-1),
+            take[:, :, None],
+            axis=1,
+        )
+        pr_cpu = pr[..., 0]
+        pr_ram = pr[..., 1]
         pv0 = (
             jnp.arange(K_sd, dtype=jnp.int32)[None, None, :]
             < cnt_perm[:, :, None]
@@ -879,10 +904,9 @@ def _ca_scale_down(
 
         seg_pos = jnp.clip(seg_start[rows1, slotc], 0, P - 1)
         take = jnp.clip(seg_pos[:, None] + col_k, 0, P - 1)
-        pod_order = porder[rows1[:, None], take]
         pvalid = (col_k < cnt[:, None]) & attempt[:, None]
-        prcpu = pods.req_cpu[rows, pod_order]
-        prram = pods.req_ram[rows, pod_order]
+        prcpu = rc_sorted[rows, take]
+        prram = rr_sorted[rows, take]
 
         save_cpu, save_ram = valloc_cpu, valloc_ram
 

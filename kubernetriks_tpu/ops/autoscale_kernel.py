@@ -464,3 +464,4 @@ def fused_ca_scale_up(
 
     # starved as (C, 1) so shard_map's uniform (axis, None) out_specs apply.
     return planned_o[:S, :C].T != 0, gpl_o[:Gn, :C].T, starved_o[0:1, :C].T
+
