@@ -741,9 +741,12 @@ def _ca_scale_down(
     vis_removed = (phase_v == PHASE_RUNNING) & t_le(pods.removal_time, snap_p)
     vis_gone = vis_gone | vis_removed
 
-    # Virtual allocatables as the storage sees them. ONE stacked scatter-add
-    # for cpu+ram: XLA's TPU scatter lowering costs per-index, so halving
-    # the index count halves the dominant cost (xplane-measured r5).
+    # Virtual allocatables as the storage sees them. The per-node
+    # correction sums are SEGMENT SUMS over a node-sorted copy of the
+    # deltas (sort + cumsum + boundary gathers) instead of a (C, P)
+    # scatter-add: XLA's TPU scatter lowering costs per-index
+    # (xplane-measured ~1.1 ms/window at the composed shape; this
+    # formulation is ~0.3). Integer adds, so any summation order is exact.
     node_c = jnp.clip(pods.node, 0, N - 1)
     d_cpu = jnp.where(vis_gone, pods.req_cpu, 0) - jnp.where(
         vis_back, pods.req_cpu, 0
@@ -752,13 +755,21 @@ def _ca_scale_down(
         vis_back, pods.req_ram, 0
     )
     touched = vis_gone | vis_back
-    alloc_v = (
-        jnp.stack([alloc_cpu_v, alloc_ram_v], axis=-1)
-        .at[rows, jnp.where(touched, node_c, N)]
-        .add(jnp.stack([d_cpu, d_ram], axis=-1), mode="drop")
+    tkey = jnp.where(touched, node_c, jnp.int32(N))
+    tkey_s, dc_s, dr_s = jax.lax.sort(
+        (tkey, d_cpu, d_ram), dimension=1, num_keys=1, is_stable=True
     )
-    alloc_cpu_v = alloc_v[..., 0]
-    alloc_ram_v = alloc_v[..., 1]
+    zero_col = jnp.zeros((C, 1), jnp.int32)
+    ecs_c = jnp.concatenate([zero_col, jnp.cumsum(dc_s, axis=1)], axis=1)
+    ecs_r = jnp.concatenate([zero_col, jnp.cumsum(dr_s, axis=1)], axis=1)
+    tstart = (tkey_s[:, :, None] < col_n[:, None, :]).sum(
+        axis=1, dtype=jnp.int32
+    )
+    tend = tstart + (tkey_s[:, :, None] == col_n[:, None, :]).sum(
+        axis=1, dtype=jnp.int32
+    )
+    alloc_cpu_v = alloc_cpu_v + ecs_c[rows, tend] - ecs_c[rows, tstart]
+    alloc_ram_v = alloc_ram_v + ecs_r[rows, tend] - ecs_r[rows, tstart]
 
     # Group storage-visible running pods by assigned node ONCE (a per-slot
     # (C, P) mask + argsort made the pass O(S * P log P) per window — fatal
