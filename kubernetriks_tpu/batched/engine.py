@@ -477,6 +477,19 @@ class BatchedSimulation:
         self._full_pods = None
         self._resident_shift = 0
 
+        # Full-resident runs 128-align the pod axis: the Pallas wrapper pads
+        # (operand copies from jnp.pad before every kernel launch) become
+        # no-ops when P is already a tile multiple. Padded slots are exactly
+        # batch-padding slots (req 0, duration sentinel, no create event —
+        # phase stays EMPTY forever). The sliding path keeps exact widths:
+        # its segmented [window | resident] layout derives device offsets
+        # from the plain-slot count, and the device window W is already the
+        # caller's tile-friendly choice.
+        n_pods_aligned = None
+        if pod_window is None and os.environ.get("KTPU_ALIGN_PODS", "1") != "0":
+            p_max = max((c.n_pods for c in compiled_traces), default=0)
+            n_pods_aligned = -(-max(p_max, 1) // 128) * 128
+
         (
             ev_time,
             ev_kind,
@@ -486,7 +499,7 @@ class BatchedSimulation:
             pod_req_cpu,
             pod_req_ram,
             pod_duration,
-        ) = pad_and_batch(compiled_traces)
+        ) = pad_and_batch(compiled_traces, n_pods=n_pods_aligned)
 
         if pod_window is not None:
             # Cross-process meshes are supported through the device-resident
