@@ -603,6 +603,9 @@ class BatchedSimulation:
         self.n_clusters = C
         self.n_nodes = node_cap_cpu.shape[1]
         self.n_pods = pod_req_cpu.shape[1]
+        # Real (trace-defined) pod slots, before the 128-alignment padding
+        # of the device axis — the count completion/terminal asserts want.
+        self.n_real_pods = max((c.n_pods for c in compiled_traces), default=0)
         self.n_events = ev_time.shape[1]
 
         # Per-window event application runs in CHUNKS of this size inside a

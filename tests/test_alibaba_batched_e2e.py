@@ -130,7 +130,7 @@ def test_alibaba_replay_batched_with_cluster_autoscaler(tmp_path):
     batched.run_to_completion(max_time=1e6)
     bm = batched.metrics_summary()
 
-    n_pods = batched.n_pods
+    n_pods = batched.n_real_pods  # device n_pods is 128-align padded
     assert bm["counters"]["total_scaled_up_nodes"] > 0
     # Every instance terminates (succeeded; none are removed in this trace).
     assert bm["counters"]["pods_succeeded"] == 2 * n_pods

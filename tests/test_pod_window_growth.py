@@ -69,9 +69,13 @@ def test_window_grows_and_matches_resident():
     assert (
         sim.metrics_summary()["counters"]["pods_succeeded"] == 200 * N_CLUSTERS
     )
-    # Fully grown (window == whole plain segment): same terminal phases.
+    # Fully grown (window == whole plain segment): same terminal phases on
+    # the real slots (the resident build's device axis is 128-align padded
+    # with EMPTY slots beyond them).
+    P_real = np.asarray(sim.state.pods.phase).shape[1]
     assert np.array_equal(
-        np.asarray(ref.state.pods.phase), np.asarray(sim.state.pods.phase)
+        np.asarray(ref.state.pods.phase)[:, :P_real],
+        np.asarray(sim.state.pods.phase),
     )
 
 
