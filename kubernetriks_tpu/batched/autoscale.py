@@ -618,27 +618,28 @@ def _ca_scale_up(
         # Else open a node from the first fitting group (name-sorted at build).
         can_open = valid & ~any_fit & (total < st.ca_max_nodes)
         gcount = auto.ca_count + g_planned
-        g_ok = (
+        # Base eligibility (quota headroom + template fit); g_ok adds the
+        # slot-reserve cursor bound. Deriving g_ok from the base keeps the
+        # starvation counter's "blocked ONLY by the reserve" invariant in
+        # lockstep with the actual open decision.
+        g_ok_nc = (
             ((st.ng_max_count < 0) | (gcount < st.ng_max_count))
-            & (auto.ca_cursor + g_planned < st.ng_slot_count)
             & (rcpu[:, None] <= st.ng_tmpl_cpu)
             & (rram[:, None] <= st.ng_tmpl_ram)
         )
+        g_ok = g_ok_nc & (auto.ca_cursor + g_planned < st.ng_slot_count)
         g_found = g_ok.any(axis=1)
         g = jax.lax.argmax(g_ok, 1, jnp.int32)
         open_ = can_open & g_found
         # Reserve starvation: a group would accept this pod (quota headroom
-        # + template fit) but its never-reclaimed slot reserve is consumed
-        # (autoscale.py "Remaining bounded deviations") — counted so the
-        # engine can raise loudly instead of silently diverging.
-        g_ok_nc = (
-            ((st.ng_max_count < 0) | (gcount < st.ng_max_count))
-            & (st.ng_slot_count > 0)
-            & (rcpu[:, None] <= st.ng_tmpl_cpu)
-            & (rram[:, None] <= st.ng_tmpl_ram)
-        )
+        # + template fit, with a real reserve) but its never-reclaimed slot
+        # reserve is consumed (autoscale.py "Remaining bounded deviations")
+        # — counted so the engine raises loudly instead of silently
+        # diverging.
         starved = starved + (
-            can_open & ~g_found & g_ok_nc.any(axis=1)
+            can_open
+            & ~g_found
+            & (g_ok_nc & (st.ng_slot_count > 0)).any(axis=1)
         ).astype(jnp.int32)
         s_new = (
             st.ng_ca_start[rows1, g]

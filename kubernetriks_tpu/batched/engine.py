@@ -824,6 +824,17 @@ class BatchedSimulation:
                 "slide path needs every shard addressable)"
             )
 
+    def _slide_payload_fits(self, W: int) -> bool:
+        """Whether the device-resident slide payload at window width W fits
+        the memory budget — the ONE owner of the payload-size formula, used
+        by _init_device_slide and by _grow_pod_window's pre-mutation check
+        (req x2, dur pair x2, create window, + name ranks with statics)."""
+        if self._full_pods is None:
+            return False
+        C, T = self._full_pods["req_cpu"].shape
+        n_i32 = 5 + (1 if self.autoscale_statics is not None else 0)
+        return C * (T + W) * 4 * n_i32 <= _DEVICE_SLIDE_BUDGET_BYTES
+
     def _init_device_slide(self) -> None:
         """Upload the slide payload (pod requests, durations, create
         windows, name ranks over the PLAIN trace segment) to the device so
@@ -840,8 +851,7 @@ class BatchedSimulation:
         C, T = full["req_cpu"].shape
         W = self.pod_window
         has_rank = self.autoscale_statics is not None
-        n_i32 = 5 + (1 if has_rank else 0)  # req x2, dur pair x2, create, rank
-        if C * (T + W) * 4 * n_i32 > _DEVICE_SLIDE_BUDGET_BYTES:
+        if not self._slide_payload_fits(W):
             return
         no_create = np.iinfo(np.int32).max
 
@@ -1265,17 +1275,15 @@ class BatchedSimulation:
             self.mesh is not None
             and is_cross_process(self.mesh)
             and self._full_pods is not None
+            and not self._slide_payload_fits(new_W)
         ):
-            C_full, T_full = self._full_pods["req_cpu"].shape
-            n_i32 = 5 + (1 if self.autoscale_statics is not None else 0)
-            if C_full * (T_full + new_W) * 4 * n_i32 > _DEVICE_SLIDE_BUDGET_BYTES:
-                raise ValueError(
-                    "pod_window growth on a cross-process mesh would push "
-                    "the device-resident slide payload past its memory "
-                    "budget — raise _DEVICE_SLIDE_BUDGET_BYTES, start with "
-                    "a larger pod_window, or drop to a single-process mesh "
-                    "(the host slide path needs every shard addressable)"
-                )
+            raise ValueError(
+                "pod_window growth on a cross-process mesh would push "
+                "the device-resident slide payload past its memory "
+                "budget — raise _DEVICE_SLIDE_BUDGET_BYTES, start with "
+                "a larger pod_window, or drop to a single-process mesh "
+                "(the host slide path needs every shard addressable)"
+            )
         base = self._pod_base
         C = self._pod_create_win.shape[0]
         refill = self._make_refill(base + W, insert)
@@ -1311,7 +1319,7 @@ class BatchedSimulation:
                 ),
                 pg_slot_start=st.pg_slot_start + jnp.int32(insert),
             )
-            if self._hpa_seg != (0, 0):
+            if self._hpa_seg not in (None, (0, 0)):
                 lo, hi = self._hpa_seg
                 self._hpa_seg = (lo + insert, hi + insert)
             self._refresh_name_ranks()  # rebuilds windowed ranks at new_W

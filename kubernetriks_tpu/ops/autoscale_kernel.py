@@ -338,26 +338,29 @@ def _ca_up_kernel(
         counter = scal_ref[1:2, :]
         can_open = valid & ~any_fit & (total < max_nodes)
         gcount = count_ref[:] + gpl_out[:]
-        g_ok = (
-            ((gmax_ref[:] < i0) | (gcount < gmax_ref[:]))
-            & (cursor_ref[:] + gpl_out[:] < gslots_ref[:])
-            & (rc <= tmplc_ref[:])
-            & (rr <= tmplr_ref[:])
-        )
-        first_g = jnp.min(jnp.where(g_ok, iota_g, bigi), axis=0, keepdims=True)
-        open_ = can_open & (first_g < bigi)
-        # Reserve starvation: a group would accept this pod (quota headroom
-        # + template fit) but its never-reclaimed slot reserve is consumed
-        # — the silent-divergence case engine.check_autoscaler_bounds
-        # surfaces loudly (same predicate as the XLA path).
+        # Base eligibility (quota headroom + template fit); g_ok adds the
+        # slot-reserve cursor bound — deriving one from the other keeps the
+        # starvation counter in lockstep with the open decision (same
+        # predicates as the XLA path).
         g_ok_nc = (
             ((gmax_ref[:] < i0) | (gcount < gmax_ref[:]))
-            & (gslots_ref[:] > i0)
             & (rc <= tmplc_ref[:])
             & (rr <= tmplr_ref[:])
         )
+        g_ok = g_ok_nc & (cursor_ref[:] + gpl_out[:] < gslots_ref[:])
+        first_g = jnp.min(jnp.where(g_ok, iota_g, bigi), axis=0, keepdims=True)
+        open_ = can_open & (first_g < bigi)
+        # Reserve starvation: a group would accept this pod (with a real
+        # reserve, gslots > 0) but its never-reclaimed slot reserve is
+        # consumed — the silent-divergence case
+        # engine.check_autoscaler_bounds surfaces loudly.
         any_nc = (
-            jnp.max(jnp.where(g_ok_nc, i1, i0), axis=0, keepdims=True) > i0
+            jnp.max(
+                jnp.where(g_ok_nc & (gslots_ref[:] > i0), i1, i0),
+                axis=0,
+                keepdims=True,
+            )
+            > i0
         )
         starved = can_open & ~(first_g < bigi) & any_nc
         starved_out[0:1, :] = (

@@ -82,3 +82,55 @@ def test_profiling_hooks(tmp_path, caplog):
     for root, _, files in os.walk(tmp_path / "trace"):
         dumped.extend(files)
     assert dumped, "profiler trace directory is empty"
+
+
+def test_pod_axis_alignment_full_resident_only():
+    """Full-resident builds pad the pod axis to a 128 multiple (Pallas
+    wrapper pads become no-ops); padded slots are batch-padding slots that
+    never leave PHASE_EMPTY, and the sliding path keeps exact widths."""
+    import numpy as np
+
+    from kubernetriks_tpu.batched.state import PHASE_EMPTY
+    from kubernetriks_tpu.config import SimulationConfig
+    from kubernetriks_tpu.trace.generator import (
+        PoissonWorkloadTrace,
+        UniformClusterTrace,
+    )
+    from kubernetriks_tpu.batched.engine import build_batched_from_traces
+
+    config = SimulationConfig.from_yaml(
+        "sim_name: align\nseed: 1\nscheduling_cycle_interval: 10.0"
+    )
+    cluster = UniformClusterTrace(4, cpu=16000, ram=32 * 1024**3)
+    workload = PoissonWorkloadTrace(
+        rate_per_second=0.5, horizon=100.0, seed=2, cpu=2000,
+        ram=4 * 1024**3, duration_range=(10.0, 30.0),
+    )
+
+    full = build_batched_from_traces(
+        config,
+        cluster.convert_to_simulator_events(),
+        workload.convert_to_simulator_events(),
+        n_clusters=2,
+    )
+    assert full.n_pods % 128 == 0
+    assert full.n_real_pods <= full.n_pods
+    full.step_until_time(200.0)
+    phases = np.asarray(full.state.pods.phase)
+    assert (phases[:, full.n_real_pods:] == PHASE_EMPTY).all(), (
+        "alignment padding slots must never be touched"
+    )
+
+    windowed = build_batched_from_traces(
+        config,
+        cluster.convert_to_simulator_events(),
+        workload.convert_to_simulator_events(),
+        n_clusters=2,
+        pod_window=16,
+    )
+    assert windowed.n_pods == 16, "sliding path keeps exact widths"
+    windowed.step_until_time(200.0)
+    assert (
+        windowed.metrics_summary()["counters"]
+        == full.metrics_summary()["counters"]
+    )
