@@ -173,9 +173,12 @@ events:
 
     # Warm-up through the HPA burst and several window slides, so both
     # quantized slide shapes and every dispatch-chunk shape compile before
-    # the clock starts (a novel slide shape costs ~7 s of compile through
-    # the tunnel and would otherwise land inside the timed region).
+    # the clock starts (a novel slide or chunk shape costs seconds of
+    # compile through the tunnel and would otherwise land inside the timed
+    # region); precompile_chunks covers ladder shapes the warm span's
+    # binary decomposition happens not to use.
     sim.step_until_time(590.0)
+    sim.precompile_chunks()
     decisions_before = decisions_now()
     t0 = time.perf_counter()
     end = 790.0

@@ -59,6 +59,12 @@ from kubernetriks_tpu.config import (
 # Above this, the engine keeps the host slide path (payloads stay in RAM).
 _DEVICE_SLIDE_BUDGET_BYTES = 2 << 30
 
+# Power-of-two dispatch chunk ladder for the sliding path: any span is its
+# binary decomposition (popcount(span) dispatches), and at most this many
+# program shapes ever compile (engine.step_until_time; precompile_chunks
+# AOT-compiles them up front).
+_CHUNK_LADDER = (128, 64, 32, 16, 8, 4, 2, 1)
+
 
 @jax.jit
 def _slide_shift_device(phase, create_win_pay, base):
@@ -1011,6 +1017,52 @@ class BatchedSimulation:
             self.state = out
         self.next_window_idx = int(idxs[-1]) + 1
 
+    def precompile_chunks(self, max_chunk: int = 128) -> int:
+        """Warm the sliding path's dispatch-chunk program shapes (the
+        power-of-two ladder) so no compile lands inside a timed region — a
+        novel chunk shape costs seconds through the tunneled TPU runtime.
+        Each shape is dispatched once against the CURRENT state and the
+        result discarded (run_windows is pure; self.state is untouched),
+        which both compiles and seeds jit's dispatch cache; already-warm
+        shapes are cache hits. Returns the number of shapes dispatched.
+        No-op on fast-forward or non-sliding engines (one program serves
+        any span there)."""
+        if self.pod_window is None or (
+            self.fast_forward and not self.collect_gauges
+        ):
+            return 0
+        n = 0
+        for chunk in _CHUNK_LADDER:
+            if chunk > max_chunk:
+                continue
+            idxs = jnp.arange(
+                self.next_window_idx, self.next_window_idx + chunk,
+                dtype=jnp.int32,
+            )
+            out = run_windows(
+                self.state,
+                self.slab,
+                idxs,
+                self.consts,
+                self.max_events_per_window,
+                self.max_pods_per_cycle,
+                self.autoscale_statics,
+                self.max_ca_pods_per_cycle,
+                self.max_pods_per_scale_down,
+                self.use_pallas,
+                self.pallas_interpret,
+                self.conditional_move,
+                self.collect_gauges,
+                pallas_mesh=self.mesh if self.use_pallas else None,
+                pallas_axis=self._batch_axis,
+                use_pallas_select=self.use_pallas_select,
+                use_megakernel=self.use_megakernel,
+                hpa_seg=self._hpa_seg,
+            )
+            jax.block_until_ready(out)  # discarded: warm-up only
+            n += 1
+        return n
+
     def step_until_time(self, until_time: float) -> None:
         idxs = self.window_idxs(until_time)
         if len(idxs) == 0:
@@ -1020,19 +1072,20 @@ class BatchedSimulation:
             return
         # Sliding-window dispatch: run sub-spans up to the last window whose
         # pod creations still fit the device window, shifting past terminal
-        # pods between spans. Spans are cut greedily along a geometric chunk
-        # ladder so only len(LADDER) program shapes ever compile while long
-        # spans ride big chunks — ~3x fewer dispatches than fixed 32-window
-        # chunks (per-dispatch overhead is ~20 ms through the tunneled TPU
-        # runtime; replay wall-clock itself is bound by per-window compute,
-        # so this trims the dispatch tax, it does not change the asymptote).
-        LADDER = (128, 32, 8, 1)
+        # pods between spans. Spans are cut greedily along a power-of-two
+        # chunk ladder — the binary decomposition of any span length, so a
+        # span costs popcount(span) dispatches (a 20-window span is 16+4 =
+        # 2 dispatches; the old coarse (128,32,8,1) ladder cut it into
+        # 8+8+1+1+1+1 = 6, and per-dispatch overhead is ~20 ms through the
+        # tunneled TPU runtime — the dispatch tax WAS the composed path's
+        # largest single cost). At most len(LADDER) program shapes compile;
+        # precompile_chunks() AOT-compiles them so none lands mid-bench.
         target = int(idxs[-1])
         while self.next_window_idx <= target:
             sub = min(target, self._pod_capacity_window())
             while self.next_window_idx <= sub:
                 span = sub - self.next_window_idx + 1
-                chunk = next(c for c in LADDER if c <= span)
+                chunk = next(c for c in _CHUNK_LADDER if c <= span)
                 # _step_idxs keeps the profiling/gauge instrumentation on
                 # every dispatch size.
                 self._step_idxs(
