@@ -22,6 +22,11 @@ i.e. 125k decisions/s per chip (BASELINE.json).
 Scenario per shape: Poisson pod arrivals (2 pods/s for 1000 s, ~2k pods per
 cluster), default kube-scheduler filter/score, stepped in 20-window device
 chunks.
+
+`--smoke` runs the same three lines at CPU-safe toy shapes (tiny batches,
+short horizons, no ladder precompile) purely to prove the bench plumbing
+runs and parses end-to-end — the values are meaningless as performance
+numbers. tests/test_bench_smoke.py pins it under JAX_PLATFORMS=cpu.
 """
 
 import json
@@ -33,7 +38,15 @@ import numpy as np
 BASELINE_DECISIONS_PER_SEC_PER_CHIP = 1_000_000 / 8
 
 
-def run_shape(n_clusters: int, n_nodes: int) -> float:
+def run_shape(
+    n_clusters: int,
+    n_nodes: int,
+    *,
+    horizon: float = 1000.0,
+    warm_until: float = 190.0,
+    t_end: float = 1200.0,
+    step: float = 200.0,
+) -> float:
     from kubernetriks_tpu.batched.engine import build_batched_from_traces
     from kubernetriks_tpu.config import SimulationConfig
     from kubernetriks_tpu.trace.generator import (
@@ -47,7 +60,7 @@ def run_shape(n_clusters: int, n_nodes: int) -> float:
     cluster = UniformClusterTrace(n_nodes, cpu=64000, ram=128 * 1024**3)
     workload = PoissonWorkloadTrace(
         rate_per_second=2.0,
-        horizon=1000.0,
+        horizon=horizon,
         seed=3,
         cpu=4000,
         ram=8 * 1024**3,
@@ -68,22 +81,66 @@ def run_shape(n_clusters: int, n_nodes: int) -> float:
         # clock stop and inflate the result.
         return int(np.asarray(sim.state.metrics.scheduling_decisions).sum())
 
-    # Warm-up: 0..190 is 20 windows — the exact chunk shape the timed loop
-    # dispatches, so no compilation happens inside the measured region.
-    sim.step_until_time(190.0)
+    # Warm-up: the default 0..190 is 20 windows — the exact chunk shape the
+    # timed loop dispatches, so no compilation happens inside the measured
+    # region.
+    sim.step_until_time(warm_until)
     decisions_before = decisions_now()
 
     t0 = time.perf_counter()
-    end = 390.0
-    while end <= 1200.0:
-        sim.step_until_time(end)  # 20-window chunks
-        end += 200.0
+    end = warm_until + step
+    while end <= t_end:
+        sim.step_until_time(end)  # fixed-size window chunks
+        end += step
     decisions = decisions_now() - decisions_before
     elapsed = time.perf_counter() - t0
     return decisions / elapsed
 
 
-def run_composed(n_clusters: int = 256, n_nodes: int = 32) -> float:
+COMPOSED_GROUP_YAML = """
+events:
+- timestamp: 49.5
+  event_type:
+    !CreatePodGroup
+      pod_group:
+        name: grp
+        initial_pod_count: 8
+        max_pod_count: {max_pods}
+        pod_template:
+          metadata: {{name: grp}}
+          spec:
+            resources:
+              requests: {{cpu: 8000, ram: 17179869184}}
+              limits: {{cpu: 8000, ram: 17179869184}}
+        target_resources_usage: {{cpu_utilization: 0.5}}
+        resources_usage_model_config:
+          cpu_config:
+            model_name: pod_group
+            config: |
+              - duration: {d1}
+                total_load: 4.0
+              - duration: {d2}
+                total_load: 24.0
+              - duration: {d3}
+                total_load: 2.0
+"""
+
+
+def run_composed(
+    n_clusters: int = 256,
+    n_nodes: int = 32,
+    *,
+    rate_per_second: float = 1.5,
+    horizon: float = 1000.0,
+    pod_window: int = 512,
+    warm_until: float = 590.0,
+    t_end: float = 1200.0,
+    step: float = 200.0,
+    max_group_pods: int = 64,
+    burst: tuple = (300.0, 300.0, 400.0),
+    precompile: bool = True,
+    use_pallas=True,  # True force-on (hardware bench), False off, None auto
+) -> float:
     """The COMPOSED flagship configuration as a tracked line (VERDICT r3
     item 4): HPA pod groups + cluster autoscaler + sliding pod window +
     Pallas kernels on a dense cluster batch. Regressions in the composed
@@ -98,7 +155,7 @@ def run_composed(n_clusters: int = 256, n_nodes: int = 32) -> float:
     from kubernetriks_tpu.trace.generic import GenericWorkloadTrace
 
     config = SimulationConfig.from_yaml(
-        """
+        f"""
 sim_name: bench_composed
 seed: 1
 scheduling_cycle_interval: 10.0
@@ -107,19 +164,19 @@ horizontal_pod_autoscaler:
 cluster_autoscaler:
   enabled: true
   scan_interval: 10.0
-  max_node_count: 32
+  max_node_count: {n_nodes}
   node_groups:
   - node_template:
-      metadata: {name: ca_node}
-      status: {capacity: {cpu: 64000, ram: 137438953472}}
+      metadata: {{name: ca_node}}
+      status: {{capacity: {{cpu: 64000, ram: 137438953472}}}}
 """
     )
     cluster = UniformClusterTrace(n_nodes, cpu=64000, ram=128 * 1024**3)
     # Plain load ~88% of base capacity: the HPA burst pushes past it, so
     # pods park and the CA provisions (and later retires) template nodes.
     plain = PoissonWorkloadTrace(
-        rate_per_second=1.5,
-        horizon=1000.0,
+        rate_per_second=rate_per_second,
+        horizon=horizon,
         seed=3,
         cpu=16000,
         ram=32 * 1024**3,
@@ -127,33 +184,9 @@ cluster_autoscaler:
         name_prefix="plain",
     )
     group = GenericWorkloadTrace.from_yaml(
-        """
-events:
-- timestamp: 49.5
-  event_type:
-    !CreatePodGroup
-      pod_group:
-        name: grp
-        initial_pod_count: 8
-        max_pod_count: 64
-        pod_template:
-          metadata: {name: grp}
-          spec:
-            resources:
-              requests: {cpu: 8000, ram: 17179869184}
-              limits: {cpu: 8000, ram: 17179869184}
-        target_resources_usage: {cpu_utilization: 0.5}
-        resources_usage_model_config:
-          cpu_config:
-            model_name: pod_group
-            config: |
-              - duration: 300.0
-                total_load: 4.0
-              - duration: 300.0
-                total_load: 24.0
-              - duration: 400.0
-                total_load: 2.0
-"""
+        COMPOSED_GROUP_YAML.format(
+            max_pods=max_group_pods, d1=burst[0], d2=burst[1], d3=burst[2]
+        )
     ).convert_to_simulator_events()
     workload = sorted(
         plain.convert_to_simulator_events() + group, key=lambda e: e[0]
@@ -164,8 +197,11 @@ events:
         workload,
         n_clusters=n_clusters,
         max_pods_per_cycle=64,
-        pod_window=512,
-        use_pallas=True,
+        pod_window=pod_window,
+        # Tri-state passes straight through: the engine treats None as the
+        # platform default (the CPU smoke path passes False — it must not
+        # force Pallas kernels onto a host backend).
+        use_pallas=use_pallas,
     )
 
     def decisions_now() -> int:
@@ -175,16 +211,18 @@ events:
     # quantized slide shapes and every dispatch-chunk shape compile before
     # the clock starts (a novel slide or chunk shape costs seconds of
     # compile through the tunnel and would otherwise land inside the timed
-    # region); precompile_chunks covers ladder shapes the warm span's
-    # binary decomposition happens not to use.
-    sim.step_until_time(590.0)
-    sim.precompile_chunks()
+    # region); precompile_chunks covers ladder shapes — including their
+    # fused chunk+slide variants — the warm span's binary decomposition
+    # happens not to use.
+    sim.step_until_time(warm_until)
+    if precompile:
+        sim.precompile_chunks()
     decisions_before = decisions_now()
     t0 = time.perf_counter()
-    end = 790.0
-    while end <= 1200.0:
+    end = warm_until + step
+    while end <= t_end:
         sim.step_until_time(end)
-        end += 200.0
+        end += step
     decisions = decisions_now() - decisions_before
     elapsed = time.perf_counter() - t0
     assert sim._pod_base > 0, "composed bench: pod window never slid"
@@ -194,48 +232,67 @@ events:
     return decisions / elapsed
 
 
-def main() -> None:
-    continuity = run_shape(1024, 256)
+def _emit(metric: str, value: float) -> None:
     print(
         json.dumps(
             {
-                "metric": "pod-scheduling decisions/sec (single chip, 1024x256-node clusters)",
-                "value": round(continuity),
+                "metric": metric,
+                "value": round(value),
                 "unit": "decisions/s",
                 "vs_baseline": round(
-                    continuity / BASELINE_DECISIONS_PER_SEC_PER_CHIP, 3
+                    value / BASELINE_DECISIONS_PER_SEC_PER_CHIP, 3
                 ),
             }
         ),
         flush=True,
     )
-    composed = run_composed()
-    print(
-        json.dumps(
-            {
-                "metric": "pod-scheduling decisions/sec (single chip, composed flagship: 256 clusters x HPA+CA+sliding window+Pallas)",
-                "value": round(composed),
-                "unit": "decisions/s",
-                "vs_baseline": round(
-                    composed / BASELINE_DECISIONS_PER_SEC_PER_CHIP, 3
-                ),
-            }
-        ),
-        flush=True,
+
+
+def main(argv=None) -> None:
+    smoke = "--smoke" in (argv if argv is not None else sys.argv[1:])
+    if smoke:
+        # CPU-safe plumbing check: all three lines must build, run their
+        # full composed machinery (slides, HPA, CA asserts included) and
+        # print parseable JSON. Values are NOT performance numbers.
+        _emit(
+            "pod-scheduling decisions/sec (SMOKE, 4x8-node clusters)",
+            run_shape(4, 8, horizon=200.0, warm_until=90.0, t_end=290.0,
+                      step=100.0),
+        )
+        _emit(
+            "pod-scheduling decisions/sec (SMOKE, composed flagship: "
+            "4 clusters x HPA+CA+sliding window)",
+            run_composed(
+                4, 8, rate_per_second=0.375, horizon=500.0, pod_window=128,
+                warm_until=290.0, t_end=490.0, step=100.0, max_group_pods=16,
+                burst=(100.0, 150.0, 250.0), precompile=False,
+                use_pallas=False,
+            ),
+        )
+        _emit(
+            "pod-scheduling decisions/sec (SMOKE, 4x8-node clusters = "
+            "north-star stand-in)",
+            # Same shape as the continuity line ON PURPOSE: the second run
+            # is a jit-cache hit, so the three-line plumbing check pays one
+            # plain-shape compile, not two. Smoke values are meaningless as
+            # performance numbers either way.
+            run_shape(4, 8, horizon=200.0, warm_until=90.0, t_end=290.0,
+                      step=100.0),
+        )
+        return
+    _emit(
+        "pod-scheduling decisions/sec (single chip, 1024x256-node clusters)",
+        run_shape(1024, 256),
     )
-    north_star = run_shape(1250, 1000)
-    print(
-        json.dumps(
-            {
-                "metric": "pod-scheduling decisions/sec (single chip, 1250x1000-node clusters = north-star per-chip share)",
-                "value": round(north_star),
-                "unit": "decisions/s",
-                "vs_baseline": round(
-                    north_star / BASELINE_DECISIONS_PER_SEC_PER_CHIP, 3
-                ),
-            }
-        ),
-        flush=True,
+    _emit(
+        "pod-scheduling decisions/sec (single chip, composed flagship: "
+        "256 clusters x HPA+CA+sliding window+Pallas)",
+        run_composed(),
+    )
+    _emit(
+        "pod-scheduling decisions/sec (single chip, 1250x1000-node clusters "
+        "= north-star per-chip share)",
+        run_shape(1250, 1000),
     )
 
 

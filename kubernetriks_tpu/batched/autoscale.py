@@ -1135,3 +1135,53 @@ def ca_pass(
         metrics=metrics,
     )
     return state, auto
+
+
+# Donated standalone entry points. Inside the window step the passes are
+# already FUSED into the chunk program (step._window_body calls them in-trace,
+# so there is no separate HPA/CA dispatch in the steady-state loop); these
+# wrappers serve callers that drive a pass by itself (tests, exploratory
+# tools) with the same in-place buffer reuse the donated window entries get.
+# They take the full state ONLY — state.auto carries the AutoscaleState — so
+# donation never sees the same buffer through two arguments (state and a
+# separately-passed auto alias). Bit-identical to the plain calls
+# (tests/test_window_donation_dispatch.py).
+@partial(jax.jit, static_argnames=("seg",), donate_argnums=(0,))
+def hpa_pass_donated(
+    state: ClusterBatchState,
+    st: AutoscaleStatics,
+    W: jnp.ndarray,
+    consts: StepConstants,
+    seg=None,
+) -> ClusterBatchState:
+    state2, auto2 = hpa_pass(state, state.auto, st, W, consts, seg=seg)
+    return state2._replace(auto=auto2)
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "K_up", "K_sd", "use_pallas", "pallas_interpret", "pallas_mesh",
+        "pallas_axis",
+    ),
+    donate_argnums=(0,),
+)
+def ca_pass_donated(
+    state: ClusterBatchState,
+    st: AutoscaleStatics,
+    W: jnp.ndarray,
+    consts: StepConstants,
+    K_up: int,
+    K_sd: int,
+    pre=None,
+    use_pallas: bool = False,
+    pallas_interpret: bool = False,
+    pallas_mesh=None,
+    pallas_axis: str = "clusters",
+) -> ClusterBatchState:
+    state2, auto2 = ca_pass(
+        state, state.auto, st, W, consts, K_up, K_sd, pre=pre,
+        use_pallas=use_pallas, pallas_interpret=pallas_interpret,
+        pallas_mesh=pallas_mesh, pallas_axis=pallas_axis,
+    )
+    return state2._replace(auto=auto2)

@@ -39,9 +39,14 @@ from kubernetriks_tpu.batched.state import (
     TraceSlab,
     init_state,
     make_step_constants,
+    tree_copy,
 )
 from kubernetriks_tpu.batched.timerep import TPair, from_f64_np, to_f64
-from kubernetriks_tpu.batched.step import run_windows, window_step
+from kubernetriks_tpu.batched.step import (
+    _STEP_STATICS,
+    run_windows,
+    window_step,
+)
 from kubernetriks_tpu.batched.trace_compile import (
     CompiledClusterTrace,
     compile_cluster_trace,
@@ -66,8 +71,7 @@ _DEVICE_SLIDE_BUDGET_BYTES = 2 << 30
 _CHUNK_LADDER = (128, 64, 32, 16, 8, 4, 2, 1)
 
 
-@jax.jit
-def _slide_shift_device(phase, create_win_pay, base):
+def _slide_shift_core(phase, create_win_pay, base):
     """The window-shift amount, computed ON DEVICE: the leading run of
     terminal-or-padding pod slots across every cluster (min over C of each
     row's first blocking slot). Bit-identical to the host formulation in
@@ -97,6 +101,166 @@ def _slide_shift_device(phase, create_win_pay, base):
         jnp.int32(W),
     )
     return jnp.min(first_live).astype(jnp.int32)
+
+
+_slide_shift_device = jax.jit(_slide_shift_core)
+
+
+def _quantize_shift_device(s0, W: int):
+    """Device mirror of _advance_pod_window's host shift quantization (same
+    small set of slide amounts, so fused and unfused runs follow identical
+    slide trajectories). s0 == 0 maps to 0 — the fused program's "no slide
+    possible" flag, read back by the engine to trigger window growth."""
+    quantum = max(W // 8, 1)
+    # Largest power of two <= s0 (bit-smear; 0 for s0 == 0), the host path's
+    # 1 << (s.bit_length() - 1) fallback.
+    v = s0
+    for sh in (1, 2, 4, 8, 16):
+        v = v | (v >> sh)
+    s = jnp.where(s0 >= quantum, jnp.int32(quantum), v - (v >> 1))
+    if W // 4 > 0:
+        s = jnp.where(s0 >= W // 4, jnp.int32(W // 4), s)
+    if W // 2 > 0:
+        s = jnp.where(s0 >= W // 2, jnp.int32(W // 2), s)
+    return s.astype(jnp.int32)
+
+
+def _slide_apply_traced(pods, rank, pay, base, s, W: int):
+    """Window slide with a TRACED shift amount (s == 0 is the identity): the
+    gather formulation of _slide_apply_device, so ONE compiled program covers
+    every quantized shift and the slide can fuse into the window-chunk
+    program (_fused_chunk_slide). Bit-identical to the concat path: shifted
+    window slots copy their source slot, refill slots combine the device
+    payload with the SAME fresh-slot constructor init_state uses, and the
+    resident pod-group tail (device slots >= W) is untouched."""
+    from kubernetriks_tpu.batched.state import fresh_pod_arrays
+
+    C, P = pods.phase.shape
+    idx = jnp.arange(P, dtype=jnp.int32)[None, :]  # (1, P)
+    in_window = idx < W
+    refill = in_window & (idx >= (jnp.int32(W) - s))
+    # Window slots shift left by s; refill slots read idx (masked out below);
+    # resident-tail slots are the identity. idx + s < W for every shifted
+    # slot, so the gather never crosses into the resident tail.
+    src_old = jnp.broadcast_to(
+        jnp.where(in_window & ~refill, idx + s, idx), (C, P)
+    )
+    # Refill slot idx's global plain slot is (base + s) + idx; the payload is
+    # padded to T + W columns, which covers every reachable refill column
+    # (slides only happen while base + W < T). Clip for the masked-out rest.
+    pay_cols = pay["req_cpu"].shape[1]
+    pay_col = jnp.broadcast_to(
+        jnp.clip(base + s + idx, 0, pay_cols - 1), (C, P)
+    )
+
+    def pg(a):
+        return jnp.take_along_axis(a, pay_col, axis=1)
+
+    fresh = fresh_pod_arrays(
+        C,
+        P,
+        pg(pay["req_cpu"]),
+        pg(pay["req_ram"]),
+        TPair(win=pg(pay["dur_win"]), off=pg(pay["dur_off"])),
+    )
+    new_pods = jax.tree.map(
+        lambda old, fr: jnp.where(
+            refill, fr, jnp.take_along_axis(old, src_old, axis=1)
+        ),
+        pods,
+        fresh,
+    )
+    new_rank = None
+    if rank is not None:
+        new_rank = jnp.where(
+            refill, pg(pay["rank"]), jnp.take_along_axis(rank, src_old, axis=1)
+        )
+    return new_pods, new_rank
+
+
+def _fused_chunk_slide_impl(
+    state,
+    slab,
+    window_idxs,
+    consts,
+    payload,
+    base,
+    max_events_per_window: int,
+    max_pods_per_cycle: int,
+    autoscale_statics=None,
+    max_ca_pods_per_cycle: int = 64,
+    max_pods_per_scale_down: int = 8,
+    use_pallas: bool = False,
+    pallas_interpret: bool = False,
+    conditional_move: bool = False,
+    pallas_mesh=None,
+    pallas_axis: str = "clusters",
+    use_pallas_select: bool = False,
+    use_megakernel: bool = True,
+    hpa_seg=None,
+    W: int = 0,
+):
+    """The composed path's steady-state MEGASTEP: one device program runs a
+    whole window chunk (scheduling cycles + the in-trace HPA/CA passes of
+    _window_body) AND the following pod-window slide — shift computation,
+    quantization, gather-apply — with a traced shift amount. The engine
+    dispatches this for the LAST ladder chunk of every slide span, so a span
+    costs exactly popcount(span) dispatches and its only host sync is the
+    asynchronous 4-byte readback of the returned shift (0 = no slide was
+    possible; grow the window). Returns (state, new_pod_name_rank | None,
+    shift)."""
+    from kubernetriks_tpu.batched.step import _window_body
+
+    def body(carry, w):
+        new = _window_body(
+            carry,
+            slab,
+            w,
+            consts,
+            max_events_per_window,
+            max_pods_per_cycle,
+            autoscale_statics,
+            max_ca_pods_per_cycle,
+            max_pods_per_scale_down,
+            use_pallas,
+            pallas_interpret,
+            conditional_move,
+            pallas_mesh,
+            pallas_axis,
+            use_pallas_select,
+            use_megakernel=use_megakernel,
+            hpa_seg=hpa_seg,
+        )
+        return new, None
+
+    state, _ = jax.lax.scan(body, state, jnp.asarray(window_idxs, jnp.int32))
+    base = jnp.asarray(base, jnp.int32)
+    s0 = _slide_shift_core(state.pods.phase[:, :W], payload["create_win"], base)
+    s = _quantize_shift_device(s0, W)
+    rank = (
+        autoscale_statics.pod_name_rank
+        if (autoscale_statics is not None and "rank" in payload)
+        else None
+    )
+    new_pods, new_rank = _slide_apply_traced(
+        state.pods, rank, payload, base, s, W
+    )
+    state = state._replace(pods=new_pods, pod_base=state.pod_base + s)
+    return state, new_rank, s
+
+
+# The fused program shares every window-program static (drift between the
+# fused and plain programs' static sets would make a new kwarg traced in one
+# of them) plus the slide's window width.
+_FUSED_STATICS = _STEP_STATICS + ("W",)
+_fused_chunk_slide = jax.jit(
+    _fused_chunk_slide_impl, static_argnames=_FUSED_STATICS
+)
+_fused_chunk_slide_donated = jax.jit(
+    _fused_chunk_slide_impl, static_argnames=_FUSED_STATICS, donate_argnums=(0,)
+)
+
+
 
 
 @partial(jax.jit, static_argnames=("s", "W"))
@@ -418,8 +582,66 @@ class BatchedSimulation:
         pallas_interpret: bool = False,
         pod_window: Optional[int] = None,
         fast_forward: Optional[bool] = None,
+        donate: Optional[bool] = None,
+        fuse_slide: Optional[bool] = None,
     ) -> None:
         self.config = config
+        # Buffer donation (KTPU_DONATE / donate arg): the steady-state
+        # dispatch loop consumes its input state buffers in place instead of
+        # re-materializing the full (C,N)/(C,P) state every dispatch.
+        # Bit-identical either way (tests/test_window_donation_dispatch.py);
+        # anything that must keep self.state valid across a dispatch
+        # (precompile_chunks) runs against a scratch copy. Default: on for
+        # accelerator backends — the win is device-buffer reuse behind the
+        # tunnel; on CPU hosts it measures neutral-at-best and the donated
+        # program variants would shadow-compile next to any undonated use,
+        # so tests opt in explicitly.
+        if donate is not None:
+            self.donate = bool(donate)
+        else:
+            env = os.environ.get("KTPU_DONATE")
+            self.donate = (
+                env != "0" if env is not None
+                else jax.default_backend() != "cpu"
+            )
+        # Fused chunk+slide megastep (KTPU_FUSED_SLIDE / fuse_slide arg):
+        # the last ladder chunk of a slide span also computes, quantizes and
+        # applies the window slide on device (see _fused_chunk_slide); the
+        # engine reads one 4-byte shift back asynchronously instead of
+        # dispatching shift + apply separately. Default: on for accelerator
+        # backends — the win is per-span dispatch+sync overhead that only
+        # exists through the device tunnel; on CPU hosts the extra fused
+        # program variants would only double compile time, so tests opt in
+        # explicitly (tests/test_window_donation_dispatch.py).
+        if fuse_slide is not None:
+            self._fuse_slide = bool(fuse_slide)
+        else:
+            env = os.environ.get("KTPU_FUSED_SLIDE")
+            self._fuse_slide = (
+                env != "0" if env is not None
+                else jax.default_backend() != "cpu"
+            )
+        # (shift-array, new-name-rank-or-None) of a fused slide whose host
+        # resolution is still pending (step_until_time resolves it at the
+        # span boundary).
+        self._pending_shift = None
+        # (start, width, refill pytree) prefetched for the HOST slide path
+        # while a span's chunks run on device (_prefetch_refill).
+        self._refill_prefetch = None
+        # Dispatch accounting for the steady-state loop, asserted by the
+        # dispatch-count regression test: window_chunks counts device
+        # dispatches that advance windows (fused_slides of them also slid),
+        # slide_dispatches counts SEPARATE shift/apply dispatches (0 when
+        # fused), slide_syncs counts blocking host readbacks that gate a
+        # slide decision, refill_prefetches counts host-path payload
+        # prefetches that overlapped device compute.
+        self.dispatch_stats = {
+            "window_chunks": 0,
+            "fused_slides": 0,
+            "slide_dispatches": 0,
+            "slide_syncs": 0,
+            "refill_prefetches": 0,
+        }
         self._use_pallas_requested = use_pallas
         self.pallas_interpret = bool(pallas_interpret)
         self.use_pallas = bool(use_pallas)  # finalized after shapes are known
@@ -957,57 +1179,92 @@ class BatchedSimulation:
         count = int(math.floor(until_time / interval)) - first + 1
         return first + np.arange(max(count, 0), dtype=np.int32)
 
-    def _dispatch_windows(self, idxs: np.ndarray) -> None:
-        """Run one chunk of windows and fold the results into self.state
-        (+ gauge accumulation)."""
-        if self.fast_forward and not self.collect_gauges:
-            # Fast-forward dispatch: execute only interesting windows of the
-            # span (bit-identical end state; see run_windows_skip). Gauge
-            # collection needs every window's sample, so it keeps the scan.
-            from kubernetriks_tpu.batched.step import run_windows_skip
-
-            self.state = run_windows_skip(
-                self.state,
-                self.slab,
-                np.int32(idxs[0]),
-                np.int32(idxs[-1]),
-                self.consts,
-                self.max_events_per_window,
-                self.max_pods_per_cycle,
-                self.autoscale_statics,
-                self.max_ca_pods_per_cycle,
-                self.max_pods_per_scale_down,
-                self.use_pallas,
-                self.pallas_interpret,
-                self.conditional_move,
-                pallas_mesh=self.mesh if self.use_pallas else None,
-                pallas_axis=self._batch_axis,
-                use_pallas_select=self.use_pallas_select,
-                use_megakernel=self.use_megakernel,
-                flush_windows=self._flush_windows,
-                hpa_seg=self._hpa_seg,
-            )
-            self.next_window_idx = int(idxs[-1]) + 1
-            return
-        out = run_windows(
-            self.state,
-            self.slab,
-            jnp.asarray(idxs, jnp.int32),
-            self.consts,
-            self.max_events_per_window,
-            self.max_pods_per_cycle,
-            self.autoscale_statics,
-            self.max_ca_pods_per_cycle,
-            self.max_pods_per_scale_down,
-            self.use_pallas,
-            self.pallas_interpret,
-            self.conditional_move,
-            self.collect_gauges,
+    def _window_call_kwargs(self) -> dict:
+        """The window-program config kwargs shared by every dispatch and
+        warm-up site (run_windows, run_windows_skip, the fused chunk+slide
+        megastep). ONE owner — a new engine static added here reaches the
+        warmed AND dispatched programs together, so precompile_chunks can
+        never warm a program the loop then doesn't use. Callers add their
+        entry-specific extras (collect_gauges, flush_windows, W)."""
+        return dict(
+            max_events_per_window=self.max_events_per_window,
+            max_pods_per_cycle=self.max_pods_per_cycle,
+            autoscale_statics=self.autoscale_statics,
+            max_ca_pods_per_cycle=self.max_ca_pods_per_cycle,
+            max_pods_per_scale_down=self.max_pods_per_scale_down,
+            use_pallas=self.use_pallas,
+            pallas_interpret=self.pallas_interpret,
+            conditional_move=self.conditional_move,
             pallas_mesh=self.mesh if self.use_pallas else None,
             pallas_axis=self._batch_axis,
             use_pallas_select=self.use_pallas_select,
             use_megakernel=self.use_megakernel,
             hpa_seg=self._hpa_seg,
+        )
+
+    def _dispatch_windows(self, idxs: np.ndarray, fuse_slide: bool = False) -> None:
+        """Run one chunk of windows and fold the results into self.state
+        (+ gauge accumulation). With fuse_slide, dispatch the chunk+slide
+        megastep instead (_fused_chunk_slide): the returned shift's host
+        readback starts immediately but is only consumed at the span
+        boundary (_resolve_pending_slide), so no sync lands here."""
+        self.dispatch_stats["window_chunks"] += 1
+        if fuse_slide:
+            self.dispatch_stats["fused_slides"] += 1
+            fn = _fused_chunk_slide_donated if self.donate else _fused_chunk_slide
+            state, new_rank, s = fn(
+                self.state,
+                self.slab,
+                jnp.asarray(idxs, jnp.int32),
+                self.consts,
+                self._device_slide,
+                np.int32(self._pod_base),
+                W=self.pod_window,
+                **self._window_call_kwargs(),
+            )
+            self.state = state
+            if new_rank is not None:
+                # Device-to-device swap, no sync; identical values when the
+                # slide turns out to be a no-op (s == 0).
+                self.autoscale_statics = self.autoscale_statics._replace(
+                    pod_name_rank=new_rank
+                )
+            if hasattr(s, "copy_to_host_async"):
+                s.copy_to_host_async()
+            self._pending_shift = s
+            self.next_window_idx = int(idxs[-1]) + 1
+            return
+        if self.fast_forward and not self.collect_gauges:
+            # Fast-forward dispatch: execute only interesting windows of the
+            # span (bit-identical end state; see run_windows_skip). Gauge
+            # collection needs every window's sample, so it keeps the scan.
+            from kubernetriks_tpu.batched.step import (
+                run_windows_skip,
+                run_windows_skip_donated,
+            )
+
+            skip_fn = run_windows_skip_donated if self.donate else run_windows_skip
+            self.state = skip_fn(
+                self.state,
+                self.slab,
+                np.int32(idxs[0]),
+                np.int32(idxs[-1]),
+                self.consts,
+                flush_windows=self._flush_windows,
+                **self._window_call_kwargs(),
+            )
+            self.next_window_idx = int(idxs[-1]) + 1
+            return
+        from kubernetriks_tpu.batched.step import run_windows_donated
+
+        win_fn = run_windows_donated if self.donate else run_windows
+        out = win_fn(
+            self.state,
+            self.slab,
+            jnp.asarray(idxs, jnp.int32),
+            self.consts,
+            collect_gauges=self.collect_gauges,
+            **self._window_call_kwargs(),
         )
         if self.collect_gauges:
             self.state, gauges = out
@@ -1019,48 +1276,67 @@ class BatchedSimulation:
 
     def precompile_chunks(self, max_chunk: int = 128) -> int:
         """Warm the sliding path's dispatch-chunk program shapes (the
-        power-of-two ladder) so no compile lands inside a timed region — a
-        novel chunk shape costs seconds through the tunneled TPU runtime.
-        Each shape is dispatched once against the CURRENT state and the
-        result discarded (run_windows is pure; self.state is untouched),
-        which both compiles and seeds jit's dispatch cache; already-warm
-        shapes are cache hits. Returns the number of shapes dispatched.
-        No-op on fast-forward or non-sliding engines (one program serves
-        any span there)."""
+        power-of-two ladder, plus the fused chunk+slide variants when they
+        are in play) so no compile lands inside a timed region — a novel
+        chunk shape costs seconds through the tunneled TPU runtime.
+
+        Each shape is dispatched once against a scratch COPY of the current
+        state (so self.state survives buffer donation) with the CURRENT
+        window index REPEATED chunk times: warm-up indices stay in range —
+        never past the pod window's capacity — and a repeated window is
+        quiet by construction (its due events, finishes and autoscaler
+        ticks all resolve in the first scan iteration, leaving the rest of
+        the chunk empty cycles). Per-shape warm-up compute is therefore
+        bounded by ~one real window + (chunk - 1) empty cycles, instead of
+        re-simulating chunk real windows per shape; idx VALUES are traced,
+        so the compiled/warmed program is exactly the one the dispatch loop
+        uses. Total cost: at most len(_CHUNK_LADDER) shapes (2x with the
+        fused-slide variants), each one compile (seconds through the
+        tunnel, cache hit when already warm) plus the bounded quiet
+        execution. Returns the number of shapes dispatched. No-op on
+        fast-forward or non-sliding engines (one program serves any span
+        there)."""
         if self.pod_window is None or (
             self.fast_forward and not self.collect_gauges
         ):
             return 0
+        from kubernetriks_tpu.batched.step import run_windows_donated
+
+        win_fn = run_windows_donated if self.donate else run_windows
         n = 0
+        warm_fused = self._fused_slide_ok()
         for chunk in _CHUNK_LADDER:
             if chunk > max_chunk:
                 continue
-            idxs = jnp.arange(
-                self.next_window_idx, self.next_window_idx + chunk,
-                dtype=jnp.int32,
-            )
-            out = run_windows(
-                self.state,
+            idxs = jnp.full((chunk,), self.next_window_idx, jnp.int32)
+            out = win_fn(
+                tree_copy(self.state),
                 self.slab,
                 idxs,
                 self.consts,
-                self.max_events_per_window,
-                self.max_pods_per_cycle,
-                self.autoscale_statics,
-                self.max_ca_pods_per_cycle,
-                self.max_pods_per_scale_down,
-                self.use_pallas,
-                self.pallas_interpret,
-                self.conditional_move,
-                self.collect_gauges,
-                pallas_mesh=self.mesh if self.use_pallas else None,
-                pallas_axis=self._batch_axis,
-                use_pallas_select=self.use_pallas_select,
-                use_megakernel=self.use_megakernel,
-                hpa_seg=self._hpa_seg,
+                collect_gauges=self.collect_gauges,
+                **self._window_call_kwargs(),
             )
             jax.block_until_ready(out)  # discarded: warm-up only
             n += 1
+            if warm_fused:
+                fn = (
+                    _fused_chunk_slide_donated
+                    if self.donate
+                    else _fused_chunk_slide
+                )
+                out = fn(
+                    tree_copy(self.state),
+                    self.slab,
+                    idxs,
+                    self.consts,
+                    self._device_slide,
+                    np.int32(self._pod_base),
+                    W=self.pod_window,
+                    **self._window_call_kwargs(),
+                )
+                jax.block_until_ready(out)
+                n += 1
         return n
 
     def step_until_time(self, until_time: float) -> None:
@@ -1078,26 +1354,48 @@ class BatchedSimulation:
         # 2 dispatches; the old coarse (128,32,8,1) ladder cut it into
         # 8+8+1+1+1+1 = 6, and per-dispatch overhead is ~20 ms through the
         # tunneled TPU runtime — the dispatch tax WAS the composed path's
-        # largest single cost). At most len(LADDER) program shapes compile;
+        # largest single cost). When a slide will follow the span, the LAST
+        # chunk dispatches as the fused chunk+slide megastep
+        # (_fused_chunk_slide): the slide itself costs no extra dispatch,
+        # and the only host sync of the span is the asynchronous 4-byte
+        # shift readback at the boundary (_resolve_pending_slide). Engines
+        # on the host slide path instead prefetch the refill payload while
+        # the span's chunks are still running on device. At most
+        # len(LADDER) program shapes compile per variant;
         # precompile_chunks() AOT-compiles them so none lands mid-bench.
         target = int(idxs[-1])
         while self.next_window_idx <= target:
             sub = min(target, self._pod_capacity_window())
+            will_slide = sub < target
+            fuse = will_slide and self._fused_slide_ok()
             while self.next_window_idx <= sub:
                 span = sub - self.next_window_idx + 1
                 chunk = next(c for c in _CHUNK_LADDER if c <= span)
                 # _step_idxs keeps the profiling/gauge instrumentation on
-                # every dispatch size.
+                # every dispatch size; chunk == span marks the span's final
+                # chunk (the greedy binary decomposition ends exactly at sub).
                 self._step_idxs(
                     np.arange(
                         self.next_window_idx,
                         self.next_window_idx + chunk,
                         dtype=np.int32,
-                    )
+                    ),
+                    fuse_slide=fuse and chunk == span,
                 )
             if sub >= target:
                 return
-            if not self._advance_pod_window():
+            if will_slide and self._device_slide is None:
+                # Host slide path: assemble the refill payload NOW, while
+                # the span's dispatched chunks are still executing on device
+                # (dispatches are asynchronous; the blocking phase fetch in
+                # _advance_pod_window comes after).
+                self._prefetch_refill()
+            advanced = (
+                self._resolve_pending_slide()
+                if self._pending_shift is not None
+                else self._advance_pod_window()
+            )
+            if not advanced:
                 # The live-pod span outgrew the window (no leading pod is
                 # terminal): grow the window in place instead of failing —
                 # dense stretches of a long trace adapt automatically.
@@ -1108,6 +1406,53 @@ class BatchedSimulation:
                         "and no leading pod is terminal yet, and the window "
                         "already covers the whole plain trace segment"
                     )
+
+    def _fused_slide_ok(self) -> bool:
+        """Whether spans can end in the fused chunk+slide megastep: needs
+        the device-resident slide payload and the plain run_windows dispatch
+        mode (fast-forward spans and gauge collection keep their own
+        programs; both fall back to the two-dispatch slide)."""
+        return (
+            self._fuse_slide
+            and self._device_slide is not None
+            and not self.fast_forward
+            and not self.collect_gauges
+        )
+
+    def _resolve_pending_slide(self) -> bool:
+        """Consume a fused slide's pending shift — the span's ONLY host
+        sync, an async-prefetched 4-byte readback. The device state already
+        slid (or provably could not, shift 0); this just moves the host
+        mirrors. Returns False when no slide was possible (grow the window).
+        """
+        s_arr = self._pending_shift
+        self._pending_shift = None
+        self.dispatch_stats["slide_syncs"] += 1
+        s = int(s_arr)
+        if s <= 0:
+            # The fused slide was the identity (statics rank swap included);
+            # nothing moved on device or host.
+            return False
+        self._pod_base += s
+        self._refill_prefetch = None
+        return True
+
+    def _prefetch_refill(self) -> None:
+        """Host slide path: build the next slide's refill payload at the
+        MAXIMAL quantized width (every possible shift is a prefix of it)
+        before the blocking phase fetch, overlapping the host assembly +
+        device_put with the span's in-flight device chunks.
+        _advance_pod_window slices it to the actual shift."""
+        W = self.pod_window
+        width = max(W // 2, 1)
+        start = self._pod_base + W
+        if (
+            self._refill_prefetch is not None
+            and self._refill_prefetch[:2] == (start, width)
+        ):
+            return
+        self.dispatch_stats["refill_prefetches"] += 1
+        self._refill_prefetch = (start, width, self._make_refill(start, width))
 
     def _pod_capacity_window(self) -> int:
         """Largest window index dispatchable before a pod creation would land
@@ -1177,6 +1522,12 @@ class BatchedSimulation:
             # On-device shift computation: only the scalar crosses the
             # tunnel (the host fetch of the full (C, W) phase array was the
             # first of the per-slide round-trips this path eliminates).
+            # (The steady-state loop fuses this dispatch pair into the
+            # span's last chunk instead — _fused_chunk_slide; this
+            # two-dispatch path serves fast-forward/gauge/fuse-disabled
+            # engines.)
+            self.dispatch_stats["slide_dispatches"] += 1
+            self.dispatch_stats["slide_syncs"] += 1
             s = int(
                 _slide_shift_device(
                     self.state.pods.phase[:, :W],
@@ -1185,6 +1536,7 @@ class BatchedSimulation:
                 )
             )
         else:
+            self.dispatch_stats["slide_syncs"] += 1
             phases = to_host(self.state.pods.phase)[:, :W]
             terminal = (
                 (phases == PHASE_SUCCEEDED)
@@ -1223,6 +1575,7 @@ class BatchedSimulation:
             s = 1 << (s.bit_length() - 1)
 
         if self._device_slide is not None:
+            self.dispatch_stats["slide_dispatches"] += 1
             rank = (
                 self.autoscale_statics.pod_name_rank
                 if self.autoscale_statics is not None
@@ -1246,7 +1599,14 @@ class BatchedSimulation:
                 )
             return True
 
-        refill = self._make_refill(win_lo + W, s)
+        pf = self._refill_prefetch
+        self._refill_prefetch = None
+        if pf is not None and pf[0] == win_lo + W and pf[1] >= s:
+            # Prefetched while the span's chunks ran on device: every
+            # quantized shift is a prefix of the maximal-width payload.
+            refill = jax.tree.map(lambda a: a[:, :s], pf[2])
+        else:
+            refill = self._make_refill(win_lo + W, s)
         new_pods = jax.tree.map(
             lambda a, b: jnp.concatenate([a[:, s:W], b, a[:, W:]], axis=1),
             self.state.pods,
@@ -1377,11 +1737,22 @@ class BatchedSimulation:
                 self._hpa_seg = (lo + insert, hi + insert)
             self._refresh_name_ranks()  # rebuilds windowed ranks at new_W
         self._init_device_slide()  # re-pad the payload to T + new_W
-        assert not (
+        # A prefetched refill payload (host slide path) is sized/positioned
+        # for the OLD window width — drop it.
+        self._refill_prefetch = None
+        if (
             self.mesh is not None
             and is_cross_process(self.mesh)
             and self._device_slide is None
-        ), "pre-mutation budget check above must match _init_device_slide"
+        ):
+            # Not an assert: this consistency check must survive python -O —
+            # silently continuing on a cross-process mesh without the
+            # device payload would hit to_host on non-addressable shards
+            # much later, as an opaque error.
+            raise RuntimeError(
+                "pre-mutation budget check above must match "
+                "_init_device_slide"
+            )
         # Kernel VMEM fits-gates depend on the device pod-axis width.
         self.n_pods += insert
         from kubernetriks_tpu.ops.scheduler_kernel import (
@@ -1410,9 +1781,9 @@ class BatchedSimulation:
         )
         return True
 
-    def _step_idxs(self, idxs: np.ndarray) -> None:
+    def _step_idxs(self, idxs: np.ndarray, fuse_slide: bool = False) -> None:
         if not (self.profile_dir or self.log_throughput):
-            self._dispatch_windows(idxs)
+            self._dispatch_windows(idxs, fuse_slide=fuse_slide)
             return
 
         # Instrumented path: optional jax.profiler capture + a per-chunk
@@ -1434,7 +1805,7 @@ class BatchedSimulation:
         )
         t0 = time.perf_counter()
         with ctx:
-            self._dispatch_windows(idxs)
+            self._dispatch_windows(idxs, fuse_slide=fuse_slide)
             jax.block_until_ready(self.state.time)
         elapsed = time.perf_counter() - t0
         if self.log_throughput:

@@ -348,6 +348,17 @@ def init_state(
     )
 
 
+@jax.jit
+def tree_copy(tree):
+    """Fresh device buffers carrying the inputs' shardings (jit outputs
+    never alias undonated inputs). The buffer-donation-era state copier:
+    a state pytree passed to a donated entry point (step.run_windows_donated
+    and friends, engine._fused_chunk_slide) is CONSUMED — callers that must
+    keep their state across such a dispatch (warm-up, A/B experiments,
+    equivalence tests) dispatch a copy instead."""
+    return jax.tree.map(jnp.copy, tree)
+
+
 def compare_states(a: ClusterBatchState, b: ClusterBatchState) -> list:
     """Compare two final state pytrees under the documented parity policy:
     all simulation state exactly equal; float32 metric estimator accumulators

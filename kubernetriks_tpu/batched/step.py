@@ -1596,8 +1596,7 @@ def _catch_up_bookkeeping(
     return state
 
 
-@partial(jax.jit, static_argnames=_STEP_STATICS + ("flush_windows",))
-def run_windows_skip(
+def _run_windows_skip_impl(
     state: ClusterBatchState,
     slab: TraceSlab,
     first: jnp.ndarray,
@@ -1668,8 +1667,26 @@ def run_windows_skip(
     return state
 
 
-@partial(jax.jit, static_argnames=_STEP_STATICS + ("collect_gauges",))
-def run_windows(
+# Undonated (pure) and donated jit entries share one traced body. The engine's
+# steady-state loop uses the DONATED variants: the full (C,N)/(C,P) state is
+# consumed and updated in place instead of being re-materialized into fresh
+# device buffers on every dispatch (the composed path dispatches popcount(span)
+# chunks per slide span, so the per-dispatch allocate+copy of the whole state
+# was pure overhead). Donated and undonated programs are bit-identical —
+# tests/test_window_donation_dispatch.py pins it — but a donated call INVALIDATES its
+# input state; callers that keep the input (tests, warm-up against a scratch
+# copy) use the undonated names.
+run_windows_skip = partial(
+    jax.jit, static_argnames=_STEP_STATICS + ("flush_windows",)
+)(_run_windows_skip_impl)
+run_windows_skip_donated = jax.jit(
+    _run_windows_skip_impl,
+    static_argnames=_STEP_STATICS + ("flush_windows",),
+    donate_argnums=(0,),
+)
+
+
+def _run_windows_impl(
     state: ClusterBatchState,
     slab: TraceSlab,
     window_idxs: jnp.ndarray,
@@ -1723,3 +1740,13 @@ def run_windows(
     if collect_gauges:
         return state, gauges
     return state
+
+
+run_windows = partial(
+    jax.jit, static_argnames=_STEP_STATICS + ("collect_gauges",)
+)(_run_windows_impl)
+run_windows_donated = jax.jit(
+    _run_windows_impl,
+    static_argnames=_STEP_STATICS + ("collect_gauges",),
+    donate_argnums=(0,),
+)

@@ -252,13 +252,15 @@ def fused_ca_scale_down(
 
 
 def ca_up_kernel_fits(n_slots: int, n_groups: int, k_up: int) -> bool:
-    """VMEM fits-check for the scale-up kernel: 3 slot tiles (planned +
-    2 working allocatables) + plan_seq scratch, 8 group tiles, 3 (K_up)
-    candidate tables, meta — double-buffered, ~40% headroom."""
+    """VMEM fits-check for the scale-up kernel: 4 slot tiles (planned out +
+    plan_seq/alloc-cpu/alloc-ram scratch), 8 group tiles (7 in + gpl out),
+    3 (K_up) candidate tables, and 3 (_SUB x _LANE) meta tiles — the meta
+    input, the scal scratch, AND the starved_out output tile (added with
+    the reserve-starvation counter) — double-buffered, ~40% headroom."""
     sp_pad = -(-n_slots // _SUB) * _SUB
     gp_pad = -(-n_groups // _SUB) * _SUB
     kp_pad = -(-k_up // _SUB) * _SUB
-    resident = (4 * sp_pad + 8 * gp_pad + 3 * kp_pad + 2 * _SUB) * _LANE * 4
+    resident = (4 * sp_pad + 8 * gp_pad + 3 * kp_pad + 3 * _SUB) * _LANE * 4
     return 2 * resident <= int(0.8 * _VMEM_LIMIT)
 
 

@@ -1,0 +1,34 @@
+"""bench.py --smoke: the CPU-safe plumbing check for the three tracked
+bench lines (continuity shape, composed flagship, north-star stand-in).
+Asserts all three lines build, RUN their full machinery — the composed
+line includes real window slides, HPA scale-ups and CA provisioning, the
+same in-bench asserts the flagship line enforces on hardware — and emit
+parseable JSON with the headline fields. Values are not performance
+numbers; tier-1 runs this under JAX_PLATFORMS=cpu (conftest pins it)."""
+
+import json
+import os
+import sys
+
+
+def test_bench_smoke_emits_three_parseable_lines(capsys):
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import bench
+
+    bench.main(["--smoke"])
+    lines = [
+        ln for ln in capsys.readouterr().out.strip().splitlines() if ln.strip()
+    ]
+    assert len(lines) == 3, lines
+    records = [json.loads(ln) for ln in lines]
+    for rec in records:
+        assert set(rec) == {"metric", "value", "unit", "vs_baseline"}
+        assert rec["unit"] == "decisions/s"
+        assert rec["value"] > 0
+        # Smoke values are toy-shape numbers; the rounded-to-3-decimals
+        # ratio can legitimately print as 0.0.
+        assert rec["vs_baseline"] >= 0
+    # Line order is part of the contract: continuity, composed, north-star
+    # (the LAST line is the headline the driver reads).
+    assert "composed" in records[1]["metric"]
+    assert "north-star" in records[2]["metric"]

@@ -1,0 +1,255 @@
+"""Composed-path dispatch & transfer overhaul guards.
+
+1. DONATION EQUIVALENCE: the steady-state loop's donated, fused
+   chunk+slide programs (step.run_windows_donated / run_windows_skip_donated,
+   engine._fused_chunk_slide) update the full (C,N)/(C,P) state in place;
+   a composed run (HPA + CA + sliding pod window) with donation + fusion ON
+   must be BIT-IDENTICAL to the undonated, unfused two-dispatch-slide run —
+   every simulation-state leaf exact, metric estimators exact (same
+   programs' float op order), same slide trajectory (pod_base).
+
+2. DISPATCH-COUNT REGRESSION: the steady-state sliding loop issues exactly
+   popcount(span) device dispatches per slide span — each span's chunks are
+   the greedy binary decomposition of its length, the span's LAST chunk
+   carries the fused on-device slide (no separate shift/apply dispatches),
+   and the only host sync per span is the asynchronous 4-byte shift
+   readback at the span boundary (no per-chunk sync in the timed region).
+
+3. The donated standalone autoscaler entry points
+   (autoscale.hpa_pass_donated / ca_pass_donated) match the plain calls
+   bit-for-bit.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from kubernetriks_tpu.batched.engine import build_batched_from_traces
+from kubernetriks_tpu.batched.state import compare_states, tree_copy
+from kubernetriks_tpu.test_util import default_test_simulation_config
+from kubernetriks_tpu.trace.generator import (
+    PoissonWorkloadTrace,
+    UniformClusterTrace,
+)
+from kubernetriks_tpu.trace.generic import GenericWorkloadTrace
+
+COMPOSED_CONFIG_SUFFIX = """
+horizontal_pod_autoscaler:
+  enabled: true
+cluster_autoscaler:
+  enabled: true
+  autoscaler_type: kube_cluster_autoscaler
+  scan_interval: 10.0
+  max_node_count: 4
+  node_groups:
+  - node_template:
+      metadata:
+        name: ca_node
+      status:
+        capacity:
+          cpu: 8000
+          ram: 17179869184
+"""
+
+# HPA group whose load curve bursts past the base capacity: replicas park,
+# the CA provisions template nodes, the load drop walks both back down.
+GROUP_TRACE = """
+events:
+- timestamp: 49.5
+  event_type:
+    !CreatePodGroup
+      pod_group:
+        name: grp
+        initial_pod_count: 2
+        max_pod_count: 8
+        pod_template:
+          metadata: {name: grp}
+          spec:
+            resources:
+              requests: {cpu: 4000, ram: 2147483648}
+              limits: {cpu: 4000, ram: 2147483648}
+        target_resources_usage: {cpu_utilization: 0.5}
+        resources_usage_model_config:
+          cpu_config:
+            model_name: pod_group
+            config: |
+              - duration: 100.0
+                total_load: 1.0
+              - duration: 150.0
+                total_load: 6.0
+              - duration: 250.0
+                total_load: 0.5
+"""
+
+
+def _build_composed(**kwargs):
+    config = default_test_simulation_config(COMPOSED_CONFIG_SUFFIX)
+    cluster = UniformClusterTrace(4, cpu=16000, ram=32 * 1024**3)
+    plain = PoissonWorkloadTrace(
+        rate_per_second=0.3,
+        horizon=500.0,
+        seed=7,
+        cpu=2000,
+        ram=2 * 1024**3,
+        duration_range=(30.0, 90.0),
+        name_prefix="plain",
+    )
+    workload = sorted(
+        plain.convert_to_simulator_events()
+        + GenericWorkloadTrace.from_yaml(GROUP_TRACE).convert_to_simulator_events(),
+        key=lambda e: e[0],
+    )
+    # CPU defaults for both knobs are off (compile cost on a host backend);
+    # this module is exactly the place that exercises them.
+    kwargs.setdefault("fuse_slide", True)
+    kwargs.setdefault("donate", True)
+    return build_batched_from_traces(
+        config,
+        cluster.convert_to_simulator_events(),
+        workload,
+        n_clusters=2,
+        max_pods_per_cycle=16,
+        pod_window=64,
+        fast_forward=False,
+        **kwargs,
+    )
+
+
+@pytest.fixture(scope="module")
+def composed_runs():
+    donated = _build_composed()  # donate default + fused slide opt-in
+    assert donated.donate and donated._fused_slide_ok()
+    donated.precompile_chunks(max_chunk=16)  # also exercises scratch-copy warm-up
+    for end in (150.0, 300.0, 450.0):
+        donated.step_until_time(end)
+    plain = _build_composed(donate=False, fuse_slide=False)
+    assert not plain.donate and not plain._fused_slide_ok()
+    for end in (150.0, 300.0, 450.0):
+        plain.step_until_time(end)
+    return donated, plain
+
+
+def test_donated_composed_run_is_bit_identical(composed_runs):
+    donated, plain = composed_runs
+    # The run actually composes everything: slides happened, HPA scaled,
+    # CA provisioned — otherwise this guard proves nothing.
+    assert donated._pod_base > 0
+    counters = donated.metrics_summary()["counters"]
+    assert counters["total_scaled_up_pods"] > 0
+    assert counters["total_scaled_up_nodes"] > 0
+    # Donation really was in play on the steady loop.
+    assert donated.dispatch_stats["fused_slides"] > 0
+    assert plain.dispatch_stats["fused_slides"] == 0
+
+    assert donated._pod_base == plain._pod_base
+    assert compare_states(donated.state, plain.state) == []
+    assert donated.metrics_summary() == plain.metrics_summary()
+
+
+def test_autoscaler_entry_points_donated_match_plain(composed_runs):
+    from kubernetriks_tpu.batched.autoscale import (
+        ca_pass,
+        ca_pass_donated,
+        hpa_pass,
+        hpa_pass_donated,
+    )
+
+    donated, _ = composed_runs
+    state = donated.state
+    st = donated.autoscale_statics
+    W = jnp.full((donated.n_clusters,), donated.next_window_idx, jnp.int32)
+
+    ref, ref_auto = hpa_pass(
+        tree_copy(state), state.auto, st, W, donated.consts,
+        seg=donated._hpa_seg,
+    )
+    ref = ref._replace(auto=ref_auto)
+    got = hpa_pass_donated(
+        tree_copy(state), st, W, donated.consts, seg=donated._hpa_seg
+    )
+    assert compare_states(ref, got) == []
+
+    ref, ref_auto = ca_pass(
+        tree_copy(state), state.auto, st, W, donated.consts,
+        donated.max_ca_pods_per_cycle, donated.max_pods_per_scale_down,
+    )
+    ref = ref._replace(auto=ref_auto)
+    got = ca_pass_donated(
+        tree_copy(state), st, W, donated.consts,
+        donated.max_ca_pods_per_cycle, donated.max_pods_per_scale_down,
+    )
+    assert compare_states(ref, got) == []
+
+
+def _greedy_decomposition(span, ladder):
+    out = []
+    while span > 0:
+        chunk = next(c for c in ladder if c <= span)
+        out.append(chunk)
+        span -= chunk
+    return out
+
+
+def test_steady_state_dispatch_counts():
+    """popcount(span) dispatches per slide span, slide fused into the last
+    chunk, no separate slide dispatches, one async shift sync per span."""
+    from kubernetriks_tpu.batched.engine import _CHUNK_LADDER
+
+    config = default_test_simulation_config()
+    cluster = UniformClusterTrace(8, cpu=64000, ram=128 * 1024**3)
+    workload = PoissonWorkloadTrace(
+        rate_per_second=1.0,
+        horizon=500.0,
+        seed=5,
+        cpu=1000,
+        ram=1024**3,
+        duration_range=(20.0, 40.0),
+    )
+    sim = build_batched_from_traces(
+        config,
+        cluster.convert_to_simulator_events(),
+        workload.convert_to_simulator_events(),
+        n_clusters=2,
+        max_pods_per_cycle=16,
+        pod_window=128,
+        fast_forward=False,
+        fuse_slide=True,
+        donate=True,
+    )
+    assert sim._fused_slide_ok()
+
+    log = []
+    orig = sim._dispatch_windows
+
+    def recording(idxs, fuse_slide=False):
+        log.append((len(idxs), fuse_slide))
+        return orig(idxs, fuse_slide=fuse_slide)
+
+    sim._dispatch_windows = recording
+    sim.step_until_time(400.0)
+
+    stats = sim.dispatch_stats
+    assert stats["fused_slides"] > 0, "no slide span exercised"
+    # Every slide ran fused into its span's last chunk: zero separate
+    # shift/apply dispatches, and dispatch count == chunk count.
+    assert stats["slide_dispatches"] == 0
+    assert stats["window_chunks"] == len(log)
+    # One host sync per span boundary (the async shift readback), none per
+    # chunk.
+    assert stats["slide_syncs"] == stats["fused_slides"]
+
+    # Reconstruct slide spans: a fused dispatch closes a span. Each interior
+    # span's chunks must be exactly the greedy binary decomposition of its
+    # length — popcount(span) dispatches, no more.
+    span_sizes = []
+    for size, fused in log:
+        span_sizes.append(size)
+        if fused:
+            span = sum(span_sizes)
+            assert span_sizes == _greedy_decomposition(span, _CHUNK_LADDER)
+            assert len(span_sizes) == bin(span).count("1")
+            span_sizes = []
+    # Trailing (target-reaching) span also follows the ladder decomposition.
+    if span_sizes:
+        assert span_sizes == _greedy_decomposition(sum(span_sizes), _CHUNK_LADDER)
