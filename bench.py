@@ -97,6 +97,21 @@ def run_shape(
     return decisions / elapsed
 
 
+# --faults: chaos-engine block appended to the composed config so the fault
+# path (crash/recover slab events, per-attempt failure draws, CrashLoopBackOff
+# requeues) gets its own measured dispatch/throughput line.
+FAULTS_YAML = """
+fault_injection:
+  enabled: true
+  node:
+    mttf: 900.0
+    mttr: 120.0
+  pod:
+    fail_prob: 0.05
+    restart_limit: 3
+"""
+
+
 COMPOSED_GROUP_YAML = """
 events:
 - timestamp: 49.5
@@ -140,6 +155,7 @@ def run_composed(
     burst: tuple = (300.0, 300.0, 400.0),
     precompile: bool = True,
     use_pallas=True,  # True force-on (hardware bench), False off, None auto
+    faults: bool = False,
 ) -> float:
     """The COMPOSED flagship configuration as a tracked line (VERDICT r3
     item 4): HPA pod groups + cluster autoscaler + sliding pod window +
@@ -154,6 +170,7 @@ def run_composed(
     )
     from kubernetriks_tpu.trace.generic import GenericWorkloadTrace
 
+    faults_block = FAULTS_YAML if faults else ""
     config = SimulationConfig.from_yaml(
         f"""
 sim_name: bench_composed
@@ -169,6 +186,7 @@ cluster_autoscaler:
   - node_template:
       metadata: {{name: ca_node}}
       status: {{capacity: {{cpu: 64000, ram: 137438953472}}}}
+{faults_block}
 """
     )
     cluster = UniformClusterTrace(n_nodes, cpu=64000, ram=128 * 1024**3)
@@ -249,7 +267,9 @@ def _emit(metric: str, value: float) -> None:
 
 
 def main(argv=None) -> None:
-    smoke = "--smoke" in (argv if argv is not None else sys.argv[1:])
+    args = argv if argv is not None else sys.argv[1:]
+    smoke = "--smoke" in args
+    faults = "--faults" in args
     if smoke:
         # CPU-safe plumbing check: all three lines must build, run their
         # full composed machinery (slides, HPA, CA asserts included) and
@@ -279,7 +299,25 @@ def main(argv=None) -> None:
             run_shape(4, 8, horizon=200.0, warm_until=90.0, t_end=290.0,
                       step=100.0),
         )
+        if faults:
+            _emit(
+                "pod-scheduling decisions/sec (SMOKE, composed flagship + "
+                "chaos faults)",
+                run_composed(
+                    4, 8, rate_per_second=0.375, horizon=500.0,
+                    pod_window=128, warm_until=290.0, t_end=490.0,
+                    step=100.0, max_group_pods=16,
+                    burst=(100.0, 150.0, 250.0), precompile=False,
+                    use_pallas=False, faults=True,
+                ),
+            )
         return
+    if faults:
+        _emit(
+            "pod-scheduling decisions/sec (single chip, composed flagship + "
+            "chaos faults: crashes/recoveries + CrashLoopBackOff)",
+            run_composed(faults=True),
+        )
     _emit(
         "pod-scheduling decisions/sec (single chip, 1024x256-node clusters)",
         run_shape(1024, 256),

@@ -198,6 +198,8 @@ def _fused_chunk_slide_impl(
     use_pallas_select: bool = False,
     use_megakernel: bool = True,
     hpa_seg=None,
+    fault_params=None,
+    name_ranks=None,
     W: int = 0,
 ):
     """The composed path's steady-state MEGASTEP: one device program runs a
@@ -230,6 +232,8 @@ def _fused_chunk_slide_impl(
             use_pallas_select,
             use_megakernel=use_megakernel,
             hpa_seg=hpa_seg,
+            fault_params=fault_params,
+            name_ranks=name_ranks,
         )
         return new, None
 
@@ -297,6 +301,18 @@ def _slide_apply_device(pods, rank, pay, base, s: int, W: int):
             [rank[:, s:W], sl(pay["rank"]), rank[:, W:]], axis=1
         )
     return new_pods, new_rank
+
+
+def _lex_name_ranks(names) -> np.ndarray:
+    """Rank of each slot's name in the stable lexicographic sort of
+    `names` — THE scalar-parity ordering primitive (the scalar storage
+    walks name-sorted snapshots). Used by both the autoscale statics and
+    the standalone fault-run rank tables; keep them on this one
+    implementation so the rank rules can't drift apart."""
+    order = np.argsort(np.asarray(names, dtype=object), kind="stable")
+    out = np.empty(len(names), np.int32)
+    out[order] = np.arange(len(names), dtype=np.int32)
+    return out
 
 
 def build_autoscale_statics(
@@ -468,10 +484,7 @@ def build_autoscale_statics(
     def _ranks_for(names_key, names):
         got = _rank_cache.get(names_key)
         if got is None:
-            order = np.argsort(np.asarray(names, dtype=object), kind="stable")
-            got = np.empty(len(names), np.int32)
-            got[order] = np.arange(len(names), dtype=np.int32)
-            _rank_cache[names_key] = got
+            got = _rank_cache[names_key] = _lex_name_ranks(names)
         return got
 
     pod_name_rank = np.full((C, n_pods), BIG_RANK, np.int32)
@@ -727,7 +740,17 @@ class BatchedSimulation:
             pod_req_cpu,
             pod_req_ram,
             pod_duration,
+            node_crash_downtime,
         ) = pad_and_batch(compiled_traces, n_pods=n_pods_aligned)
+
+        # Chaos engine: static fault constants (None = off, identical
+        # programs) and the KTPU_DEBUG_FINITE guard mode (host-side NaN/inf
+        # sweep after every dispatched chunk; off by default so the donated
+        # hot path is untouched).
+        from kubernetriks_tpu.chaos import make_fault_params
+
+        self.fault_params = make_fault_params(config)
+        self._debug_finite = os.environ.get("KTPU_DEBUG_FINITE") == "1"
 
         if pod_window is not None:
             # Cross-process meshes are supported through the device-resident
@@ -913,6 +936,19 @@ class BatchedSimulation:
             )
         )
 
+        # The CA's reserved node slots (appended above) never crash — pad
+        # the crash-downtime payload to the final node axis.
+        if node_crash_downtime.shape[1] < self.n_nodes:
+            node_crash_downtime = np.concatenate(
+                [
+                    node_crash_downtime,
+                    np.zeros(
+                        (C, self.n_nodes - node_crash_downtime.shape[1]),
+                        np.float32,
+                    ),
+                ],
+                axis=1,
+            )
         self.state = init_state(
             C,
             self.n_nodes,
@@ -923,6 +959,7 @@ class BatchedSimulation:
             pod_req_ram,
             pod_duration,
             interval=config.scheduling_cycle_interval,
+            node_crash_downtime=node_crash_downtime,
         )
         # Static (lo, hi) device-slot bounds covering every pod-group slot:
         # the HPA pass only touches group slots, so its body (victim sort
@@ -1032,6 +1069,52 @@ class BatchedSimulation:
                     self.autoscale_statics,
                     self._state_shardings(sharding, self.autoscale_statics),
                 )
+        # Standalone name-rank tables for fault-injection runs WITHOUT
+        # autoscalers (full-resident only): node crashes produce large
+        # same-instant reschedule batches, whose queue order must follow the
+        # scalar's sorted-name walk — the slot-order fallback diverges
+        # there. With autoscalers on, the autoscale statics already carry
+        # the ranks; under a sliding pod window without autoscalers the
+        # slot-order stand-in remains (documented in docs/PARITY.md).
+        self._fault_name_ranks = None
+        if (
+            self.fault_params is not None
+            and self.autoscale_statics is None
+            and self.pod_window is None
+        ):
+            BIG_RANK = np.int32(1 << 30)
+            nnr = np.full((C, self.n_nodes), BIG_RANK, np.int32)
+            pnr = np.full((C, self.n_pods), BIG_RANK, np.int32)
+            # Workload traces are identical across clusters (only the node
+            # fault schedules differ), so memoize the object-dtype argsort
+            # by name tuple — the pod table is computed once for C clusters.
+            memo: dict = {}
+
+            def _ranks(names):
+                key = tuple(names)
+                got = memo.get(key)
+                if got is None:
+                    got = memo[key] = _lex_name_ranks(names)
+                return got
+
+            for ci in range(C):
+                r = _ranks(self.node_names[ci])
+                nnr[ci, : len(r)] = r
+                r = _ranks(self.pod_names[ci])
+                # The pod axis may be 128-aligned past the real names;
+                # padding slots keep BIG_RANK.
+                pnr[ci, : min(len(r), self.n_pods)] = r[: self.n_pods]
+            ranks = (jnp.asarray(nnr), jnp.asarray(pnr))
+            if self.mesh is not None:
+                row = NamedSharding(
+                    self.mesh, PartitionSpec(self._batch_axis, None)
+                )
+                put = (
+                    put_global if is_cross_process(self.mesh) else jax.device_put
+                )
+                ranks = put(ranks, (row, row))
+            self._fault_name_ranks = ranks
+
         # Sliding runs: install the initial windowed name-rank slice
         # (build_autoscale_statics leaves ranks BIG under sliding). Must run
         # AFTER self.mesh is assigned and the statics carry their final
@@ -1200,6 +1283,8 @@ class BatchedSimulation:
             use_pallas_select=self.use_pallas_select,
             use_megakernel=self.use_megakernel,
             hpa_seg=self._hpa_seg,
+            fault_params=self.fault_params,
+            name_ranks=self._fault_name_ranks,
         )
 
     def _dispatch_windows(self, idxs: np.ndarray, fuse_slide: bool = False) -> None:
@@ -1781,9 +1866,50 @@ class BatchedSimulation:
         )
         return True
 
+    # Float state fields whose +/-inf values are documented sentinels ("no
+    # pending effect" pairs, estimator min/max identities) — everything else
+    # must be finite after every chunk under KTPU_DEBUG_FINITE=1.
+    _FINITE_EXEMPT = (
+        "finish_time",
+        "removal_time",
+        "remove_time",
+        "create_time",
+        "hpa_next",
+        "ca_next",
+        "minimum",
+        "maximum",
+    )
+
+    def _check_finite(self) -> None:
+        """KTPU_DEBUG_FINITE=1 guard mode: sweep every float leaf of the
+        state after a dispatched chunk — NaN anywhere, or inf outside the
+        documented sentinel fields, raises with the offending field name.
+        Host-side readback, so the donated hot path is untouched when off."""
+        if not self._debug_finite:
+            return
+        flat, _ = jax.tree_util.tree_flatten_with_path(self.state)
+        for path, leaf in flat:
+            arr = np.asarray(to_host(leaf))
+            if not np.issubdtype(arr.dtype, np.floating):
+                continue
+            key = jax.tree_util.keystr(path)
+            if np.isnan(arr).any():
+                raise FloatingPointError(
+                    f"KTPU_DEBUG_FINITE: NaN in state field {key} after "
+                    f"window {self.next_window_idx - 1}"
+                )
+            if not any(tok in key for tok in self._FINITE_EXEMPT) and not (
+                np.isfinite(arr).all()
+            ):
+                raise FloatingPointError(
+                    f"KTPU_DEBUG_FINITE: non-finite value in state field "
+                    f"{key} after window {self.next_window_idx - 1}"
+                )
+
     def _step_idxs(self, idxs: np.ndarray, fuse_slide: bool = False) -> None:
         if not (self.profile_dir or self.log_throughput):
             self._dispatch_windows(idxs, fuse_slide=fuse_slide)
+            self._check_finite()
             return
 
         # Instrumented path: optional jax.profiler capture + a per-chunk
@@ -1808,6 +1934,7 @@ class BatchedSimulation:
             self._dispatch_windows(idxs, fuse_slide=fuse_slide)
             jax.block_until_ready(self.state.time)
         elapsed = time.perf_counter() - t0
+        self._check_finite()
         if self.log_throughput:
             decisions = (
                 int(to_host(self.state.metrics.scheduling_decisions).sum()) - before
@@ -1847,6 +1974,8 @@ class BatchedSimulation:
             use_pallas_select=self.use_pallas_select,
             use_megakernel=self.use_megakernel,
             hpa_seg=self._hpa_seg,
+            fault_params=self.fault_params,
+            name_ranks=self._fault_name_ranks,
         )
         if self.collect_gauges:
             from kubernetriks_tpu.batched.step import gauge_snapshot
@@ -1983,6 +2112,15 @@ class BatchedSimulation:
                 "total_scaled_down_pods": int(np.asarray(m.scaled_down_pods).sum()),
                 "total_scaled_up_nodes": int(np.asarray(m.scaled_up_nodes).sum()),
                 "total_scaled_down_nodes": int(np.asarray(m.scaled_down_nodes).sum()),
+                # Chaos-engine fault counters (zero when faults are off).
+                "node_crashes": int(np.asarray(m.node_crashes).sum()),
+                "node_recoveries": int(np.asarray(m.node_recoveries).sum()),
+                "node_downtime_s": float(
+                    np.asarray(m.node_downtime_s, np.float64).sum()
+                ),
+                "pod_interruptions": int(np.asarray(m.pod_interruptions).sum()),
+                "pod_restarts": int(np.asarray(m.pod_restarts).sum()),
+                "pods_failed": int(np.asarray(m.pods_failed).sum()),
             },
             "timings": {
                 "pod_duration": est(m.pod_duration),
@@ -2195,12 +2333,49 @@ def build_batched_from_traces(
     **kwargs,
 ) -> BatchedSimulation:
     """Replicate one (cluster trace, workload trace) pair across n_clusters —
-    the homogeneous-batch benchmark shape."""
+    the homogeneous-batch benchmark shape.
+
+    With fault injection enabled and node faults configured, each cluster
+    gets its OWN crash/recover schedule (the counter PRNG keys on the
+    cluster index — cluster 0 matches the scalar path), so the trace is
+    compiled per cluster instead of tiled."""
+    ram_unit = kwargs.pop("ram_unit", DEFAULT_RAM_UNIT)
+    slot_mult = kwargs.pop("pod_group_slot_multiplier", 2)
+
+    from kubernetriks_tpu import chaos
+
+    fault_cfg = getattr(config, "fault_injection", None)
+    if chaos.has_node_faults(fault_cfg):
+        fault_seed = (
+            fault_cfg.seed if fault_cfg.seed is not None else config.seed
+        )
+        horizon = chaos.fault_horizon(
+            fault_cfg, cluster_events, workload_events
+        )
+        compiled_list = [
+            compile_cluster_trace(
+                chaos.inject_node_faults(
+                    cluster_events,
+                    fault_cfg,
+                    fault_seed,
+                    c,
+                    horizon,
+                    config.scheduling_cycle_interval,
+                ),
+                workload_events,
+                config,
+                ram_unit=ram_unit,
+                pod_group_slot_multiplier=slot_mult,
+            )
+            for c in range(n_clusters)
+        ]
+        return BatchedSimulation(config, compiled_list, **kwargs)
+
     compiled = compile_cluster_trace(
         cluster_events,
         workload_events,
         config,
-        ram_unit=kwargs.pop("ram_unit", DEFAULT_RAM_UNIT),
-        pod_group_slot_multiplier=kwargs.pop("pod_group_slot_multiplier", 2),
+        ram_unit=ram_unit,
+        pod_group_slot_multiplier=slot_mult,
     )
     return BatchedSimulation(config, [compiled] * n_clusters, **kwargs)

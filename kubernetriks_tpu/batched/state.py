@@ -61,6 +61,12 @@ EV_CREATE_NODE = 1
 EV_REMOVE_NODE = 2
 EV_CREATE_POD = 3
 EV_REMOVE_POD = 4
+# Chaos engine (chaos.py): a crash is EV_REMOVE_NODE semantics plus fault
+# accounting (the slot's pre-staged crash_downtime folds into the downtime
+# metric); a recovery is EV_CREATE_NODE semantics on a FRESH slot (slots are
+# never reused) plus the recovery counter.
+EV_NODE_CRASH = 5
+EV_NODE_RECOVER = 6
 
 DEFAULT_RAM_UNIT = 1024 * 1024  # 1 MiB
 
@@ -78,6 +84,11 @@ class NodeArrays(NamedTuple):
     # Pending on-device effects (cluster-autoscaler actions); +inf = none.
     create_time: TPair
     remove_time: TPair
+    # Pre-staged chaos payload: the sampled repair span of the slot's crash
+    # event (each slot crashes at most once — recovery opens a fresh slot);
+    # 0 on slots that never crash. Folded into node_downtime_s when
+    # EV_NODE_CRASH applies.
+    crash_downtime: jnp.ndarray  # float32 seconds
 
 
 class PodArrays(NamedTuple):
@@ -102,6 +113,12 @@ class PodArrays(NamedTuple):
     # victim selection pops the lexicographically-smallest name from it
     # (kube_horizontal_pod_autoscaler.rs:197-205).
     hpa_idx: jnp.ndarray  # int32
+    # Chaos engine (CrashLoopBackOff): completed failure count, and whether
+    # the CURRENT running attempt fails at finish_time (drawn at commit from
+    # the counter PRNG on (cluster, global slot, restarts)). Inert zeros
+    # when fault injection is off.
+    restarts: jnp.ndarray  # int32
+    will_fail: jnp.ndarray  # bool
 
 
 class EstArrays(NamedTuple):
@@ -158,6 +175,16 @@ class MetricArrays(NamedTuple):
     # fitting template — the CA-side silent divergence, same loud-readout
     # treatment.
     ca_reserve_starved: jnp.ndarray  # int32
+    # Chaos-engine fault counters (mirroring the scalar AccumulatedMetrics
+    # additions): crashes/recoveries applied, summed sampled repair spans,
+    # crash-caused pod reschedules, CrashLoopBackOff requeues, and pods
+    # permanently failed past the restart limit.
+    node_crashes: jnp.ndarray  # int32
+    node_recoveries: jnp.ndarray  # int32
+    node_downtime_s: jnp.ndarray  # float32
+    pod_interruptions: jnp.ndarray  # int32
+    pod_restarts: jnp.ndarray  # int32
+    pods_failed: jnp.ndarray  # int32
     queue_time: EstArrays
     algo_latency: EstArrays
     pod_duration: EstArrays
@@ -290,6 +317,8 @@ def fresh_pod_arrays(
         finish_time=t_inf((C, P)),
         removal_time=t_inf((C, P)),
         hpa_idx=jnp.full((C, P), -1, jnp.int32),
+        restarts=jnp.zeros((C, P), jnp.int32),
+        will_fail=jnp.zeros((C, P), bool),
     )
 
 
@@ -303,10 +332,13 @@ def init_state(
     pod_req_ram: np.ndarray,
     pod_duration: np.ndarray,
     interval: float,
+    node_crash_downtime: Optional[np.ndarray] = None,
 ) -> ClusterBatchState:
     """Build the initial state with pre-staged payloads (all slots start
     EMPTY/dead; trace events bring them to life). pod_duration: float64
-    seconds, <0 marks a long-running service."""
+    seconds, <0 marks a long-running service. node_crash_downtime: (C, N)
+    sampled repair spans of the chaos engine's crash events (None = no
+    faults, zeros)."""
     C, N, P = n_clusters, n_nodes, n_pods
     duration = duration_pair_np(pod_duration, interval)
     nodes = NodeArrays(
@@ -317,6 +349,11 @@ def init_state(
         alloc_ram=jnp.asarray(node_cap_ram, jnp.int32),
         create_time=t_inf((C, N)),
         remove_time=t_inf((C, N)),
+        crash_downtime=(
+            jnp.zeros((C, N), jnp.float32)
+            if node_crash_downtime is None
+            else jnp.asarray(node_crash_downtime, jnp.float32)
+        ),
     )
     pods = fresh_pod_arrays(C, P, pod_req_cpu, pod_req_ram, duration)
     metrics = MetricArrays(
@@ -331,6 +368,12 @@ def init_state(
         scaled_down_nodes=jnp.zeros((C,), jnp.int32),
         hpa_reserve_clamped=jnp.zeros((C,), jnp.int32),
         ca_reserve_starved=jnp.zeros((C,), jnp.int32),
+        node_crashes=jnp.zeros((C,), jnp.int32),
+        node_recoveries=jnp.zeros((C,), jnp.int32),
+        node_downtime_s=jnp.zeros((C,), jnp.float32),
+        pod_interruptions=jnp.zeros((C,), jnp.int32),
+        pod_restarts=jnp.zeros((C,), jnp.int32),
+        pods_failed=jnp.zeros((C,), jnp.int32),
         queue_time=EstArrays.zeros((C,)),
         algo_latency=EstArrays.zeros((C,)),
         pod_duration=EstArrays.zeros((C,)),

@@ -48,8 +48,11 @@ from kubernetriks_tpu.batched.state import (
     EstArrays,
     EV_CREATE_NODE,
     EV_CREATE_POD,
+    EV_NODE_CRASH,
+    EV_NODE_RECOVER,
     EV_REMOVE_NODE,
     EV_REMOVE_POD,
+    PHASE_FAILED,
     PHASE_QUEUED,
     PHASE_REMOVED,
     PHASE_RUNNING,
@@ -108,6 +111,23 @@ def _rel_seconds(t: TPair, base_win: jnp.ndarray, interval) -> jnp.ndarray:
     return (t.win - base_win).astype(jnp.float32) * jnp.float32(interval) + t.off
 
 
+def _stable_queue_rank(keys) -> jnp.ndarray:
+    """Dense queue ranks from lexicographic (C, P) sort keys: the
+    scatter-inverse of a stable sort over the pod axis, slot order breaking
+    exact key ties. Shared by the reschedule and CrashLoopBackOff retry
+    dispositions so the scalar-parity ordering rules live in ONE place."""
+    C, P = keys[0].shape
+    iota_pp = jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32)[None, :], (C, P))
+    out = jax.lax.sort(
+        (*keys, iota_pp), dimension=1, num_keys=len(keys), is_stable=True
+    )
+    return (
+        jnp.zeros((C, P), jnp.int32)
+        .at[jnp.arange(C, dtype=jnp.int32)[:, None], out[-1]]
+        .set(iota_pp)
+    )
+
+
 
 def _shard_rowwise(core, n_in: int, n_out: int, mesh, axis: str):
     """shard_map a kernel wrapper over the cluster axis: every input/output
@@ -142,9 +162,18 @@ def _apply_window_events(
     use_pallas_select: bool = False,
     node_name_rank=None,
     pod_name_rank=None,
+    fault_params=None,
 ) -> ClusterBatchState:
     """Apply every trace event with effect time STRICTLY before the cycle time
     W * interval, and resolve all pod finishes due in the window.
+
+    fault_params (chaos.FaultParams, static): with node_faults, the slab may
+    carry EV_NODE_CRASH (remove semantics + crash/downtime accounting; a
+    separate scatter keeps crash attribution for the interruption counter)
+    and EV_NODE_RECOVER (create semantics on a fresh slot + recovery count);
+    with pod_faults, running pods whose will_fail flag is set FAIL at their
+    finish_time instead of succeeding — retry via CrashLoopBackOff requeue
+    or terminate as PHASE_FAILED past the restart limit.
 
     Strictness: an effect landing exactly at cycle time T is processed after
     the cycle in the scalar kernel (older-event-id-first FIFO), so it belongs
@@ -172,12 +201,20 @@ def _apply_window_events(
         fused_event_scatter,
     )
 
+    node_faults = fault_params is not None and fault_params.node_faults
+    pod_faults = fault_params is not None and fault_params.fail_prob > 0
+
     # The one-hot scatter kernels sweep whole (P, 128-lane) tiles per event,
     # so like the selection kernel they only pay when the cluster lanes are
     # dense — use_pallas_select carries exactly that gate (measured: the
-    # C=1 replay regressed 229 s -> 350 s with them always-on).
+    # C=1 replay regressed 229 s -> 350 s with them always-on). The kernel
+    # predates the chaos event kinds, so fault-bearing slabs take the plain
+    # scatter path (bit-identical fallback).
     use_event_kernel = (
-        use_pallas and use_pallas_select and event_kernel_fits(N, P, E)
+        use_pallas
+        and use_pallas_select
+        and event_kernel_fits(N, P, E)
+        and not node_faults
     )
     if use_event_kernel:
         event_core = partial(fused_event_scatter, interpret=pallas_interpret)
@@ -199,12 +236,14 @@ def _apply_window_events(
         return jnp.any(chunk_due(carry[0]))
 
     def chunk_body(carry):
+        (cursor, created, node_removal, pod_create, pod_create_seq,
+         pod_removal, n_creates) = carry[:7]
+        tail = 7
         if conditional_move:
-            (cursor, created, node_removal, pod_create, pod_create_seq,
-             pod_removal, n_creates, node_create_rel) = carry
-        else:
-            (cursor, created, node_removal, pod_create, pod_create_seq,
-             pod_removal, n_creates) = carry
+            node_create_rel = carry[tail]
+            tail += 1
+        if node_faults:
+            crash_rm, n_recover = carry[tail], carry[tail + 1]
         offs = cursor[:, None] + jnp.arange(E, dtype=jnp.int32)[None, :]
         offs_c = jnp.clip(offs, 0, E_total - 1)
         # One packed gather instead of four (gather cost is per-index on TPU).
@@ -240,6 +279,16 @@ def _apply_window_events(
         is_rn = valid & (ev_k == EV_REMOVE_NODE)
         is_cp = valid & (ev_k == EV_CREATE_POD)
         is_rp = valid & (ev_k == EV_REMOVE_POD)
+        if node_faults:
+            # Recoveries ARE creations (fresh slot, fresh capacity) — fold
+            # into is_cn so every create-side effect (alive/alloc, wake
+            # events, pending-create interplay) applies identically; crashes
+            # scatter into their own removal array so crash attribution
+            # survives for the interruption/downtime metrics, and merge into
+            # node_removal after the loop.
+            is_crash = valid & (ev_k == EV_NODE_CRASH)
+            is_recover = valid & (ev_k == EV_NODE_RECOVER)
+            is_cn = is_cn | is_recover
         # Queue sequence numbers follow slab (== emission) order, continuing
         # across chunks via the running n_creates.
         create_rank = jnp.cumsum(is_cp, axis=1, dtype=jnp.int32) - 1
@@ -292,6 +341,14 @@ def _apply_window_events(
                 rows, jnp.where(is_cn, ev_s, N)
             ].min(jnp.where(is_cn, ev_rel, f32inf), mode="drop")
             out = out + (node_create_rel,)
+        if node_faults:
+            crash_rm = crash_rm.at[rows, jnp.where(is_crash, ev_s, N)].min(
+                jnp.where(is_crash, ev_rel, f32inf), mode="drop"
+            )
+            out = out + (
+                crash_rm,
+                n_recover + is_recover.sum(axis=1, dtype=jnp.int32),
+            )
         return out
 
     carry0 = (
@@ -305,10 +362,32 @@ def _apply_window_events(
     )
     if conditional_move:
         carry0 = carry0 + (jnp.full((C, N), INF, jnp.float32),)
+    if node_faults:
+        carry0 = carry0 + (
+            jnp.full((C, N), INF, jnp.float32),
+            jnp.zeros((C,), jnp.int32),
+        )
     carry_out = jax.lax.while_loop(chunk_cond, chunk_body, carry0)
     (event_cursor, created, node_removal, pod_create, pod_create_seq,
      pod_removal, n_creates) = carry_out[:7]
-    node_create_rel = carry_out[7] if conditional_move else None
+    tail = 7
+    node_create_rel = None
+    if conditional_move:
+        node_create_rel = carry_out[tail]
+        tail += 1
+    if node_faults:
+        crash_rm, n_recover = carry_out[tail], carry_out[tail + 1]
+        crashed_now = crash_rm < f32inf
+        metrics = metrics._replace(
+            node_crashes=metrics.node_crashes
+            + crashed_now.sum(axis=1, dtype=jnp.int32),
+            node_recoveries=metrics.node_recoveries + n_recover,
+            # Downtime = the crash's pre-sampled repair span (each slot
+            # crashes at most once; recovery opens a fresh slot).
+            node_downtime_s=metrics.node_downtime_s
+            + jnp.where(crashed_now, nodes.crash_downtime, 0.0).sum(axis=1),
+        )
+        node_removal = jnp.minimum(node_removal, crash_rm)
 
     # Pending autoscaler creations due this window (CA scale-up effects).
     pend_create = (nodes.create_time.win < W[:, None]) & ~nodes.alive
@@ -386,6 +465,34 @@ def _apply_window_events(
     rescheds = interrupted & (pod_node_removal < pod_removal)
     removed_running = interrupted & (pod_removal <= pod_node_removal)
 
+    # Chaos: split completions into real finishes and failing attempts
+    # (will_fail drawn at commit; finish_time IS the fail time). Both free
+    # their resources through the shared `freed` path below; only real
+    # finishes count succeeded/duration stats.
+    if pod_faults:
+        fails = finishes & pods.will_fail
+        real_fin = finishes & ~pods.will_fail
+    else:
+        fails = None
+        real_fin = finishes
+
+    if node_faults:
+        # Crash-caused reschedules (the interruption metric): the pod's
+        # earliest node removal came from a crash (ties attribute to the
+        # crash, matching the scalar chain where the crash IS the removal).
+        pod_crash_rm = jax.lax.cond(
+            crashed_now.any(),
+            lambda: jnp.where(
+                pods.node >= 0, crash_rm[rows, node_idx], f32inf
+            ),
+            lambda: jnp.full((C, P), INF, jnp.float32),
+        )
+        crash_caused = rescheds & (pod_crash_rm <= pod_node_removal)
+        metrics = metrics._replace(
+            pod_interruptions=metrics.pod_interruptions
+            + crash_caused.sum(axis=1, dtype=jnp.int32)
+        )
+
     # Free resources of finished and removed-while-running pods (a dead node's
     # allocatable is irrelevant; slots are never reused). A straight
     # (C, P)-indexed scatter is the single most expensive op in the step
@@ -413,7 +520,7 @@ def _apply_window_events(
         # (C, P) masked reductions below.
         alloc_cpu, alloc_ram, dur_stats = core(
             freed, pods.node, pods.req_cpu, pods.req_ram,
-            finishes, duration_s, alloc_cpu, alloc_ram,
+            real_fin, duration_s, alloc_cpu, alloc_ram,
         )
     else:
         F = min(P, 32)  # freed-compaction chunk width (independent of E)
@@ -451,9 +558,9 @@ def _apply_window_events(
             maximum=jnp.maximum(est.maximum, dur_stats[:, 4]),
         )
     else:
-        n_done = finishes.sum(axis=1, dtype=jnp.int32)
+        n_done = real_fin.sum(axis=1, dtype=jnp.int32)
         pod_duration_est = _est_add_reduced(
-            metrics.pod_duration, duration_s, finishes
+            metrics.pod_duration, duration_s, real_fin
         )
     metrics = metrics._replace(
         pods_succeeded=metrics.pods_succeeded + n_done,
@@ -461,7 +568,7 @@ def _apply_window_events(
         pod_duration=pod_duration_est,
         processed_nodes=metrics.processed_nodes + created.sum(axis=1, dtype=jnp.int32),
     )
-    phase = jnp.where(finishes, PHASE_SUCCEEDED, phase)
+    phase = jnp.where(real_fin, PHASE_SUCCEEDED, phase)
     finish_time = t_where(finishes, t_inf((C, P)), pods.finish_time)
 
     # Reschedule pods of removed nodes (reference: scheduler.rs:336-364).
@@ -484,18 +591,7 @@ def _apply_window_events(
             k3 = jnp.where(rescheds, pod_name_rank, big)
         else:
             k3 = jnp.zeros((C, P), jnp.int32)
-        iota_pp = jnp.broadcast_to(
-            jnp.arange(P, dtype=jnp.int32)[None, :], (C, P)
-        )
-        _, _, _, inv = jax.lax.sort(
-            (k1, k2, k3, iota_pp), dimension=1, num_keys=3, is_stable=True
-        )
-        rank = (
-            jnp.zeros((C, P), jnp.int32)
-            .at[jnp.arange(C, dtype=jnp.int32)[:, None], inv]
-            .set(iota_pp)
-        )
-        return rank
+        return _stable_queue_rank((k1, k2, k3))
 
     resched_rank = jax.lax.cond(
         rescheds.any(),
@@ -519,6 +615,82 @@ def _apply_window_events(
     finish_time = t_where(rescheds, t_inf((C, P)), finish_time)
     pod_node = jnp.where(rescheds, -1, pods.node)
     n_rescheds = rescheds.sum(axis=1, dtype=jnp.int32)
+
+    # Chaos: dispose of failing attempts — CrashLoopBackOff retry (requeue
+    # at fail + min(base * 2^k, cap), fresh initial-attempt timestamp,
+    # mirroring the scalar RequeuePodAfterBackoff delivery) or permanent
+    # failure past the restart limit (terminal PHASE_FAILED).
+    restarts_arr = pods.restarts
+    will_fail_arr = pods.will_fail
+    n_fail_retries = jnp.zeros_like(n_rescheds)
+    if pod_faults:
+        new_restarts = pods.restarts + 1
+        retry = fails & (new_restarts <= jnp.int32(fault_params.restart_limit))
+        perma = fails & ~retry
+        fail_rel = _rel_seconds(pods.finish_time, base[:, None], interval)
+        backoff = jnp.minimum(
+            jnp.float32(fault_params.backoff_base)
+            * jnp.exp2(pods.restarts.astype(jnp.float32)),
+            jnp.float32(fault_params.backoff_cap),
+        )
+        # The retry cannot enter the queue before the failure itself reaches
+        # the scheduler (node -> api server -> storage -> scheduler — the
+        # same chain as a node-removal reschedule), so a backoff shorter
+        # than that delay is floored at it, like the scalar delivery.
+        retry_ts = t_norm(
+            jnp.broadcast_to(base[:, None], (C, P)),
+            jnp.where(
+                retry,
+                fail_rel
+                + jnp.maximum(backoff, jnp.float32(consts.delta_reschedule)),
+                0.0,
+            ),
+            interval,
+        )
+
+        def _fail_rank_exact():
+            # Seq ranks among this window's retries follow the scalar's
+            # failure-event order: fail time, then pod name (slot order as
+            # the rank-less fallback, kept by the stable sort).
+            big = jnp.int32(1 << 30)
+            k1 = jnp.where(retry, fail_rel, f32inf)
+            if pod_name_rank is not None:
+                k2 = jnp.where(retry, pod_name_rank, big)
+            else:
+                k2 = jnp.zeros((C, P), jnp.int32)
+            return _stable_queue_rank((k1, k2))
+
+        fail_rank = jax.lax.cond(
+            retry.any(),
+            _fail_rank_exact,
+            lambda: jnp.cumsum(retry, axis=1, dtype=jnp.int32) - 1,
+        )
+        phase = jnp.where(
+            retry,
+            PHASE_QUEUED,
+            jnp.where(perma, PHASE_FAILED, phase),
+        )
+        queue_ts = t_where(retry, retry_ts, queue_ts)
+        queue_seq = jnp.where(
+            retry,
+            state.queue_seq_counter[:, None]
+            + n_creates[:, None]
+            + n_rescheds[:, None]
+            + fail_rank,
+            queue_seq,
+        )
+        initial_attempt_ts = t_where(retry, retry_ts, initial_attempt_ts)
+        attempts = jnp.where(retry, 1, attempts)
+        pod_node = jnp.where(fails, -1, pod_node)
+        restarts_arr = jnp.where(fails, new_restarts, pods.restarts)
+        will_fail_arr = jnp.where(fails, False, pods.will_fail)
+        n_fail_retries = retry.sum(axis=1, dtype=jnp.int32)
+        n_perma = perma.sum(axis=1, dtype=jnp.int32)
+        metrics = metrics._replace(
+            pod_restarts=metrics.pod_restarts + n_fail_retries,
+            pods_failed=metrics.pods_failed + n_perma,
+            terminated_pods=metrics.terminated_pods + n_perma,
+        )
 
     # Removed-while-running pods terminate as removed
     # (reference: api_server.rs PodRemovedFromNode removed=true accounting).
@@ -546,6 +718,10 @@ def _apply_window_events(
 
     any_created_node = created.any(axis=1)
     any_freed = (n_done > 0) | (n_removed_running > 0)
+    if pod_faults:
+        # Failing attempts free their resources too (scalar: the failure
+        # handler wakes the unschedulable queue like a finish).
+        any_freed = any_freed | fails.any(axis=1)
 
     # Conditional-move wake events (consumed by prepare_cycle's per-event
     # wake scans when enable_unscheduled_pods_conditional_move is on;
@@ -584,10 +760,15 @@ def _apply_window_events(
             node=pod_node,
             finish_time=finish_time,
             removal_time=pod_removal_time,
+            restarts=restarts_arr,
+            will_fail=will_fail_arr,
         ),
         metrics=metrics,
         event_cursor=event_cursor,
-        queue_seq_counter=state.queue_seq_counter + n_creates + n_rescheds,
+        queue_seq_counter=state.queue_seq_counter
+        + n_creates
+        + n_rescheds
+        + n_fail_retries,
         # Events of interest wake the unschedulable queue (flush-all policy,
         # reference: scheduler.rs:391-410,435-440,445-473).
         requeue_signal=state.requeue_signal | any_created_node | any_freed,
@@ -894,12 +1075,20 @@ def commit_scattered_tail(
     node,
     start_tmp,
     park_tmp,
+    fault_params=None,
 ) -> ClusterBatchState:
     """Shared bottom half of the decision commit: reconstruct absolute
     start/finish/park pairs from the scattered float32 second offsets
     (+inf = untouched) and write the post-cycle state. Used by commit_cycle
     and by the megakernel path (whose kernel already produced the scattered
-    phase/node/start/park arrays)."""
+    phase/node/start/park arrays).
+
+    With pod faults on, this is ALSO where every new attempt's failure draw
+    happens: a counter-PRNG threefry on (seed, cluster, global plain pod
+    slot, restarts) — identical bits to the scalar oracle's draw at
+    assignment commit — decides whether the attempt fails and at what
+    fraction of its duration; a failing attempt's finish_time becomes its
+    fail time and will_fail is set for the finish resolution to dispose."""
     C, P = pods.phase.shape
     interval = jnp.float32(consts.scheduling_interval)
     f32inf = jnp.float32(INF)
@@ -913,11 +1102,46 @@ def commit_scattered_tail(
     service = pods.duration.win < 0
     finish_pair = t_add(start_pair, pods.duration, interval)
     start_time = t_where(started, start_pair, pods.start_time)
-    finish_time = t_where(
-        started,
-        t_where(service, t_inf((C, P)), finish_pair),
-        pods.finish_time,
-    )
+    finish_val = t_where(service, t_inf((C, P)), finish_pair)
+    pods_fault_fields = {}
+    if fault_params is not None and fault_params.fail_prob > 0:
+        from kubernetriks_tpu import chaos
+
+        idx = jnp.broadcast_to(
+            jnp.arange(P, dtype=jnp.int32)[None, :], (C, P)
+        )
+        # Device layout: [window over plain slots | resident ring tail];
+        # plain device slot -> global slot via pod_base, resident via the
+        # fixed shift. Only plain trace pods with finite durations draw
+        # (ring replicas' identities are runtime-assigned and path-specific).
+        plain_width = consts.trace_pod_bound - consts.resident_shift
+        in_plain = idx < plain_width
+        gslot = idx + jnp.where(
+            in_plain, state.pod_base[:, None], jnp.int32(consts.resident_shift)
+        )
+        cid = jnp.broadcast_to(
+            jnp.arange(C, dtype=jnp.int32)[:, None], (C, P)
+        )
+        u_fail, u_frac = chaos.pod_attempt_uniforms(
+            fault_params.seed,
+            cid.astype(jnp.uint32),
+            gslot.astype(jnp.uint32),
+            pods.restarts.astype(jnp.uint32),
+            xp=jnp,
+        )
+        faultable = started & in_plain & (pods.duration.win >= 0)
+        wf = faultable & (u_fail < jnp.float32(fault_params.fail_prob))
+        dur_s = t_seconds_f32(pods.duration, interval)
+        fail_fin = t_norm(
+            jnp.broadcast_to(W[:, None], (C, P)),
+            jnp.where(wf, start_tmp + u_frac * dur_s, 0.0),
+            interval,
+        )
+        finish_val = t_where(wf, fail_fin, finish_val)
+        pods_fault_fields["will_fail"] = jnp.where(
+            started, wf, pods.will_fail
+        )
+    finish_time = t_where(started, finish_val, pods.finish_time)
     parked = park_tmp < f32inf
     park_pair = t_norm(
         jnp.broadcast_to(W[:, None], (C, P)),
@@ -934,6 +1158,7 @@ def commit_scattered_tail(
             node=node,
             start_time=start_time,
             finish_time=finish_time,
+            **pods_fault_fields,
         ),
         metrics=metrics,
         requeue_signal=jnp.zeros_like(state.requeue_signal),
@@ -959,6 +1184,7 @@ def commit_cycle(
     pallas_interpret: bool = False,
     pallas_mesh=None,
     pallas_axis: str = "clusters",
+    fault_params=None,
 ) -> ClusterBatchState:
     """Scatter the K per-cluster decisions back into (C, P) state.
 
@@ -1017,6 +1243,7 @@ def commit_cycle(
     return commit_scattered_tail(
         state, pods, cc.last_flush_win, W, consts, alloc_cpu, alloc_ram,
         metrics, phase, node, start_tmp, park_tmp,
+        fault_params=fault_params,
     )
 
 
@@ -1033,6 +1260,7 @@ def _run_scheduling_cycle(
     use_pallas_select: bool = False,
     wake=None,
     use_megakernel: bool = True,
+    fault_params=None,
 ) -> ClusterBatchState:
     """One vectorized kube-scheduler cycle at window W for every cluster
     (scalar equivalent: reference scheduler.rs:246-333).
@@ -1131,6 +1359,7 @@ def _run_scheduling_cycle(
         return commit_scattered_tail(
             state, pods, last_flush_win, W, consts, alloc_cpu, alloc_ram,
             metrics, phase, node, start_tmp, park_tmp,
+            fault_params=fault_params,
         )
     elif use_pallas and use_pallas_select:
         # Two-kernel fallback (KTPU_MEGAKERNEL=0): in-kernel selection+cycle,
@@ -1249,6 +1478,7 @@ def _run_scheduling_cycle(
         pallas_interpret=pallas_interpret,
         pallas_mesh=pallas_mesh,
         pallas_axis=pallas_axis,
+        fault_params=fault_params,
     )
 
 
@@ -1270,8 +1500,22 @@ def _window_body(
     use_pallas_select: bool = False,
     use_megakernel: bool = True,
     hpa_seg=None,
+    fault_params=None,
+    name_ranks=None,
 ) -> ClusterBatchState:
     W = jnp.broadcast_to(jnp.asarray(W, jnp.int32), state.time.shape)
+    # Same-time reschedule/retry ordering needs lexicographic name ranks to
+    # match the scalar's sorted-name walks; they come from the autoscale
+    # statics when autoscalers are on, else from the engine's standalone
+    # rank tables (built for fault-injection runs, where node crashes
+    # produce large same-instant reschedule batches).
+    if autoscale_statics is not None:
+        node_name_rank = autoscale_statics.node_name_rank
+        pod_name_rank = autoscale_statics.pod_name_rank
+    elif name_ranks is not None:
+        node_name_rank, pod_name_rank = name_ranks
+    else:
+        node_name_rank = pod_name_rank = None
     state, wake = _apply_window_events(
         state,
         slab,
@@ -1284,14 +1528,9 @@ def _window_body(
         pallas_mesh,
         pallas_axis,
         use_pallas_select,
-        node_name_rank=(
-            autoscale_statics.node_name_rank
-            if autoscale_statics is not None else None
-        ),
-        pod_name_rank=(
-            autoscale_statics.pod_name_rank
-            if autoscale_statics is not None else None
-        ),
+        node_name_rank=node_name_rank,
+        pod_name_rank=pod_name_rank,
+        fault_params=fault_params,
     )
     # Pre-cycle shadows for the CA's early-snapshot case (a CA storage
     # snapshot landing before this window's commit-visibility time must not
@@ -1315,6 +1554,7 @@ def _window_body(
         use_pallas_select,
         wake=wake,
         use_megakernel=use_megakernel,
+        fault_params=fault_params,
     )
     if autoscale_statics is not None:
         # Autoscaler ticks due by this window run after the scheduling cycle
@@ -1405,6 +1645,9 @@ _STEP_STATICS = (
     "use_pallas_select",
     "use_megakernel",
     "hpa_seg",
+    # chaos.FaultParams (hashable NamedTuple of scalars) or None; None
+    # compiles programs textually identical to the pre-chaos build.
+    "fault_params",
 )
 
 
@@ -1427,6 +1670,8 @@ def window_step(
     use_pallas_select: bool = False,
     use_megakernel: bool = True,
     hpa_seg=None,
+    fault_params=None,
+    name_ranks=None,
 ) -> ClusterBatchState:
     """Advance every cluster through scheduling-cycle window index W."""
     return _window_body(
@@ -1447,6 +1692,8 @@ def window_step(
         use_pallas_select,
         use_megakernel=use_megakernel,
         hpa_seg=hpa_seg,
+        fault_params=fault_params,
+        name_ranks=name_ranks,
     )
 
 
@@ -1616,6 +1863,8 @@ def _run_windows_skip_impl(
     use_megakernel: bool = True,
     flush_windows: int = 3,
     hpa_seg=None,
+    fault_params=None,
+    name_ranks=None,
 ):
     """run_windows with FAST-FORWARD over provably no-op windows: a dynamic
     while_loop executes only interesting windows (see
@@ -1649,6 +1898,8 @@ def _run_windows_skip_impl(
             use_pallas_select,
             use_megakernel=use_megakernel,
             hpa_seg=hpa_seg,
+            fault_params=fault_params,
+            name_ranks=name_ranks,
         )
         W_next = jnp.minimum(
             _next_interesting_window(
@@ -1705,6 +1956,8 @@ def _run_windows_impl(
     use_pallas_select: bool = False,
     use_megakernel: bool = True,
     hpa_seg=None,
+    fault_params=None,
+    name_ranks=None,
 ):
     """Scan a whole sequence of scheduling-cycle windows on-device (the hot
     benchmark loop: no host round-trips between cycles). window_idxs: (Wn,)
@@ -1733,6 +1986,8 @@ def _run_windows_impl(
             use_pallas_select,
             use_megakernel=use_megakernel,
             hpa_seg=hpa_seg,
+            fault_params=fault_params,
+            name_ranks=name_ranks,
         )
         return new, (gauge_snapshot(new) if collect_gauges else None)
 

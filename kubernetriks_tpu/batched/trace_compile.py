@@ -21,6 +21,8 @@ from kubernetriks_tpu.batched.state import (
     DEFAULT_RAM_UNIT,
     EV_CREATE_NODE,
     EV_CREATE_POD,
+    EV_NODE_CRASH,
+    EV_NODE_RECOVER,
     EV_REMOVE_NODE,
     EV_REMOVE_POD,
 )
@@ -87,6 +89,9 @@ class CompiledClusterTrace:
     node_names: List[str] = field(default_factory=list)
     pod_names: List[str] = field(default_factory=list)
     pod_groups: List[CompiledPodGroup] = field(default_factory=list)
+    # (N,) sampled repair span of each slot's chaos-engine crash event
+    # (0 where the slot never crashes); None when no faults were injected.
+    node_crash_downtime: Optional[np.ndarray] = None
 
     @property
     def n_events(self) -> int:
@@ -174,9 +179,12 @@ def compile_cluster_trace(
     pod_names: List[str] = []
     pod_slot: Dict[str, int] = {}
     pod_groups: List[CompiledPodGroup] = []
+    node_crash_downtime: Dict[int, float] = {}
 
     for ts, _, event in merged:
         if isinstance(event, CreateNodeRequest):
+            # Chaos recoveries are fresh-slot creations (slots are never
+            # reused); only the event kind differs, for fault accounting.
             node = event.node
             slot = len(node_cap_cpu)
             node_cap_cpu.append(int(node.status.capacity.cpu))
@@ -184,12 +192,16 @@ def compile_cluster_trace(
             node_names.append(node.metadata.name)
             live_node_slot[node.metadata.name] = slot
             ev_time.append(ts)
-            ev_kind.append(EV_CREATE_NODE)
+            ev_kind.append(EV_NODE_RECOVER if event.recovered else EV_CREATE_NODE)
             ev_slot.append(slot)
         elif isinstance(event, RemoveNodeRequest):
             slot = live_node_slot.pop(event.node_name)
             ev_time.append(ts)
-            ev_kind.append(EV_REMOVE_NODE)
+            if event.crashed:
+                ev_kind.append(EV_NODE_CRASH)
+                node_crash_downtime[slot] = float(event.downtime_s)
+            else:
+                ev_kind.append(EV_REMOVE_NODE)
             ev_slot.append(slot)
         elif isinstance(event, CreatePodRequest):
             pod = event.pod
@@ -267,6 +279,12 @@ def compile_cluster_trace(
                 f"batched path does not support trace event {type(event).__name__}"
             )
 
+    crash_downtime_arr = None
+    if node_crash_downtime:
+        crash_downtime_arr = np.zeros(len(node_cap_cpu), np.float32)
+        for slot, ttr in node_crash_downtime.items():
+            crash_downtime_arr[slot] = ttr
+
     return CompiledClusterTrace(
         ev_time=np.asarray(ev_time, np.float64),
         ev_kind=np.asarray(ev_kind, np.int32),
@@ -279,6 +297,7 @@ def compile_cluster_trace(
         node_names=node_names,
         pod_names=pod_names,
         pod_groups=pod_groups,
+        node_crash_downtime=crash_downtime_arr,
     )
 
 
@@ -358,6 +377,7 @@ def segment_pod_slots(
                 node_names=c.node_names,
                 pod_names=names,
                 pod_groups=groups,
+                node_crash_downtime=c.node_crash_downtime,
             )
         )
     return out, T
@@ -386,6 +406,7 @@ def pad_and_batch(
     pod_req_cpu = np.zeros((C, P), np.int32)
     pod_req_ram = np.zeros((C, P), np.int32)
     pod_duration = np.full((C, P), -1.0, np.float64)
+    node_crash_downtime = np.zeros((C, N), np.float32)
 
     for i, c in enumerate(compiled):
         ev_time[i, : c.n_events] = c.ev_time
@@ -396,6 +417,8 @@ def pad_and_batch(
         pod_req_cpu[i, : c.n_pods] = c.pod_req_cpu
         pod_req_ram[i, : c.n_pods] = c.pod_req_ram
         pod_duration[i, : c.n_pods] = c.pod_duration
+        if c.node_crash_downtime is not None:
+            node_crash_downtime[i, : c.n_nodes] = c.node_crash_downtime
 
     return (
         ev_time,
@@ -406,6 +429,7 @@ def pad_and_batch(
         pod_req_cpu,
         pod_req_ram,
         pod_duration,
+        node_crash_downtime,
     )
 
 

@@ -1,28 +1,127 @@
 """Shared orbax checkpoint helpers (SURVEY §5.4: checkpointing is absent in
 the reference — a run is seed+config+trace — but every stateful object here
-is a pytree of arrays, so persistence is one save/restore pair)."""
+is a pytree of arrays, so persistence is one save/restore pair).
+
+Hardened (chaos-era): saves are ATOMIC — the checkpoint is written to a
+temporary sibling directory and renamed into place, so a crash mid-save can
+never leave a torn checkpoint at the target path — and restores validate the
+saved tree against the caller's template first, raising a ValueError that
+names every mismatching leaf instead of surfacing an orbax stack trace.
+"""
 
 from __future__ import annotations
 
+import json
 import os
+import shutil
 
 import jax
+import numpy as np
+
+# Structure manifest sidecar (next to the checkpoint directory, not inside
+# it — orbax owns the directory's contents); restore validates against it
+# before touching orbax.
+def _manifest_path(path: str) -> str:
+    return path + ".structure.json"
+
+
+def _manifest_entries(payload) -> dict:
+    """keystr -> [shape, dtype] for every array leaf of the payload."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(payload)
+    return {
+        jax.tree_util.keystr(path): [
+            list(np.shape(leaf)),
+            str(np.asarray(leaf).dtype if not hasattr(leaf, "dtype") else leaf.dtype),
+        ]
+        for path, leaf in flat
+    }
 
 
 def ckpt_save(path: str, payload) -> None:
-    """Save a pytree of arrays to an orbax checkpoint directory (overwrites)."""
+    """Save a pytree of arrays to an orbax checkpoint directory
+    (overwrites). Atomic: writes to a temp dir on the same filesystem, then
+    renames over the target — no torn checkpoints on crash."""
     import orbax.checkpoint as ocp
 
+    path = os.path.abspath(path)
+    # FIXED suffixes (not pid-tagged): a crash mid-swap must leave the aside
+    # at a path a LATER process can find (ckpt_restore falls back to it),
+    # and stale temp/aside dirs from crashed runs get cleaned on the next
+    # save instead of accumulating.
+    tmp = f"{path}.tmp"
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
     ckptr = ocp.StandardCheckpointer()
-    ckptr.save(os.path.abspath(path), payload, force=True)
+    ckptr.save(tmp, payload, force=True)
     ckptr.wait_until_finished()
+    manifest_tmp = _manifest_path(tmp)
+    with open(manifest_tmp, "w") as fh:
+        json.dump(_manifest_entries(payload), fh)
+    # Never destroy the only complete checkpoint: move the previous save
+    # ASIDE (rename, not delete), swing the new one into place, then clean
+    # up. A crash at any point leaves a complete checkpoint at `path` or at
+    # the .old aside — never a torn or missing one. (The manifest swap is
+    # a separate step; a crash between it and the dir swap can only cause a
+    # LOUD validation mismatch on restore, never silent acceptance.)
+    old = f"{path}.old"
+    if os.path.exists(old):
+        shutil.rmtree(old)
+    if os.path.exists(path):
+        os.rename(path, old)
+    os.rename(tmp, path)
+    os.replace(manifest_tmp, _manifest_path(path))
+    if os.path.exists(old):
+        shutil.rmtree(old)
 
 
 def ckpt_restore(path: str, template):
     """Restore a pytree saved by ckpt_save; `template` (a live pytree of the
-    same structure) provides the shapes/dtypes."""
+    same structure) provides the shapes/dtypes. Raises ValueError naming the
+    mismatching leaves when the checkpoint's structure/shapes/dtypes don't
+    match the template (instead of an orbax stack trace)."""
     import orbax.checkpoint as ocp
 
+    path = os.path.abspath(path)
+    manifest_path = _manifest_path(path)
+    if not os.path.isdir(path):
+        # A save that crashed between moving the previous checkpoint aside
+        # and swinging the new one into place leaves the only complete
+        # checkpoint at the .old aside — recover it. Its manifest is still
+        # the one at the MAIN manifest path (the manifest swap comes last).
+        aside = f"{path}.old"
+        if not os.path.isdir(aside):
+            raise ValueError(f"no checkpoint directory at {path!r}")
+        path = aside
+    if os.path.exists(manifest_path):
+        with open(manifest_path) as fh:
+            saved = json.load(fh)
+        expected = _manifest_entries(template)
+        problems = []
+        for key, spec in expected.items():
+            got = saved.get(key)
+            if got is None:
+                problems.append(f"missing in checkpoint: {key} {spec}")
+            elif got != spec:
+                problems.append(
+                    f"mismatch at {key}: checkpoint has shape={got[0]} "
+                    f"dtype={got[1]}, template expects shape={spec[0]} "
+                    f"dtype={spec[1]}"
+                )
+        for key in saved:
+            if key not in expected:
+                problems.append(f"unexpected leaf in checkpoint: {key}")
+        if problems:
+            raise ValueError(
+                f"checkpoint at {path!r} does not match the expected state "
+                "structure (was it saved from a different config/trace or "
+                "an older state layout?):\n  " + "\n  ".join(problems)
+            )
     ckptr = ocp.StandardCheckpointer()
     abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
-    return ckptr.restore(os.path.abspath(path), abstract)
+    try:
+        return ckptr.restore(path, abstract)
+    except Exception as exc:  # orbax raises various internal types
+        raise ValueError(
+            f"failed to restore checkpoint at {path!r}: structure/shape/"
+            f"dtype mismatch against the live template ({exc})"
+        ) from exc

@@ -140,6 +140,18 @@ def build_batched_simulation(
     trace_config = config.trace_config
     alibaba = trace_config.alibaba_cluster_trace_v2017 if trace_config else None
     if alibaba is not None and feeder.native_available():
+        from kubernetriks_tpu.chaos import has_node_faults
+
+        if has_node_faults(config.fault_injection):
+            # Node crash/recover events are injected at trace compile time
+            # (chaos.inject_node_faults); the native array fast path skips
+            # that stage. Pod-level faults (engine-side draws) still work.
+            raise ValueError(
+                "node-level fault injection is not supported on the "
+                "alibaba native-feeder path — use the generic trace path "
+                "or set fault_injection.node.mttf to 0 (pod-level faults "
+                "are unaffected)"
+            )
         workload_arrays = feeder.load_workload_arrays(
             alibaba.batch_instance_trace_path, alibaba.batch_task_trace_path
         )

@@ -128,6 +128,122 @@ class HorizontalPodAutoscalerConfig:
         )
 
 
+_FAULT_DISTRIBUTIONS = ("exponential", "fixed")
+
+
+def _checked_distribution(value: Any) -> str:
+    dist = str(value)
+    if dist not in _FAULT_DISTRIBUTIONS:
+        raise ValueError(
+            f"fault_injection distribution must be one of "
+            f"{_FAULT_DISTRIBUTIONS}, got {dist!r}"
+        )
+    return dist
+
+
+@dataclass
+class NodeFaultConfig:
+    """Per-node crash/recovery process. mttf <= 0 disables the channel.
+    distribution: "exponential" (default) or "fixed" (deterministic spans).
+    Draws are clamped below at one scheduling interval (chaos.py)."""
+
+    mttf: float = 0.0  # mean time to failure, seconds
+    mttr: float = 60.0  # mean time to recovery, seconds
+    distribution: str = "exponential"
+
+    @staticmethod
+    def from_dict(d: Optional[Dict[str, Any]]) -> "NodeFaultConfig":
+        if not d:
+            return NodeFaultConfig()
+        return NodeFaultConfig(
+            mttf=float(d.get("mttf", 0.0)),
+            mttr=float(d.get("mttr", 60.0)),
+            distribution=_checked_distribution(
+                d.get("distribution", "exponential")
+            ),
+        )
+
+
+@dataclass
+class PodFaultConfig:
+    """Pod-level failure with CrashLoopBackOff retry. fail_prob <= 0
+    disables the channel. A failed attempt re-enters the scheduling queue
+    after min(backoff_base * 2^k, backoff_cap) seconds (k = restarts so
+    far); a pod whose restart count exceeds restart_limit is marked
+    permanently failed."""
+
+    fail_prob: float = 0.0
+    backoff_base: float = 10.0
+    backoff_cap: float = 300.0
+    restart_limit: int = 5
+
+    @staticmethod
+    def from_dict(d: Optional[Dict[str, Any]]) -> "PodFaultConfig":
+        if not d:
+            return PodFaultConfig()
+        return PodFaultConfig(
+            fail_prob=float(d.get("fail_prob", 0.0)),
+            backoff_base=float(d.get("backoff_base", 10.0)),
+            backoff_cap=float(d.get("backoff_cap", 300.0)),
+            restart_limit=int(d.get("restart_limit", 5)),
+        )
+
+
+@dataclass
+class FailureGroupConfig:
+    """Correlated blast-radius set: one shared crash process takes every
+    member down (and back up) together."""
+
+    members: List[str] = field(default_factory=list)
+    mttf: float = 0.0
+    mttr: float = 60.0
+    distribution: str = "exponential"
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "FailureGroupConfig":
+        return FailureGroupConfig(
+            members=[str(m) for m in d.get("members") or []],
+            mttf=float(d.get("mttf", 0.0)),
+            mttr=float(d.get("mttr", 60.0)),
+            distribution=_checked_distribution(
+                d.get("distribution", "exponential")
+            ),
+        )
+
+
+@dataclass
+class FaultInjectionConfig:
+    """Chaos engine (kubernetriks_tpu/chaos.py): stochastic node
+    crash/recovery and pod CrashLoopBackOff, bit-identical across the
+    scalar and batched paths via a counter-based PRNG on
+    (seed, cluster, object, incarnation)."""
+
+    enabled: bool = False
+    seed: Optional[int] = None  # defaults to the simulation seed
+    horizon: Optional[float] = None  # defaults to the last trace timestamp
+    node: NodeFaultConfig = field(default_factory=NodeFaultConfig)
+    pod: PodFaultConfig = field(default_factory=PodFaultConfig)
+    failure_groups: List[FailureGroupConfig] = field(default_factory=list)
+
+    @staticmethod
+    def from_dict(d: Optional[Dict[str, Any]]) -> "FaultInjectionConfig":
+        if not d:
+            return FaultInjectionConfig()
+        return FaultInjectionConfig(
+            enabled=bool(d.get("enabled", False)),
+            seed=(int(d["seed"]) if d.get("seed") is not None else None),
+            horizon=(
+                float(d["horizon"]) if d.get("horizon") is not None else None
+            ),
+            node=NodeFaultConfig.from_dict(d.get("node")),
+            pod=PodFaultConfig.from_dict(d.get("pod")),
+            failure_groups=[
+                FailureGroupConfig.from_dict(g)
+                for g in d.get("failure_groups") or []
+            ],
+        )
+
+
 @dataclass
 class MetricsPrinterConfig:
     format: str = "JSON"  # "JSON" | "PrettyTable"
@@ -213,6 +329,9 @@ class SimulationConfig:
     horizontal_pod_autoscaler: HorizontalPodAutoscalerConfig = field(
         default_factory=HorizontalPodAutoscalerConfig
     )
+    fault_injection: FaultInjectionConfig = field(
+        default_factory=FaultInjectionConfig
+    )
     metrics_printer: Optional[MetricsPrinterConfig] = None
     default_cluster: Optional[List[NodeGroup]] = None
     scheduling_cycle_interval: float = 10.0
@@ -240,6 +359,9 @@ class SimulationConfig:
             ),
             horizontal_pod_autoscaler=HorizontalPodAutoscalerConfig.from_dict(
                 d.get("horizontal_pod_autoscaler")
+            ),
+            fault_injection=FaultInjectionConfig.from_dict(
+                d.get("fault_injection")
             ),
             metrics_printer=MetricsPrinterConfig.from_dict(d.get("metrics_printer")),
             default_cluster=(

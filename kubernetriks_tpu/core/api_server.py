@@ -68,6 +68,12 @@ class KubeApiServer(EventHandler):
         self.pending_pod_removal_requests: Set[str] = set()
         self.created_nodes: Dict[str, NodeComponent] = {}
         self.metrics_collector = metrics_collector
+        # Chaos engine (chaos.py): crash/recovery identity threaded across
+        # the storage round-trips (name -> sampled downtime), and the pod
+        # fault oracle installed by the simulator when fault injection is on.
+        self.crashed_nodes_in_flight: Dict[str, float] = {}
+        self.recovered_nodes_pending: Set[str] = set()
+        self.fault_oracle = None
 
     # --- direct API (used by the simulator and tests) -----------------------
 
@@ -97,8 +103,12 @@ class KubeApiServer(EventHandler):
         node = self.pending_node_creation_requests.pop(node_name)
         component = self.node_pool.allocate_component(node, self.ctx.id, self.config)
         self.add_node_component(component)
+        recovered = node_name in self.recovered_nodes_pending
+        self.recovered_nodes_pending.discard(node_name)
         self.ctx.emit(
-            NodeAddedToCluster(add_time=add_time, node_name=node_name),
+            NodeAddedToCluster(
+                add_time=add_time, node_name=node_name, recovered=recovered
+            ),
             self.persistent_storage,
             self.config.as_to_ps_network_delay,
         )
@@ -113,6 +123,8 @@ class KubeApiServer(EventHandler):
         node = data.node
         node.status.allocatable = node.status.capacity.copy()
         self.metrics_collector.gauge_metrics.current_nodes += 1
+        if data.recovered:
+            self.recovered_nodes_pending.add(node.metadata.name)
         self.pending_node_creation_requests[node.metadata.name] = node
         self.ctx.emit(
             CreateNodeRequest(node=node.copy()),
@@ -156,6 +168,7 @@ class KubeApiServer(EventHandler):
                 node_name=data.node_name,
                 pod_duration=data.pod_duration,
                 resources_usage_model_config=data.resources_usage_model_config,
+                fail_after=data.fail_after,
             ),
             node_component.id,
             self.config.as_to_node_network_delay,
@@ -168,20 +181,42 @@ class KubeApiServer(EventHandler):
         self.ctx.emit(data, self.persistent_storage, self.config.as_to_ps_network_delay)
 
     def on_pod_finished_running(self, data: PodFinishedRunning, time: float) -> None:
+        from kubernetriks_tpu.core.types import PodConditionType
+
         metrics = self.metrics_collector
-        metrics.accumulated_metrics.internal.terminated_pods += 1
-        metrics.accumulated_metrics.pods_succeeded += 1
-        metrics.gauge_metrics.current_pods -= 1
+        if data.finish_result == PodConditionType.POD_FAILED:
+            # Chaos-engine pod failure (chaos.py): record the restart; a pod
+            # within its restart limit re-enters the scheduling queue after
+            # backoff (downstream: storage keeps it, the scheduler requeues),
+            # one past the limit terminates as permanently failed.
+            new_restarts = self.fault_oracle.record_failure(data.pod_name)
+            if new_restarts <= self.fault_oracle.restart_limit:
+                metrics.accumulated_metrics.pod_restarts += 1
+            else:
+                metrics.accumulated_metrics.pods_failed += 1
+                metrics.accumulated_metrics.internal.terminated_pods += 1
+                metrics.gauge_metrics.current_pods -= 1
+        else:
+            metrics.accumulated_metrics.internal.terminated_pods += 1
+            metrics.accumulated_metrics.pods_succeeded += 1
+            metrics.gauge_metrics.current_pods -= 1
         self.ctx.emit(data, self.persistent_storage, self.config.as_to_ps_network_delay)
 
     def on_remove_node_request(self, data: RemoveNodeRequest, time: float) -> None:
         self.pending_node_removal_requests.add(data.node_name)
+        if data.crashed:
+            self.crashed_nodes_in_flight[data.node_name] = data.downtime_s
         self.ctx.emit(data, self.persistent_storage, self.config.as_to_ps_network_delay)
 
     def on_remove_node_response(self, data: RemoveNodeResponse, time: float) -> None:
         node_component = self.created_nodes[data.node_name]
+        downtime = self.crashed_nodes_in_flight.pop(data.node_name, None)
         self.ctx.emit(
-            RemoveNodeRequest(node_name=data.node_name),
+            RemoveNodeRequest(
+                node_name=data.node_name,
+                crashed=downtime is not None,
+                downtime_s=downtime or 0.0,
+            ),
             node_component.id,
             self.config.as_to_node_network_delay,
         )
@@ -190,6 +225,12 @@ class KubeApiServer(EventHandler):
         self, data: NodeRemovedFromCluster, time: float
     ) -> None:
         self.metrics_collector.gauge_metrics.current_nodes -= 1
+        if data.crashed:
+            # Crash accounting lands when the node component actually went
+            # down (the batched path folds it at the same effect time).
+            am = self.metrics_collector.accumulated_metrics
+            am.node_crashes += 1
+            am.node_downtime_s += data.downtime_s
         self._handle_node_removal(data.node_name)
         self.pending_node_removal_requests.discard(data.node_name)
         self.ctx.emit(data, self.persistent_storage, self.config.as_to_ps_network_delay)

@@ -25,9 +25,13 @@ from kubernetriks_tpu.core.types import (
 
 @dataclass
 class CreateNodeRequest:
-    """client/CA -> api server (reference: src/core/events.rs:22-25)."""
+    """client/CA -> api server (reference: src/core/events.rs:22-25).
+    recovered=True marks a chaos-engine recovery — the node returning after
+    a crash as fresh capacity (kubernetriks_tpu/chaos.py); it flows the
+    normal create chain and only adds fault accounting."""
 
     node: Node
+    recovered: bool = False
 
 
 @dataclass
@@ -43,14 +47,20 @@ class NodeAddedToCluster:
 
     add_time: float
     node_name: str
+    recovered: bool = False  # chaos-engine recovery (fault accounting only)
 
 
 @dataclass
 class RemoveNodeRequest:
     """client/CA -> api server; also api server -> node component
-    (reference: src/core/events.rs:45-48)."""
+    (reference: src/core/events.rs:45-48). crashed=True marks a
+    chaos-engine node crash (kubernetriks_tpu/chaos.py): it rides this
+    exact removal chain — same interruption/reschedule semantics — and
+    carries its pre-sampled repair span for the downtime metric."""
 
     node_name: str
+    crashed: bool = False
+    downtime_s: float = 0.0
 
 
 @dataclass
@@ -67,6 +77,8 @@ class NodeRemovedFromCluster:
 
     removal_time: float
     node_name: str
+    crashed: bool = False
+    downtime_s: float = 0.0
 
 
 @dataclass
@@ -74,6 +86,7 @@ class RemoveNodeFromCache:
     """persistent storage -> scheduler (reference: src/core/events.rs:67-70)."""
 
     node_name: str
+    crashed: bool = False  # the scheduler counts crash-caused reschedules
 
 
 @dataclass
@@ -145,7 +158,9 @@ class AssignPodToNodeRequest:
 
 @dataclass
 class AssignPodToNodeResponse:
-    """persistent storage -> api server (reference: src/core/events.rs:138-147)."""
+    """persistent storage -> api server (reference: src/core/events.rs:138-147).
+    fail_after: chaos-engine pod-failure draw for THIS attempt (seconds
+    after start at which the attempt fails); None = runs to completion."""
 
     pod_name: str
     pod_requests: RuntimeResources
@@ -154,6 +169,7 @@ class AssignPodToNodeResponse:
     node_name: str
     pod_duration: Optional[float]
     resources_usage_model_config: Optional[RuntimeResourcesUsageModelConfig]
+    fail_after: Optional[float] = None
 
 
 @dataclass
@@ -176,6 +192,7 @@ class BindPodToNodeRequest:
     node_name: str
     pod_duration: Optional[float]
     resources_usage_model_config: Optional[RuntimeResourcesUsageModelConfig]
+    fail_after: Optional[float] = None  # chaos: attempt fails this long after start
 
 
 @dataclass
@@ -206,6 +223,17 @@ class PodFinishedRunning:
     node_name: str
     finish_time: float
     finish_result: PodConditionType
+
+
+@dataclass
+class RequeuePodAfterBackoff:
+    """scheduler -> itself (chaos engine): deliver a CrashLoopBackOff'd pod
+    into the active queue at its backoff-expiry time. The active queue is
+    drained whole by each cycle (timestamps are priority, not eligibility),
+    so a future-timestamped entry must not be pushed early."""
+
+    pod_name: str
+    requeue_ts: float
 
 
 # --- pod groups / HPA -------------------------------------------------------

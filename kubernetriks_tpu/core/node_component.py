@@ -109,13 +109,27 @@ class NodeComponent(EventHandler):
         pod_group_creation_time: Optional[str],
         pod_duration: Optional[float],
         usage_config: Optional[RuntimeResourcesUsageModelConfig],
+        fail_after: Optional[float] = None,
     ) -> None:
         """reference: src/core/node_component.rs:114-176. A finite-duration pod
         schedules its own finish at +duration (+ as_to_node delay so the event
         leaves for the api server at the right simulated time); long-running
-        services (duration None) never self-finish."""
+        services (duration None) never self-finish. A chaos-engine failing
+        attempt (fail_after set) self-finishes EARLY with POD_FAILED — same
+        cancellable self-event, so node removal interrupts it identically."""
         event_id: Optional[int] = None
-        if pod_duration is not None:
+        if fail_after is not None:
+            delay = fail_after + self.runtime.config.as_to_node_network_delay
+            event_id = self.ctx.emit_self(
+                PodFinishedRunning(
+                    pod_name=pod_name,
+                    node_name=self.runtime.node.metadata.name,
+                    finish_time=event_time + fail_after,
+                    finish_result=PodConditionType.POD_FAILED,
+                ),
+                delay,
+            )
+        elif pod_duration is not None:
             delay = pod_duration + self.runtime.config.as_to_node_network_delay
             event_id = self.ctx.emit_self(
                 PodFinishedRunning(
@@ -168,6 +182,7 @@ class NodeComponent(EventHandler):
             data.pod_group_creation_time,
             data.pod_duration,
             data.resources_usage_model_config,
+            fail_after=data.fail_after,
         )
         self.ctx.emit(
             PodStartedRunning(pod_name=data.pod_name, start_time=time),
@@ -187,7 +202,12 @@ class NodeComponent(EventHandler):
         )
         self.cancel_all_running_pods()
         self.ctx.emit(
-            NodeRemovedFromCluster(removal_time=time, node_name=data.node_name),
+            NodeRemovedFromCluster(
+                removal_time=time,
+                node_name=data.node_name,
+                crashed=data.crashed,
+                downtime_s=data.downtime_s,
+            ),
             self.runtime.api_server,
             self.runtime.config.as_to_node_network_delay,
         )

@@ -65,6 +65,10 @@ class PersistentStorage(EventHandler):
         # node name -> set of pod names assigned to it
         self.assignments: Dict[str, Set[str]] = {}
         self.succeeded_pods: Dict[str, Pod] = {}
+        # Chaos engine: permanently-failed archive (restart limit exceeded)
+        # and the pod fault oracle installed by the simulator.
+        self.failed_pods: Dict[str, Pod] = {}
+        self.fault_oracle = None
         self.unscheduled_pods_cache: Set[str] = set()
         self.ctx = ctx
         self.config = config
@@ -168,6 +172,8 @@ class PersistentStorage(EventHandler):
             self.config.ps_to_sched_network_delay,
         )
         self.metrics_collector.accumulated_metrics.internal.processed_nodes += 1
+        if data.recovered:
+            self.metrics_collector.accumulated_metrics.node_recoveries += 1
 
     def on_create_pod_request(self, data: CreatePodRequest, time: float) -> None:
         """Creation time is the time the pod lands in storage; pods without a
@@ -204,6 +210,15 @@ class PersistentStorage(EventHandler):
         node.status.allocatable.ram -= pod.spec.resources.requests.ram
         self.assignments[data.node_name].add(data.pod_name)
 
+        # Chaos engine: the attempt's failure draw happens at assignment
+        # commit — the same point the batched path draws on device. The draw
+        # is a pure counter-PRNG function of (cluster, slot, restarts), so a
+        # later-dropped bind desyncs nothing.
+        fail_after = (
+            self.fault_oracle.attempt(data.pod_name, pod.spec.running_duration)
+            if self.fault_oracle is not None
+            else None
+        )
         self.ctx.emit(
             AssignPodToNodeResponse(
                 pod_name=data.pod_name,
@@ -215,6 +230,7 @@ class PersistentStorage(EventHandler):
                 node_name=data.node_name,
                 pod_duration=pod.spec.running_duration,
                 resources_usage_model_config=pod.spec.resources.usage_model_config,
+                fail_after=fail_after,
             ),
             self.api_server,
             self.config.as_to_ps_network_delay,
@@ -234,15 +250,31 @@ class PersistentStorage(EventHandler):
     def on_pod_finished_running(self, data: PodFinishedRunning, time: float) -> None:
         """A remove request may have raced ahead and dropped the pod from
         storage; the notification to the scheduler goes out regardless
-        (reference: src/core/persistent_storage.rs:316-351)."""
+        (reference: src/core/persistent_storage.rs:316-351).
+
+        Chaos-engine failures (finish_result == POD_FAILED): a pod within
+        its restart limit stays IN storage — its node resources/assignment
+        are released and the scheduler will requeue it after backoff — while
+        a permanently-failed pod archives like a finish, minus the duration
+        stats (only successful completions count)."""
         if data.pod_name in self.storage_data.pods:
-            pod = self.storage_data.pods.pop(data.pod_name)
-            pod.update_condition("True", data.finish_result, data.finish_time)
-            self._clean_up_pod_info(pod)
-            self.metrics_collector.accumulated_metrics.increment_pod_duration(
-                pod.spec.running_duration
-            )
-            self.succeeded_pods[data.pod_name] = pod
+            pod = self.storage_data.pods[data.pod_name]
+            if data.finish_result == PodConditionType.POD_FAILED:
+                pod.update_condition("True", data.finish_result, data.finish_time)
+                self._clean_up_pod_info(pod)
+                if self.fault_oracle.is_permanently_failed(data.pod_name):
+                    del self.storage_data.pods[data.pod_name]
+                    self.failed_pods[data.pod_name] = pod
+                else:
+                    pod.status.assigned_node = ""
+            else:
+                del self.storage_data.pods[data.pod_name]
+                pod.update_condition("True", data.finish_result, data.finish_time)
+                self._clean_up_pod_info(pod)
+                self.metrics_collector.accumulated_metrics.increment_pod_duration(
+                    pod.spec.running_duration
+                )
+                self.succeeded_pods[data.pod_name] = pod
         self.ctx.emit(data, self.scheduler, self.config.ps_to_sched_network_delay)
 
     def on_remove_node_request(self, data: RemoveNodeRequest, time: float) -> None:
@@ -258,7 +290,7 @@ class PersistentStorage(EventHandler):
         self, data: NodeRemovedFromCluster, time: float
     ) -> None:
         self.ctx.emit(
-            RemoveNodeFromCache(node_name=data.node_name),
+            RemoveNodeFromCache(node_name=data.node_name, crashed=data.crashed),
             self.scheduler,
             self.config.ps_to_sched_network_delay,
         )

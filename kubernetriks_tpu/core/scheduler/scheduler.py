@@ -19,6 +19,7 @@ from kubernetriks_tpu.core.events import (
     PodScheduleRequest,
     RemoveNodeFromCache,
     RemovePodFromCache,
+    RequeuePodAfterBackoff,
     RunSchedulingCycle,
 )
 from kubernetriks_tpu.core.scheduler.interface import (
@@ -67,6 +68,9 @@ class Scheduler(EventHandler):
         self.ctx = ctx
         self.config = config
         self.metrics_collector = metrics_collector
+        # Chaos engine: pod fault oracle (backoff/limit reads); installed by
+        # the simulator when fault injection is on.
+        self.fault_oracle = None
 
     def start(self) -> None:
         """Arm both self-tick cycles (reference: src/core/scheduler/scheduler.rs:78-81)."""
@@ -236,13 +240,16 @@ class Scheduler(EventHandler):
             )
         )
 
-    def reschedule_unfinished_pods(self, node_name: str, event_time: float) -> None:
+    def reschedule_unfinished_pods(self, node_name: str, event_time: float) -> int:
         """All pods of a dead node go back to the active queue in sorted-name
-        order (reference: src/core/scheduler/scheduler.rs:336-364)."""
+        order (reference: src/core/scheduler/scheduler.rs:336-364). Returns
+        the reschedule count (the chaos engine's interruption metric)."""
         unfinished = self.assignments.pop(node_name, None)
-        if unfinished:
-            for pod_name in sorted(unfinished):
-                self.reschedule_pod(pod_name, event_time)
+        if not unfinished:
+            return 0
+        for pod_name in sorted(unfinished):
+            self.reschedule_pod(pod_name, event_time)
+        return len(unfinished)
 
     def _move_to_active_due_to_pod_freed_resources(
         self, freed: RuntimeResources
@@ -302,6 +309,11 @@ class Scheduler(EventHandler):
         )
 
     def on_pod_finished_running(self, data: PodFinishedRunning, time: float) -> None:
+        from kubernetriks_tpu.core.types import PodConditionType
+
+        if data.finish_result == PodConditionType.POD_FAILED:
+            self._on_pod_failed(data, time)
+            return
         pod = self.objects_cache.pods.pop(data.pod_name)
         self.assignments[data.node_name].discard(data.pod_name)
         self.release_node_resources(pod)
@@ -312,9 +324,69 @@ class Scheduler(EventHandler):
         else:
             self.move_all_to_active_queue()
 
+    def _on_pod_failed(self, data: PodFinishedRunning, time: float) -> None:
+        """Chaos-engine attempt failure: free the node's resources, then
+        either requeue with CrashLoopBackOff (new active-queue entry at
+        fail_time + min(base * 2^k, cap), fresh initial-attempt timestamp —
+        mirroring the batched retry disposition) or drop the pod as
+        permanently failed. Both outcomes wake the unschedulable queue like
+        a finish — resources were freed either way."""
+        pod = self.objects_cache.pods.get(data.pod_name)
+        if pod is None:
+            return  # removed while the failure was in flight
+        self.assignments.get(data.node_name, set()).discard(data.pod_name)
+        if data.node_name in self.objects_cache.nodes:
+            self.release_node_resources(pod)
+        if self.fault_oracle.is_permanently_failed(data.pod_name):
+            self.objects_cache.pods.pop(data.pod_name)
+        else:
+            pod.status.assigned_node = ""
+            requeue_ts = data.finish_time + self.fault_oracle.backoff_after_failure(
+                data.pod_name
+            )
+            # Deliver at backoff expiry: each cycle drains the whole active
+            # queue, so pushing a future-timestamped entry now would defeat
+            # the backoff (the batched path gates on queue_ts < cycle time).
+            self.ctx.emit_self(
+                RequeuePodAfterBackoff(
+                    pod_name=data.pod_name, requeue_ts=requeue_ts
+                ),
+                max(requeue_ts - time, 0.0),
+            )
+        if self.config.enable_unscheduled_pods_conditional_move:
+            self._move_to_active_due_to_pod_freed_resources(
+                pod.spec.resources.requests.copy()
+            )
+        else:
+            self.move_all_to_active_queue()
+
+    def on_requeue_pod_after_backoff(
+        self, data: RequeuePodAfterBackoff, time: float
+    ) -> None:
+        """CrashLoopBackOff expiry: the retry enters the active queue with a
+        fresh initial-attempt timestamp. Queue entry is stamped with the
+        DELIVERY time — max(requeue_ts, failure-chain arrival) — which is
+        the batched retry disposition's initial_attempt_ts = fail +
+        max(backoff, delta_reschedule); a backoff shorter than the chain
+        delay cannot beat the failure notification to the queue."""
+        if data.pod_name not in self.objects_cache.pods:
+            return  # removed while backing off
+        self.action_queue.push(
+            QueuedPodInfo(
+                timestamp=time,
+                attempts=1,
+                initial_attempt_timestamp=time,
+                pod_name=data.pod_name,
+            )
+        )
+
     def on_remove_node_from_cache(self, data: RemoveNodeFromCache, time: float) -> None:
         del self.objects_cache.nodes[data.node_name]
-        self.reschedule_unfinished_pods(data.node_name, time)
+        n_rescheduled = self.reschedule_unfinished_pods(data.node_name, time)
+        if data.crashed:
+            self.metrics_collector.accumulated_metrics.pod_interruptions += (
+                n_rescheduled
+            )
 
     def on_remove_pod_from_cache(self, data: RemovePodFromCache, time: float) -> None:
         """Tolerant of finish-before-remove races

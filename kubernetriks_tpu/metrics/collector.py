@@ -110,6 +110,14 @@ class AccumulatedMetrics:
     total_scaled_down_nodes: int = 0
     total_scaled_up_pods: int = 0
     total_scaled_down_pods: int = 0
+    # Chaos-engine fault accounting (kubernetriks_tpu/chaos.py). pods_failed
+    # above counts PERMANENTLY failed pods (restart limit exceeded);
+    # pod_restarts counts CrashLoopBackOff requeues.
+    node_crashes: int = 0
+    node_recoveries: int = 0
+    node_downtime_s: float = 0.0  # sum of sampled repair spans of applied crashes
+    pod_interruptions: int = 0  # pods rescheduled because their node crashed
+    pod_restarts: int = 0
     internal: InternalMetrics = field(default_factory=InternalMetrics)
     # pod group name -> (cpu estimator, ram estimator)
     pod_utilization_metrics: Dict[str, Tuple[Estimator, Estimator]] = field(

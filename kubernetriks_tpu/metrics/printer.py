@@ -27,6 +27,12 @@ def metrics_as_dict(collector: MetricsCollector) -> Dict[str, Any]:
             "total_scaled_down_nodes": metrics.total_scaled_down_nodes,
             "total_scaled_up_pods": metrics.total_scaled_up_pods,
             "total_scaled_down_pods": metrics.total_scaled_down_pods,
+            # Chaos-engine fault counters (zero when fault injection is off).
+            "node_crashes": metrics.node_crashes,
+            "node_recoveries": metrics.node_recoveries,
+            "node_downtime_s": metrics.node_downtime_s,
+            "pod_interruptions": metrics.pod_interruptions,
+            "pod_restarts": metrics.pod_restarts,
         },
         "timings": {
             "pod_duration": metrics.pod_duration_stats.as_dict(),
@@ -64,6 +70,11 @@ def metrics_as_pretty_table(collector: MetricsCollector) -> str:
         ["Total scaled down nodes", d["counters"]["total_scaled_down_nodes"]],
         ["Total scaled up pods", d["counters"]["total_scaled_up_pods"]],
         ["Total scaled down pods", d["counters"]["total_scaled_down_pods"]],
+        ["Node crashes", d["counters"]["node_crashes"]],
+        ["Node recoveries", d["counters"]["node_recoveries"]],
+        ["Node downtime (s)", d["counters"]["node_downtime_s"]],
+        ["Pod interruptions", d["counters"]["pod_interruptions"]],
+        ["Pod restarts", d["counters"]["pod_restarts"]],
     ]
     timing_rows = [
         [name, *(stats[k] for k in ("min", "max", "mean", "variance"))]

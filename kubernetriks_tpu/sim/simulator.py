@@ -136,6 +136,39 @@ class KubernetriksSimulation:
         assert self.sim.time() == 0.0
 
         cluster_trace_events = cluster_trace.convert_to_simulator_events()
+        workload_trace_events = workload_trace.convert_to_simulator_events()
+
+        fault_cfg = self.config.fault_injection
+        if fault_cfg is not None and fault_cfg.enabled:
+            # Chaos engine (kubernetriks_tpu/chaos.py): node crash/recovery
+            # chains are sampled host-side from the counter-based PRNG and
+            # injected as concrete events (the batched compiler does the
+            # same with cluster index c per cluster; the scalar sim is
+            # cluster 0), and the pod fault oracle is installed into the
+            # control-plane components for CrashLoopBackOff draws.
+            from kubernetriks_tpu import chaos
+
+            fault_seed = (
+                fault_cfg.seed if fault_cfg.seed is not None else self.config.seed
+            )
+            horizon = chaos.fault_horizon(
+                fault_cfg, cluster_trace_events, workload_trace_events
+            )
+            cluster_trace_events = chaos.inject_node_faults(
+                cluster_trace_events,
+                fault_cfg,
+                fault_seed,
+                0,
+                horizon,
+                self.config.scheduling_cycle_interval,
+            )
+            oracle = chaos.PodFaultOracle(
+                fault_cfg, fault_seed, 0, workload_trace_events
+            )
+            self.api_server.fault_oracle = oracle
+            self.persistent_storage.fault_oracle = oracle
+            self.scheduler.fault_oracle = oracle
+
         trace_max_nodes = max_nodes_in_trace(cluster_trace_events)
         autoscaler_max_nodes = (
             self.cluster_autoscaler.max_nodes() if self.cluster_autoscaler else 0
@@ -153,10 +186,10 @@ class KubernetriksSimulation:
 
         api_server_id = self.api_server.ctx.id
         for ts, event in cluster_trace_events:
-            if isinstance(event, CreateNodeRequest):
+            if isinstance(event, CreateNodeRequest) and not event.recovered:
                 self.metrics_collector.accumulated_metrics.total_nodes_in_trace += 1
             client.emit(event, api_server_id, ts)
-        for ts, event in workload_trace.convert_to_simulator_events():
+        for ts, event in workload_trace_events:
             if isinstance(event, CreatePodRequest):
                 self.metrics_collector.accumulated_metrics.total_pods_in_trace += 1
             client.emit(event, api_server_id, ts)

@@ -32,3 +32,19 @@ def test_bench_smoke_emits_three_parseable_lines(capsys):
     # (the LAST line is the headline the driver reads).
     assert "composed" in records[1]["metric"]
     assert "north-star" in records[2]["metric"]
+
+
+def test_bench_smoke_faults_adds_chaos_line(capsys):
+    """--faults appends a fault-enabled composed smoke line (the chaos
+    engine's dispatch/throughput tracker) after the standard three."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import bench
+
+    bench.main(["--smoke", "--faults"])
+    lines = [
+        ln for ln in capsys.readouterr().out.strip().splitlines() if ln.strip()
+    ]
+    assert len(lines) == 4, lines
+    records = [json.loads(ln) for ln in lines]
+    assert "chaos" in records[3]["metric"]
+    assert records[3]["value"] > 0

@@ -72,7 +72,7 @@ def permute_same_ts(events, perm_seed: int):
     return out
 
 
-def run_scalar(cluster, workload):
+def run_scalar(cluster, workload, config=None):
     from kubernetriks_tpu.trace.interface import Trace
 
     class _ListTrace(Trace):
@@ -85,15 +85,18 @@ def run_scalar(cluster, workload):
         def event_count(self):
             return len(self._events)
 
-    sim = KubernetriksSimulation(default_test_simulation_config())
+    sim = KubernetriksSimulation(config or default_test_simulation_config())
     sim.initialize(_ListTrace(cluster), _ListTrace(workload))
     sim.step_until_time(END_TIME)
     return sim
 
 
-def run_batched(cluster, workload):
+def run_batched(cluster, workload, config=None):
     sim = build_batched_from_traces(
-        default_test_simulation_config(), cluster, workload, n_clusters=1
+        config or default_test_simulation_config(),
+        cluster,
+        workload,
+        n_clusters=1,
     )
     sim.step_until_time(END_TIME)
     return sim
@@ -133,6 +136,98 @@ def test_batched_matches_scalar_under_same_ts_permutations(perm_seed):
             pod = succeeded.get(name)
             assert pod is not None, (name, perm_seed)
             assert b["node"] == pod.status.assigned_node, (name, perm_seed)
+        elif b["phase"] == PHASE_UNSCHEDULABLE:
+            assert name in cache, (name, perm_seed)
+
+
+FAULT_SUFFIX = """
+fault_injection:
+  enabled: true
+  node:
+    mttf: 350.0
+    mttr: 60.0
+  pod:
+    fail_prob: 0.15
+    restart_limit: 2
+"""
+
+
+def fault_base_events(seed: int):
+    """base_events variant with DISTINCT node capacities: a chaos recovery
+    re-creates its node on a fresh (later) slot, so the batched score
+    argmax's last-in-slot-order tie-break can diverge from the scalar's
+    last-in-name-order walk when two nodes score EXACTLY equal — which
+    equal-capacity nodes do whenever both are empty (docs/PARITY.md).
+    Distinct capacities make exact score ties impossible, keeping the
+    permutation property about event ORDER, not float tie-breaks."""
+    rng = np.random.default_rng(seed)
+    caps = {"node_0": 16000, "node_1": 14000, "node_late": 18000}
+    cluster = [
+        (0.0, CreateNodeRequest(node=Node.new(n, caps[n], 32 * GiB)))
+        for n in ("node_0", "node_1")
+    ]
+    cluster.append(
+        (20.0, CreateNodeRequest(node=Node.new("node_late", caps["node_late"], 32 * GiB)))
+    )
+    workload = []
+    for i in range(36):
+        ts = float(rng.integers(0, 12)) * 5.0
+        cpu = int(rng.choice([2000, 6000, 12000]))
+        duration = float(rng.integers(4, 16)) * 5.0
+        workload.append(
+            (
+                ts,
+                CreatePodRequest(
+                    pod=Pod.new(f"pod_{i:03d}", cpu, cpu * 1024 * 1024, duration)
+                ),
+            )
+        )
+    return cluster, workload
+
+
+@pytest.mark.parametrize("perm_seed", [0, 1, 2])
+def test_fault_interleavings_match_scalar_under_permutations(perm_seed):
+    """Chaos extension of the permutation property: traces mixing pod
+    arrivals, a planned RemoveNode, AND injected crashes/recoveries still
+    reproduce the scalar oracle pod-for-pod under every same-timestamp
+    permutation — including identical fault metrics. (Permuting same-ts
+    CreateNode events permutes the fault compiler's node uids, so the crash
+    schedules themselves vary across permutations; both paths derive them
+    from the same permuted trace.)"""
+    from kubernetriks_tpu.batched.state import PHASE_FAILED
+    from kubernetriks_tpu.core.events import RemoveNodeRequest
+
+    config = default_test_simulation_config(FAULT_SUFFIX)
+    cluster, workload = fault_base_events(seed=7)
+    # A planned removal rides alongside the injected crashes.
+    cluster.append((400.0, RemoveNodeRequest(node_name="node_1")))
+    cluster_p = permute_same_ts(cluster, perm_seed)
+    workload_p = permute_same_ts(workload, perm_seed)
+
+    scalar = run_scalar(list(cluster_p), list(workload_p), config)
+    batched = run_batched(list(cluster_p), list(workload_p), config)
+
+    sm = scalar.metrics_collector.accumulated_metrics
+    c = batched.metrics_summary()["counters"]
+    assert c["pods_succeeded"] == sm.pods_succeeded, perm_seed
+    assert c["node_crashes"] == sm.node_crashes, perm_seed
+    assert c["node_recoveries"] == sm.node_recoveries, perm_seed
+    assert c["pod_interruptions"] == sm.pod_interruptions, perm_seed
+    assert c["pod_restarts"] == sm.pod_restarts, perm_seed
+    assert c["pods_failed"] == sm.pods_failed, perm_seed
+    assert sm.node_crashes > 0, "scenario must inject at least one crash"
+    assert sm.pod_restarts > 0, "scenario must exercise CrashLoopBackOff"
+
+    succeeded = scalar.persistent_storage.succeeded_pods
+    failed = scalar.persistent_storage.failed_pods
+    cache = scalar.persistent_storage.unscheduled_pods_cache
+    for name, b in batched.pod_view(0).items():
+        if b["phase"] == PHASE_SUCCEEDED:
+            pod = succeeded.get(name)
+            assert pod is not None, (name, perm_seed)
+            assert b["node"] == pod.status.assigned_node, (name, perm_seed)
+        elif b["phase"] == PHASE_FAILED:
+            assert name in failed, (name, perm_seed)
         elif b["phase"] == PHASE_UNSCHEDULABLE:
             assert name in cache, (name, perm_seed)
 
