@@ -62,7 +62,7 @@ total removals, keeping current = tail - head.
 from __future__ import annotations
 
 from functools import partial
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -144,6 +144,20 @@ class AutoscaleStatics(NamedTuple):
     pod_name_rank: jnp.ndarray  # (C, P) int32 lexicographic name rank; BIG = n/a
     node_name_rank: jnp.ndarray  # (C, N) int32 node-name rank (trace + CA slots)
     ca_sd_order: jnp.ndarray  # (C, S) CA slot indices in name order
+
+
+def statics_with_pod_rank(
+    statics: Optional[AutoscaleStatics], rank
+) -> Optional[AutoscaleStatics]:
+    """Rebind the windowed pod-name ranks into the statics. The superspan
+    executor (step.run_superspan) slides the pod window ON DEVICE, so the
+    ranks become loop-carried state rather than a per-dispatch constant;
+    every window chunk inside the loop reads its statics through this ONE
+    rebinding point (the statics argument's own pod_name_rank leaf is never
+    read there — it merely pins shape/sharding)."""
+    if statics is None or rank is None:
+        return statics
+    return statics._replace(pod_name_rank=rank)
 
 
 class AutoscaleState(NamedTuple):

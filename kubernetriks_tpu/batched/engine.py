@@ -36,6 +36,7 @@ from kubernetriks_tpu.batched.state import (
     PHASE_QUEUED,
     PHASE_RUNNING,
     PHASE_UNSCHEDULABLE,
+    RefillStage,
     TraceSlab,
     init_state,
     make_step_constants,
@@ -44,6 +45,14 @@ from kubernetriks_tpu.batched.state import (
 from kubernetriks_tpu.batched.timerep import TPair, from_f64_np, to_f64
 from kubernetriks_tpu.batched.step import (
     _STEP_STATICS,
+    _quantize_shift_device,
+    _slide_apply_traced,
+    _slide_shift_core,
+    SUPERSPAN_GROW,
+    SUPERSPAN_RUN,
+    SUPERSPAN_STAGE,
+    run_superspan,
+    run_superspan_donated,
     run_windows,
     window_step,
 )
@@ -71,111 +80,11 @@ _DEVICE_SLIDE_BUDGET_BYTES = 2 << 30
 _CHUNK_LADDER = (128, 64, 32, 16, 8, 4, 2, 1)
 
 
-def _slide_shift_core(phase, create_win_pay, base):
-    """The window-shift amount, computed ON DEVICE: the leading run of
-    terminal-or-padding pod slots across every cluster (min over C of each
-    row's first blocking slot). Bit-identical to the host formulation in
-    _advance_pod_window (same terminal set, same padding rule); only a
-    4-byte scalar crosses the tunnel instead of the full (C, W) phase
-    fetch."""
-    from kubernetriks_tpu.batched.state import (
-        PHASE_EMPTY,
-        PHASE_FAILED,
-        PHASE_REMOVED,
-        PHASE_SUCCEEDED,
-    )
-
-    C, W = phase.shape  # phase is pre-sliced to the plain window [0, W)
-    no_create = jnp.int32(np.iinfo(np.int32).max)
-    seg = jax.lax.dynamic_slice(create_win_pay, (jnp.int32(0), base), (C, W))
-    terminal = (
-        (phase == PHASE_SUCCEEDED)
-        | (phase == PHASE_REMOVED)
-        | (phase == PHASE_FAILED)
-    )
-    padding = (phase == PHASE_EMPTY) & (seg == no_create)
-    blocking = ~(terminal | padding)
-    first_live = jnp.where(
-        blocking.any(axis=1),
-        jnp.argmax(blocking, axis=1).astype(jnp.int32),
-        jnp.int32(W),
-    )
-    return jnp.min(first_live).astype(jnp.int32)
-
-
+# The slide primitives (_slide_shift_core, _quantize_shift_device,
+# _slide_apply_traced) moved to batched/step.py with the superspan executor
+# (run_superspan needs them and engine imports step, not vice versa); the
+# engine-side jitted shift entry keeps living here for the two-dispatch path.
 _slide_shift_device = jax.jit(_slide_shift_core)
-
-
-def _quantize_shift_device(s0, W: int):
-    """Device mirror of _advance_pod_window's host shift quantization (same
-    small set of slide amounts, so fused and unfused runs follow identical
-    slide trajectories). s0 == 0 maps to 0 — the fused program's "no slide
-    possible" flag, read back by the engine to trigger window growth."""
-    quantum = max(W // 8, 1)
-    # Largest power of two <= s0 (bit-smear; 0 for s0 == 0), the host path's
-    # 1 << (s.bit_length() - 1) fallback.
-    v = s0
-    for sh in (1, 2, 4, 8, 16):
-        v = v | (v >> sh)
-    s = jnp.where(s0 >= quantum, jnp.int32(quantum), v - (v >> 1))
-    if W // 4 > 0:
-        s = jnp.where(s0 >= W // 4, jnp.int32(W // 4), s)
-    if W // 2 > 0:
-        s = jnp.where(s0 >= W // 2, jnp.int32(W // 2), s)
-    return s.astype(jnp.int32)
-
-
-def _slide_apply_traced(pods, rank, pay, base, s, W: int):
-    """Window slide with a TRACED shift amount (s == 0 is the identity): the
-    gather formulation of _slide_apply_device, so ONE compiled program covers
-    every quantized shift and the slide can fuse into the window-chunk
-    program (_fused_chunk_slide). Bit-identical to the concat path: shifted
-    window slots copy their source slot, refill slots combine the device
-    payload with the SAME fresh-slot constructor init_state uses, and the
-    resident pod-group tail (device slots >= W) is untouched."""
-    from kubernetriks_tpu.batched.state import fresh_pod_arrays
-
-    C, P = pods.phase.shape
-    idx = jnp.arange(P, dtype=jnp.int32)[None, :]  # (1, P)
-    in_window = idx < W
-    refill = in_window & (idx >= (jnp.int32(W) - s))
-    # Window slots shift left by s; refill slots read idx (masked out below);
-    # resident-tail slots are the identity. idx + s < W for every shifted
-    # slot, so the gather never crosses into the resident tail.
-    src_old = jnp.broadcast_to(
-        jnp.where(in_window & ~refill, idx + s, idx), (C, P)
-    )
-    # Refill slot idx's global plain slot is (base + s) + idx; the payload is
-    # padded to T + W columns, which covers every reachable refill column
-    # (slides only happen while base + W < T). Clip for the masked-out rest.
-    pay_cols = pay["req_cpu"].shape[1]
-    pay_col = jnp.broadcast_to(
-        jnp.clip(base + s + idx, 0, pay_cols - 1), (C, P)
-    )
-
-    def pg(a):
-        return jnp.take_along_axis(a, pay_col, axis=1)
-
-    fresh = fresh_pod_arrays(
-        C,
-        P,
-        pg(pay["req_cpu"]),
-        pg(pay["req_ram"]),
-        TPair(win=pg(pay["dur_win"]), off=pg(pay["dur_off"])),
-    )
-    new_pods = jax.tree.map(
-        lambda old, fr: jnp.where(
-            refill, fr, jnp.take_along_axis(old, src_old, axis=1)
-        ),
-        pods,
-        fresh,
-    )
-    new_rank = None
-    if rank is not None:
-        new_rank = jnp.where(
-            refill, pg(pay["rank"]), jnp.take_along_axis(rank, src_old, axis=1)
-        )
-    return new_pods, new_rank
 
 
 def _fused_chunk_slide_impl(
@@ -597,6 +506,10 @@ class BatchedSimulation:
         fast_forward: Optional[bool] = None,
         donate: Optional[bool] = None,
         fuse_slide: Optional[bool] = None,
+        superspan: Optional[bool] = None,
+        superspan_k: int = 16,
+        superspan_chunk: int = 8,
+        superspan_stage_cols: Optional[int] = None,
     ) -> None:
         self.config = config
         # Buffer donation (KTPU_DONATE / donate arg): the steady-state
@@ -634,6 +547,34 @@ class BatchedSimulation:
                 env != "0" if env is not None
                 else jax.default_backend() != "cpu"
             )
+        # Superspan executor (KTPU_SUPERSPAN / superspan arg): the
+        # steady-state sliding loop dispatches ONE device program per up-to-K
+        # slide-spans (step.run_superspan) — windows, shift computation,
+        # quantization and slide application all inside one while_loop, refill
+        # columns drawn from a device-resident staging slab — instead of
+        # popcount(span) ladder chunks + a per-span shift readback. The only
+        # host sync left in steady state is the (4,)-int32 progress readback,
+        # one per superspan. Bit-identical to the ladder path
+        # (tests/test_superspan.py); default on for accelerator backends —
+        # on CPU hosts the extra program variant would only double compile
+        # time, so tests opt in explicitly.
+        if superspan is not None:
+            self._superspan = bool(superspan)
+        else:
+            env = os.environ.get("KTPU_SUPERSPAN")
+            self._superspan = (
+                env != "0" if env is not None
+                else jax.default_backend() != "cpu"
+            )
+        self._superspan_k = max(1, int(superspan_k))
+        self._superspan_chunk = max(1, int(superspan_chunk))
+        self._superspan_stage_cols = superspan_stage_cols
+        # (lo, RefillStage) staging buffers for the superspan executor when
+        # the whole-trace payload exceeds the device budget: the stage the
+        # next dispatch reads, and the double-buffered successor assembled
+        # while the current superspan runs on device (_prefetch_stage).
+        self._stage_cur = None
+        self._stage_next = None
         # (shift-array, new-name-rank-or-None) of a fused slide whose host
         # resolution is still pending (step_until_time resolves it at the
         # span boundary).
@@ -648,12 +589,21 @@ class BatchedSimulation:
         # fused), slide_syncs counts blocking host readbacks that gate a
         # slide decision, refill_prefetches counts host-path payload
         # prefetches that overlapped device compute.
+        # superspans counts run_superspan dispatches (each is one device
+        # program covering up to K slide-spans and ONE blocking progress
+        # readback, also counted in slide_syncs); superspan_spans counts the
+        # slide-spans those dispatches completed on device; stage_refills
+        # counts staging-buffer installs (whole-trace-payload engines never
+        # restage).
         self.dispatch_stats = {
             "window_chunks": 0,
             "fused_slides": 0,
             "slide_dispatches": 0,
             "slide_syncs": 0,
             "refill_prefetches": 0,
+            "superspans": 0,
+            "superspan_spans": 0,
+            "stage_refills": 0,
         }
         self._use_pallas_requested = use_pallas
         self.pallas_interpret = bool(pallas_interpret)
@@ -1159,41 +1109,36 @@ class BatchedSimulation:
         if self.pod_window is None or self._full_pods is None:
             return
         full = self._full_pods
-        C, T = full["req_cpu"].shape
+        T = full["req_cpu"].shape[1]
         W = self.pod_window
         has_rank = self.autoscale_statics is not None
         if not self._slide_payload_fits(W):
             return
-        no_create = np.iinfo(np.int32).max
-
-        def pad(arr, fill, dtype):
-            out = np.full((C, T + W), fill, dtype)
-            out[:, : arr.shape[1]] = arr
-            return out
-
         from kubernetriks_tpu.batched.state import duration_pair_np
+        from kubernetriks_tpu.batched.trace_compile import stage_segment
 
-        # Pad durations in f64 seconds BEFORE pair conversion so padded
-        # slots get the exact service-sentinel encoding the host refill
-        # produces for beyond-trace slots.
+        # The whole-trace payload is the lo = 0, width = T + W staging
+        # segment — stage_segment owns the padding rules, so this payload
+        # and the bounded RefillStage slabs (_make_stage) cannot drift.
+        seg = stage_segment(
+            full,
+            self._pod_create_win,
+            self._pod_name_rank_full[:, :T] if has_rank else None,
+            0,
+            T + W,
+        )
         dur_pair = duration_pair_np(
-            pad(full["duration"], -1.0, np.float64),
-            self.config.scheduling_cycle_interval,
+            seg.pop("duration"), self.config.scheduling_cycle_interval
         )
         payload = {
-            "req_cpu": jnp.asarray(pad(full["req_cpu"], 0, np.int32)),
-            "req_ram": jnp.asarray(pad(full["req_ram"], 0, np.int32)),
+            "req_cpu": jnp.asarray(seg["req_cpu"]),
+            "req_ram": jnp.asarray(seg["req_ram"]),
             "dur_win": dur_pair.win,
             "dur_off": dur_pair.off,
-            "create_win": jnp.asarray(
-                pad(self._pod_create_win, no_create, np.int32)
-            ),
+            "create_win": jnp.asarray(seg["create_win"]),
         }
         if has_rank:
-            BIG_RANK = np.int32(1 << 30)
-            payload["rank"] = jnp.asarray(
-                pad(self._pod_name_rank_full[:, :T], BIG_RANK, np.int32)
-            )
+            payload["rank"] = jnp.asarray(seg["rank"])
         if self._sharding is not None:
             row = NamedSharding(
                 self._sharding.mesh, PartitionSpec(self._batch_axis, None)
@@ -1380,11 +1325,45 @@ class BatchedSimulation:
         tunnel, cache hit when already warm) plus the bounded quiet
         execution. Returns the number of shapes dispatched. No-op on
         fast-forward or non-sliding engines (one program serves any span
-        there)."""
+        there). Superspan engines warm the ONE superspan program instead of
+        the ladder — the steady-state loop never dispatches ladder chunks
+        while the superspan path is selectable."""
         if self.pod_window is None or (
             self.fast_forward and not self.collect_gauges
         ):
             return 0
+        if self._superspan_ok():
+            # The superspan loop is the ONLY program the steady-state
+            # dispatch will use (one shape serves every span/target), so
+            # warm it instead of the ladder; a no-op progress code compiles
+            # the whole while_loop without executing a window. Dispatched
+            # against a scratch copy like the ladder shapes (donation).
+            stage, lo = self._current_stage()
+            rank = (
+                self.autoscale_statics.pod_name_rank
+                if self.autoscale_statics is not None
+                else None
+            )
+            fn = run_superspan_donated if self.donate else run_superspan
+            out = fn(
+                tree_copy(self.state),
+                rank,
+                jnp.asarray(
+                    [self.next_window_idx, self._pod_base, 0, SUPERSPAN_GROW],
+                    jnp.int32,
+                ),
+                self.slab,
+                self.consts,
+                stage,
+                jnp.int32(lo),
+                jnp.int32(self.next_window_idx),
+                W=self.pod_window,
+                K=self._superspan_k,
+                chunk=self._superspan_chunk,
+                **self._window_call_kwargs(),
+            )
+            jax.block_until_ready(out)
+            return 1
         from kubernetriks_tpu.batched.step import run_windows_donated
 
         win_fn = run_windows_donated if self.donate else run_windows
@@ -1449,6 +1428,9 @@ class BatchedSimulation:
         # len(LADDER) program shapes compile per variant;
         # precompile_chunks() AOT-compiles them so none lands mid-bench.
         target = int(idxs[-1])
+        if self._superspan_ok():
+            self._run_superspans(target)
+            return
         while self.next_window_idx <= target:
             sub = min(target, self._pod_capacity_window())
             will_slide = sub < target
@@ -1503,6 +1485,226 @@ class BatchedSimulation:
             and not self.fast_forward
             and not self.collect_gauges
         )
+
+    def _superspan_ok(self) -> bool:
+        """Whether the steady-state loop can dispatch superspans: needs the
+        sliding window and the plain run_windows dispatch mode (fast-forward
+        and gauge collection keep their own programs), and steps aside for
+        the per-chunk instrumentation paths — profiling and throughput logs
+        want ladder-granular timings, and the ladder is bit-identical.
+        KTPU_DEBUG_FINITE keeps the ladder too: its promise is per-chunk
+        NaN/inf localization, and a superspan only surfaces state once per
+        up-to-K spans."""
+        return (
+            self._superspan
+            and self.pod_window is not None
+            and not self.fast_forward
+            and not self.collect_gauges
+            and not self.profile_dir
+            and not self.log_throughput
+            and not self._debug_finite
+        )
+
+    def _stage_width(self) -> int:
+        """Static column count of the superspan staging slab when the
+        whole-trace payload is over budget: W windows of shift headroom
+        would starve a max (W/2) slide, so the default is 4W (3W of shift
+        headroom per stage), clamped to the whole padded payload."""
+        W = self.pod_window
+        T = int(self.consts.trace_pod_bound)
+        want = (
+            self._superspan_stage_cols
+            if self._superspan_stage_cols is not None
+            else 4 * W
+        )
+        return min(max(want, W + max(W // 2, 1)), T + W)
+
+    def _make_stage(self, lo: int, width: int) -> RefillStage:
+        """Assemble + upload one staging slab covering payload columns
+        [lo, lo + width) (trace_compile.stage_segment owns the layout and
+        padding rules; the device pair conversion mirrors
+        _init_device_slide)."""
+        from kubernetriks_tpu.batched.state import duration_pair_np
+        from kubernetriks_tpu.batched.trace_compile import stage_segment
+
+        seg = stage_segment(
+            self._full_pods,
+            self._pod_create_win,
+            (
+                self._pod_name_rank_full[:, : int(self.consts.trace_pod_bound)]
+                if self.autoscale_statics is not None
+                else None
+            ),
+            lo,
+            width,
+        )
+        dur = duration_pair_np(
+            seg.pop("duration"), self.config.scheduling_cycle_interval
+        )
+        stage = RefillStage(
+            req_cpu=jnp.asarray(seg["req_cpu"]),
+            req_ram=jnp.asarray(seg["req_ram"]),
+            dur_win=dur.win,
+            dur_off=dur.off,
+            create_win=jnp.asarray(seg["create_win"]),
+            rank=(
+                jnp.asarray(seg["rank"]) if "rank" in seg else None
+            ),
+        )
+        if self._sharding is not None:
+            row = NamedSharding(
+                self._sharding.mesh, PartitionSpec(self._batch_axis, None)
+            )
+            put = (
+                put_global
+                if is_cross_process(self._sharding.mesh)
+                else jax.device_put
+            )
+            stage = put(
+                stage,
+                jax.tree.map(lambda _: row, stage),
+            )
+        return stage
+
+    def _stage_covers(self, lo: int, stage: RefillStage) -> bool:
+        """A stage serves a dispatch at the current pod_base iff the base
+        sits inside it with the full window readable (the superspan's own
+        exhaustion exit handles running out of headroom mid-flight)."""
+        L = stage.req_cpu.shape[1]
+        return (
+            L == self._stage_width()
+            and lo <= self._pod_base
+            and self._pod_base - lo + self.pod_window <= L
+        )
+
+    def _current_stage(self):
+        """(stage, lo) for the next superspan dispatch. Whole-trace payload
+        engines wrap it directly (lo = 0, zero-copy, never restages);
+        over-budget engines install the double-buffered successor when it
+        covers the current base, else rebuild at the base."""
+        if self._device_slide is not None:
+            pay = self._device_slide
+            return (
+                RefillStage(
+                    req_cpu=pay["req_cpu"],
+                    req_ram=pay["req_ram"],
+                    dur_win=pay["dur_win"],
+                    dur_off=pay["dur_off"],
+                    create_win=pay["create_win"],
+                    rank=pay.get("rank"),
+                ),
+                0,
+            )
+        if self._stage_cur is not None and self._stage_covers(*self._stage_cur):
+            lo, stage = self._stage_cur
+            return stage, lo
+        nxt, self._stage_next = self._stage_next, None
+        if nxt is not None and self._stage_covers(*nxt):
+            self._stage_cur = nxt
+        else:
+            lo = self._pod_base
+            self._stage_cur = (lo, self._make_stage(lo, self._stage_width()))
+        self.dispatch_stats["stage_refills"] += 1
+        lo, stage = self._stage_cur
+        return stage, lo
+
+    def _prefetch_stage(self, cur_lo: int) -> None:
+        """Double-buffering: assemble + device_put the NEXT staging slab
+        while the just-dispatched superspan runs on device. An
+        exhaustion-exit superspan's final base b satisfies
+        b > cur_lo + R - W/2 (the failed slide's shift is at most W/2 and
+        its columns crossed cur_lo + L), so a successor at exactly that
+        lower bound always covers the restage point — host assembly and the
+        H2D transfer overlap device compute instead of serializing at the
+        span boundary (the generalization of the ladder path's
+        _prefetch_refill)."""
+        if self._device_slide is not None:
+            return
+        W = self.pod_window
+        Lw = self._stage_width()
+        lo_pred = cur_lo + (Lw - W) - W // 2
+        if lo_pred <= cur_lo:
+            return
+        if self._stage_next is not None and self._stage_next[0] == lo_pred:
+            return
+        self._stage_next = (lo_pred, self._make_stage(lo_pred, Lw))
+
+    def _run_superspans(self, target: int) -> None:
+        """The superspan dispatch loop: one device program per up-to-K
+        slide-spans, one blocking (4,)-int32 progress readback per dispatch
+        consumed AFTER the next stage's prefetch is in flight. Host work per
+        superspan: the readback, the host-mirror updates (pod_base, window
+        cursor, carried name ranks), and — over-budget engines only — the
+        overlapped staging assembly."""
+        fn = run_superspan_donated if self.donate else run_superspan
+        while self.next_window_idx <= target:
+            W = self.pod_window
+            stage, lo = self._current_stage()
+            rank = (
+                self.autoscale_statics.pod_name_rank
+                if self.autoscale_statics is not None
+                else None
+            )
+            progress_in = jnp.asarray(
+                [self.next_window_idx, self._pod_base, 0, SUPERSPAN_RUN],
+                jnp.int32,
+            )
+            self.dispatch_stats["superspans"] += 1
+            state, rank, progress = fn(
+                self.state,
+                rank,
+                progress_in,
+                self.slab,
+                self.consts,
+                stage,
+                jnp.int32(lo),
+                jnp.int32(target),
+                W=W,
+                K=self._superspan_k,
+                chunk=self._superspan_chunk,
+                **self._window_call_kwargs(),
+            )
+            self.state = state
+            if rank is not None:
+                self.autoscale_statics = self.autoscale_statics._replace(
+                    pod_name_rank=rank
+                )
+            if hasattr(progress, "copy_to_host_async"):
+                progress.copy_to_host_async()
+            # Overlap the next stage's host assembly + H2D with the device
+            # program still running, BEFORE the blocking readback.
+            self._prefetch_stage(lo)
+            w, base, spans, code = (int(v) for v in to_host(progress))
+            self.dispatch_stats["slide_syncs"] += 1
+            self.dispatch_stats["superspan_spans"] += spans
+            self.next_window_idx = w
+            self._pod_base = base
+            if code == SUPERSPAN_GROW:
+                if not self._grow_pod_window():
+                    raise RuntimeError(
+                        f"pod_window={self.pod_window} is too small: window "
+                        f"{w} needs pod slots beyond the device window "
+                        "and no leading pod is terminal yet, and the window "
+                        "already covers the whole plain trace segment"
+                    )
+            elif code == SUPERSPAN_STAGE:
+                if self._device_slide is not None:
+                    # Unreachable by construction (the whole-trace payload
+                    # covers every refill column a slide can touch); a silent
+                    # retry here would loop forever, so fail loudly instead.
+                    raise RuntimeError(
+                        "superspan reported staging exhaustion against the "
+                        "whole-trace slide payload"
+                    )
+                # The stage ran out of slide headroom mid-flight. It may
+                # still COVER the final base (exhaustion fires on the
+                # pending slide's refill columns, not the window read), so
+                # drop it — _current_stage then installs the prefetched
+                # successor, or rebuilds at the new base (L - W >= W/2 of
+                # fresh headroom, so the retried slide always lands and the
+                # dispatch loop can't spin on an exhausted buffer).
+                self._stage_cur = None
+            # SUPERSPAN_RUN with w <= target: K-span budget hit; redispatch.
 
     def _resolve_pending_slide(self) -> bool:
         """Consume a fused slide's pending shift — the span's ONLY host
@@ -1823,8 +2025,11 @@ class BatchedSimulation:
             self._refresh_name_ranks()  # rebuilds windowed ranks at new_W
         self._init_device_slide()  # re-pad the payload to T + new_W
         # A prefetched refill payload (host slide path) is sized/positioned
-        # for the OLD window width — drop it.
+        # for the OLD window width — drop it. Superspan staging slabs are
+        # width-keyed too (_stage_covers rejects them anyway; free the HBM).
         self._refill_prefetch = None
+        self._stage_cur = None
+        self._stage_next = None
         if (
             self.mesh is not None
             and is_cross_process(self.mesh)

@@ -212,6 +212,32 @@ class ClusterBatchState(NamedTuple):
     auto: Optional[NamedTuple] = None
 
 
+class RefillStage(NamedTuple):
+    """Device-resident staging slab for the superspan executor
+    (step.run_superspan): refill payload columns [lo, lo + L) of the trace's
+    PLAIN pod segment — requests, duration pairs, create windows and (under
+    autoscalers) name ranks — pre-assembled host-side
+    (trace_compile.stage_segment) and consumed by on-device window slides.
+    Columns past the trace's plain segment carry the fresh-slot padding the
+    host refill path produces (req 0, service-sentinel duration, no-create
+    window), so a stage sliced anywhere near the trace end is still exact.
+    `rank` is None when no autoscale statics exist (the pytree structure is
+    part of the compiled program's identity, like every other None static).
+
+    The engine keeps at most two stages alive: the one the in-flight
+    superspan reads and the double-buffered successor assembled while the
+    device runs (engine._prefetch_stage). An engine whose full slide payload
+    fits the device budget wraps it as one whole-trace stage (lo = 0) and
+    never restages."""
+
+    req_cpu: jnp.ndarray  # (C, L) int32 millicores
+    req_ram: jnp.ndarray  # (C, L) int32 ram units
+    dur_win: jnp.ndarray  # (C, L) int32 duration pair (win < 0 = service)
+    dur_off: jnp.ndarray  # (C, L) float32 duration pair offset
+    create_win: jnp.ndarray  # (C, L) int32 create-event window; INT32_MAX = none
+    rank: Optional[jnp.ndarray] = None  # (C, L) int32 lexicographic name ranks
+
+
 class TraceSlab(NamedTuple):
     """(C, E) compiled trace events, time-sorted per cluster, padded with
     EV_NONE/time=+inf (win=INF_WIN).

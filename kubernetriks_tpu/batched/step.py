@@ -42,6 +42,7 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from kubernetriks_tpu.batched.state import (
     ClusterBatchState,
@@ -52,6 +53,7 @@ from kubernetriks_tpu.batched.state import (
     EV_NODE_RECOVER,
     EV_REMOVE_NODE,
     EV_REMOVE_POD,
+    PHASE_EMPTY,
     PHASE_FAILED,
     PHASE_QUEUED,
     PHASE_REMOVED,
@@ -2003,5 +2005,361 @@ run_windows = partial(
 run_windows_donated = jax.jit(
     _run_windows_impl,
     static_argnames=_STEP_STATICS + ("collect_gauges",),
+    donate_argnums=(0,),
+)
+
+
+# --- sliding-window slide primitives ----------------------------------------
+# Shared by the engine's two-dispatch slide path, the fused chunk+slide
+# megastep (engine._fused_chunk_slide) and the superspan executor below.
+
+
+def _slide_shift_core(phase, create_win_pay, base):
+    """The window-shift amount, computed ON DEVICE: the leading run of
+    terminal-or-padding pod slots across every cluster (min over C of each
+    row's first blocking slot). Bit-identical to the host formulation in
+    engine._advance_pod_window (same terminal set, same padding rule); only
+    a 4-byte scalar crosses the tunnel instead of the full (C, W) phase
+    fetch. `base` indexes create_win_pay's columns — GLOBAL plain slots for
+    the whole-trace payload, stage-relative under a bounded RefillStage."""
+    C, W = phase.shape  # phase is pre-sliced to the plain window [0, W)
+    no_create = jnp.int32(np.iinfo(np.int32).max)
+    seg = jax.lax.dynamic_slice(create_win_pay, (jnp.int32(0), base), (C, W))
+    terminal = (
+        (phase == PHASE_SUCCEEDED)
+        | (phase == PHASE_REMOVED)
+        | (phase == PHASE_FAILED)
+    )
+    padding = (phase == PHASE_EMPTY) & (seg == no_create)
+    blocking = ~(terminal | padding)
+    first_live = jnp.where(
+        blocking.any(axis=1),
+        jnp.argmax(blocking, axis=1).astype(jnp.int32),
+        jnp.int32(W),
+    )
+    return jnp.min(first_live).astype(jnp.int32)
+
+
+def _quantize_shift_device(s0, W: int):
+    """Device mirror of _advance_pod_window's host shift quantization (same
+    small set of slide amounts, so fused and unfused runs follow identical
+    slide trajectories). s0 == 0 maps to 0 — the fused program's "no slide
+    possible" flag, read back by the engine to trigger window growth."""
+    quantum = max(W // 8, 1)
+    # Largest power of two <= s0 (bit-smear; 0 for s0 == 0), the host path's
+    # 1 << (s.bit_length() - 1) fallback.
+    v = s0
+    for sh in (1, 2, 4, 8, 16):
+        v = v | (v >> sh)
+    s = jnp.where(s0 >= quantum, jnp.int32(quantum), v - (v >> 1))
+    if W // 4 > 0:
+        s = jnp.where(s0 >= W // 4, jnp.int32(W // 4), s)
+    if W // 2 > 0:
+        s = jnp.where(s0 >= W // 2, jnp.int32(W // 2), s)
+    return s.astype(jnp.int32)
+
+
+def _slide_apply_traced(pods, rank, pay, base, s, W: int):
+    """Window slide with a TRACED shift amount (s == 0 is the identity): the
+    gather formulation of engine._slide_apply_device, so ONE compiled
+    program covers every quantized shift and the slide can fuse into the
+    window-chunk program (engine._fused_chunk_slide) or the superspan loop
+    (run_superspan). Bit-identical to the concat path: shifted window slots
+    copy their source slot, refill slots combine the device payload with the
+    SAME fresh-slot constructor init_state uses, and the resident pod-group
+    tail (device slots >= W) is untouched. `base` is in the payload's own
+    column coordinates (see _slide_shift_core)."""
+    from kubernetriks_tpu.batched.state import fresh_pod_arrays
+
+    C, P = pods.phase.shape
+    idx = jnp.arange(P, dtype=jnp.int32)[None, :]  # (1, P)
+    in_window = idx < W
+    refill = in_window & (idx >= (jnp.int32(W) - s))
+    # Window slots shift left by s; refill slots read idx (masked out below);
+    # resident-tail slots are the identity. idx + s < W for every shifted
+    # slot, so the gather never crosses into the resident tail.
+    src_old = jnp.broadcast_to(
+        jnp.where(in_window & ~refill, idx + s, idx), (C, P)
+    )
+    # Refill slot idx's payload column is (base + s) + idx; the whole-trace
+    # payload is padded to T + W columns and a RefillStage's exhaustion exit
+    # fires before any out-of-range refill, so every reachable refill column
+    # is covered. Clip for the masked-out rest.
+    pay_cols = pay["req_cpu"].shape[1]
+    pay_col = jnp.broadcast_to(
+        jnp.clip(base + s + idx, 0, pay_cols - 1), (C, P)
+    )
+
+    def pg(a):
+        return jnp.take_along_axis(a, pay_col, axis=1)
+
+    fresh = fresh_pod_arrays(
+        C,
+        P,
+        pg(pay["req_cpu"]),
+        pg(pay["req_ram"]),
+        TPair(win=pg(pay["dur_win"]), off=pg(pay["dur_off"])),
+    )
+    new_pods = jax.tree.map(
+        lambda old, fr: jnp.where(
+            refill, fr, jnp.take_along_axis(old, src_old, axis=1)
+        ),
+        pods,
+        fresh,
+    )
+    new_rank = None
+    if rank is not None:
+        new_rank = jnp.where(
+            refill, pg(pay["rank"]), jnp.take_along_axis(rank, src_old, axis=1)
+        )
+    return new_pods, new_rank
+
+
+# --- superspan executor ------------------------------------------------------
+
+# Exit codes in the superspan progress vector (progress[3]):
+SUPERSPAN_RUN = 0  # ran to the target / span budget; nothing blocked
+SUPERSPAN_GROW = 1  # shift == 0: the live-pod span outgrew the window
+SUPERSPAN_STAGE = 2  # next slide needs refill columns beyond the stage
+
+
+def _run_superspan_impl(
+    state: ClusterBatchState,
+    rank,
+    progress,
+    slab: TraceSlab,
+    consts: StepConstants,
+    stage,
+    stage_lo,
+    last,
+    max_events_per_window: int,
+    max_pods_per_cycle: int,
+    autoscale_statics=None,
+    max_ca_pods_per_cycle: int = 64,
+    max_pods_per_scale_down: int = 8,
+    use_pallas: bool = False,
+    pallas_interpret: bool = False,
+    conditional_move: bool = False,
+    pallas_mesh=None,
+    pallas_axis: str = "clusters",
+    use_pallas_select: bool = False,
+    use_megakernel: bool = True,
+    hpa_seg=None,
+    fault_params=None,
+    name_ranks=None,
+    W: int = 0,
+    K: int = 16,
+    chunk: int = 8,
+):
+    """Execute up to K consecutive slide-spans ENTIRELY on device: one
+    while_loop whose body either advances a chunk of windows (while the
+    next window's pod creations still fit the device window) or computes,
+    quantizes and applies the pod-window slide — refill columns drawn from
+    the device-resident RefillStage — carrying pod_base (in state) and the
+    windowed pod-name ranks as traced loop state. The steady-state host
+    boundary of the ladder path (one shift readback + refill bookkeeping
+    per span) collapses to ONE progress readback per K spans.
+
+    Arguments beyond the run_windows set:
+    - rank: (C, P) windowed pod-name ranks carried through on-device slides
+      (None without autoscale statics). The statics' own pod_name_rank leaf
+      is ignored inside the loop (autoscale.statics_with_pod_rank rebinds
+      the carried array for every window chunk).
+    - progress: (4,) int32 [next_window, pod_base, spans, code]. The loop
+      starts at progress[0] with progress[3] as the initial code — a
+      non-RUN input code makes the whole call the identity, so callers can
+      chain dispatches speculatively and resolve the codes later.
+    - stage: state.RefillStage covering payload columns
+      [stage_lo, stage_lo + L); the whole-trace payload is the L = T + W,
+      stage_lo = 0 special case and never exhausts.
+    - last: final window index (inclusive) this call may execute.
+    - W/K/chunk (static): pod-window width, span budget, windows advanced
+      per full-rate loop iteration.
+
+    Exits (code in the returned progress vector): SUPERSPAN_RUN with
+    next_window > last = target reached; SUPERSPAN_RUN with spans == K =
+    span budget, redispatch; SUPERSPAN_GROW = no slide possible with the
+    capacity column readable, the engine must grow the window;
+    SUPERSPAN_STAGE = the pending slide's refill columns lie beyond the
+    stage (or the slide is blocked with the capacity column itself beyond
+    the stage, where growth cannot be trusted), the engine must install the
+    next staging buffer. Blocking exits leave the slide UNAPPLIED (state as
+    of the last completed window), so re-dispatching after the host fix is
+    exact.
+
+    Bit-identity with the ladder path: the same _window_body runs at the
+    same window indices (chunking is associativity-free), slides trigger at
+    exactly the capacity boundaries step_until_time uses (first overflow
+    create across clusters), and shift/quantize/apply are the SAME traced
+    formulations the fused megastep dispatches.
+    """
+    big = jnp.int32(np.iinfo(np.int32).max)
+    from kubernetriks_tpu.batched.autoscale import statics_with_pod_rank
+
+    L = stage.req_cpu.shape[1]
+    stage_lo = jnp.asarray(stage_lo, jnp.int32)
+    last = jnp.asarray(last, jnp.int32)
+    pay = {
+        "req_cpu": stage.req_cpu,
+        "req_ram": stage.req_ram,
+        "dur_win": stage.dur_win,
+        "dur_off": stage.dur_off,
+        "create_win": stage.create_win,
+    }
+    if stage.rank is not None:
+        pay["rank"] = stage.rank
+
+    def step_windows(state, rank, idxs):
+        st = statics_with_pod_rank(autoscale_statics, rank)
+
+        def body(carry, w):
+            new = _window_body(
+                carry,
+                slab,
+                w,
+                consts,
+                max_events_per_window,
+                max_pods_per_cycle,
+                st,
+                max_ca_pods_per_cycle,
+                max_pods_per_scale_down,
+                use_pallas,
+                pallas_interpret,
+                conditional_move,
+                pallas_mesh,
+                pallas_axis,
+                use_pallas_select,
+                use_megakernel=use_megakernel,
+                hpa_seg=hpa_seg,
+                fault_params=fault_params,
+                name_ranks=name_ranks,
+            )
+            return new, None
+
+        state, _ = jax.lax.scan(body, state, idxs)
+        return state
+
+    def cond(carry):
+        _, _, w, spans, code = carry
+        return (w <= last) & (code == SUPERSPAN_RUN) & (spans < jnp.int32(K))
+
+    def body(carry):
+        state, rank, w, spans, code = carry
+        # pod_base is uniform across clusters (slides shift every row
+        # together); min() is the replicated-scalar read under a mesh.
+        base = jnp.min(state.pod_base)
+        # Capacity: the last window index dispatchable before a pod creation
+        # would land beyond the device window — the create window of global
+        # plain slot base + W (engine._pod_capacity_window's device twin).
+        # Beyond the trace's plain segment capacity is unbounded; a stage
+        # whose headroom is fully consumed reports capacity -1, forcing the
+        # slide branch (which then exits SUPERSPAN_STAGE or GROW).
+        gcol = base + jnp.int32(W)
+        col = gcol - stage_lo
+        cap_read = jnp.min(
+            jax.lax.dynamic_slice_in_dim(
+                stage.create_win, jnp.clip(col, 0, L - 1), 1, axis=1
+            )
+        ).astype(jnp.int32)
+        cap = jnp.where(
+            gcol >= consts.trace_pod_bound,
+            big,
+            jnp.where(col < jnp.int32(L), cap_read, jnp.int32(-1)),
+        )
+        bound = jnp.minimum(cap, last)
+
+        def run_branch(op):
+            state, rank, w, spans = op
+            can_chunk = (w + jnp.int32(chunk - 1)) <= bound
+
+            def run_k(op2):
+                state, rank, w = op2
+                idxs = w + jnp.arange(chunk, dtype=jnp.int32)
+                return step_windows(state, rank, idxs), rank, w + jnp.int32(chunk)
+
+            def run_1(op2):
+                state, rank, w = op2
+                idxs = w + jnp.arange(1, dtype=jnp.int32)
+                return step_windows(state, rank, idxs), rank, w + jnp.int32(1)
+
+            state, rank, w = jax.lax.cond(
+                can_chunk, run_k, run_1, (state, rank, w)
+            )
+            return state, rank, w, spans, jnp.int32(SUPERSPAN_RUN)
+
+        def slide_branch(op):
+            state, rank, w, spans = op
+            s0 = _slide_shift_core(
+                state.pods.phase[:, :W], stage.create_win, base - stage_lo
+            )
+            s = _quantize_shift_device(s0, W)
+            blocked = s <= jnp.int32(0)
+            # A blocked slide whose capacity column lies beyond the stage
+            # (col >= L forced cap to -1 above) is staging exhaustion, not
+            # growth: the TRUE capacity may still admit the next window, so
+            # the engine must restage — GROW is only trustworthy when the
+            # capacity read was in range.
+            cap_unread = (col >= jnp.int32(L)) & (
+                gcol < consts.trace_pod_bound
+            )
+            grow = blocked & ~cap_unread
+            exhausted = (blocked & cap_unread) | (
+                (~blocked)
+                & ((base - stage_lo + jnp.int32(W) + s) > jnp.int32(L))
+            )
+
+            def apply(op2):
+                state, rank = op2
+                new_pods, new_rank = _slide_apply_traced(
+                    state.pods, rank, pay, base - stage_lo, s, W
+                )
+                return (
+                    state._replace(
+                        pods=new_pods, pod_base=state.pod_base + s
+                    ),
+                    new_rank,
+                )
+
+            def skip(op2):
+                return op2
+
+            state, rank = jax.lax.cond(
+                grow | exhausted, skip, apply, (state, rank)
+            )
+            code = jnp.where(
+                grow,
+                jnp.int32(SUPERSPAN_GROW),
+                jnp.where(
+                    exhausted,
+                    jnp.int32(SUPERSPAN_STAGE),
+                    jnp.int32(SUPERSPAN_RUN),
+                ),
+            )
+            spans = spans + (code == SUPERSPAN_RUN).astype(jnp.int32)
+            return state, rank, w, spans, code
+
+        return jax.lax.cond(
+            w <= bound, run_branch, slide_branch, (state, rank, w, spans)
+        )
+
+    progress = jnp.asarray(progress, jnp.int32)
+    state, rank, w, spans, code = jax.lax.while_loop(
+        cond,
+        body,
+        (state, rank, progress[0], jnp.int32(0), progress[3]),
+    )
+    progress_out = jnp.stack(
+        [w, jnp.min(state.pod_base), spans, code]
+    ).astype(jnp.int32)
+    return state, rank, progress_out
+
+
+_SUPERSPAN_STATICS = _STEP_STATICS + ("W", "K", "chunk")
+run_superspan = partial(jax.jit, static_argnames=_SUPERSPAN_STATICS)(
+    _run_superspan_impl
+)
+run_superspan_donated = jax.jit(
+    _run_superspan_impl,
+    static_argnames=_SUPERSPAN_STATICS,
     donate_argnums=(0,),
 )

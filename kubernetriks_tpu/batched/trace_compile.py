@@ -522,3 +522,46 @@ def compile_from_arrays(
         pod_names=pod_names,
         pod_groups=[],
     )
+
+
+def stage_segment(
+    full_pods: Dict[str, np.ndarray],
+    create_win: np.ndarray,
+    rank_full: Optional[np.ndarray],
+    lo: int,
+    width: int,
+) -> Dict[str, np.ndarray]:
+    """Staging-segment extraction for the superspan executor: numpy refill
+    payload columns [lo, lo + width) of the trace's PLAIN pod segment, ready
+    to become a device RefillStage (batched/state.py).
+
+    Columns past the trace end get the SAME fresh-slot padding the host
+    refill path produces — request 0, duration -1.0 (the long-running
+    service sentinel the pair conversion encodes), INT32_MAX create window
+    (never comes alive), BIG name rank — so a stage straddling the trace
+    boundary slides bit-identically to the full-resident payload. The ONE
+    owner of the staging column layout: the engine's whole-trace slide
+    payload (_init_device_slide) and its bounded stage buffers (_make_stage)
+    both assemble through here, so padding rules can never drift apart.
+    Duration stays float64 SECONDS here; the caller converts to the device
+    pair (duration_pair_np) after padding, exactly like the initial build.
+    """
+    no_create = np.iinfo(np.int32).max
+    BIG_RANK = np.int32(1 << 30)
+
+    def seg(arr: np.ndarray, fill, dtype) -> np.ndarray:
+        C = arr.shape[0]
+        out = np.full((C, width), fill, dtype)
+        src = arr[:, lo : lo + width]
+        out[:, : src.shape[1]] = src
+        return out
+
+    out = {
+        "req_cpu": seg(full_pods["req_cpu"], 0, np.int32),
+        "req_ram": seg(full_pods["req_ram"], 0, np.int32),
+        "duration": seg(full_pods["duration"], -1.0, np.float64),
+        "create_win": seg(create_win, no_create, np.int32),
+    }
+    if rank_full is not None:
+        out["rank"] = seg(rank_full, BIG_RANK, np.int32)
+    return out

@@ -175,6 +175,22 @@ def _sample_span(u: float, mean: float, distribution: str) -> float:
     return float(-mean * np.log1p(-np.float64(u)))
 
 
+def _sample_span_vec(
+    u: np.ndarray, mean: float, distribution: str
+) -> np.ndarray:
+    """Vectorized _sample_span over a float32 uniform array: the SAME f64
+    elementwise arithmetic (cast first, then -mean * log1p(-u)), so each
+    lane is bit-identical to the scalar call on its element."""
+    if distribution == "fixed":
+        return np.full(np.shape(u), float(mean), np.float64)
+    if distribution != "exponential":
+        raise ValueError(
+            f"unknown fault distribution {distribution!r} "
+            "(expected 'exponential' or 'fixed')"
+        )
+    return -float(mean) * np.log1p(-np.asarray(u, np.float64))
+
+
 def fault_horizon(cfg, cluster_events, workload_events) -> float:
     """Sampling horizon: explicit config value, else the latest finite trace
     timestamp (both paths hold the same traces, so both derive the same
@@ -261,6 +277,59 @@ def _chain(
     return pairs
 
 
+def _chains_batched(
+    seed: int,
+    stream: int,
+    cluster: int,
+    uids: Sequence[int],
+    t0s: Sequence[float],
+    ends: Sequence[float],
+    horizon: float,
+    mttf: float,
+    mttr: float,
+    distribution: str,
+    interval: float,
+) -> List[List[Tuple[float, float]]]:
+    """Crash/recover chains for MANY failure processes at once — the
+    vectorized twin of per-uid _chain calls, pinned bit-identical by
+    tests/test_chaos.py. The counter PRNG is order-independent, so one
+    threefry call per incarnation index draws (u_ttf, u_ttr) for EVERY
+    process; only the tiny incarnation loop stays sequential (chain times
+    accumulate), and each lane's float arithmetic is the scalar loop's
+    exact sequence (elementwise f64 adds in the same association). Draws
+    for already-terminated processes are computed and dropped — dropped
+    draws desync nothing by construction.
+
+    Replaces the host-side compile bottleneck for node-fault traces: the
+    loop version hashed 2 x incarnations x lifetimes blocks one scalar
+    threefry at a time through Python."""
+    U = len(uids)
+    pairs: List[List[Tuple[float, float]]] = [[] for _ in range(U)]
+    if U == 0:
+        return pairs
+    uid_arr = np.asarray(uids, np.uint32)
+    t = np.asarray(t0s, np.float64).copy()
+    end_arr = np.asarray(ends, np.float64)
+    cutoff = np.minimum(np.float64(horizon), end_arr)  # crash must stay below
+    active = np.ones(U, bool)
+    k = 0
+    while active.any():
+        u1, u2 = object_uniforms(
+            seed, stream, np.uint32(cluster), uid_arr, np.uint32(k)
+        )
+        ttf = np.maximum(_sample_span_vec(u1, mttf, distribution), interval)
+        crash = t + ttf
+        active &= crash < cutoff
+        ttr = np.maximum(_sample_span_vec(u2, mttr, distribution), interval)
+        recover = crash + ttr
+        active &= recover < end_arr
+        for i in np.nonzero(active)[0]:
+            pairs[i].append((float(crash[i]), float(recover[i])))
+        t = np.where(active, recover, t)
+        k += 1
+    return pairs
+
+
 def inject_node_faults(
     cluster_events,
     cfg,
@@ -316,40 +385,47 @@ def inject_node_faults(
             (recover, CreateNodeRequest(node=fresh, recovered=True))
         )
 
+    # Chain sampling is BATCHED across lifetimes (_chains_batched draws one
+    # threefry block per incarnation index for every process at once);
+    # emission order is unchanged — lifetimes in uid order, each chain in
+    # incarnation order — so the event stream is bit-identical to the
+    # per-lifetime loop (pinned in tests/test_chaos.py).
     if cfg.node is not None and cfg.node.mttf > 0:
-        for lt in lifetimes:
-            for crash, recover in _chain(
-                seed,
-                STREAM_NODE,
-                cluster_idx,
-                lt.uid,
-                lt.create_ts,
-                lt.remove_ts,
-                horizon,
-                cfg.node.mttf,
-                cfg.node.mttr,
-                cfg.node.distribution,
-                interval,
-            ):
+        chains = _chains_batched(
+            seed,
+            STREAM_NODE,
+            cluster_idx,
+            [lt.uid for lt in lifetimes],
+            [lt.create_ts for lt in lifetimes],
+            [lt.remove_ts for lt in lifetimes],
+            horizon,
+            cfg.node.mttf,
+            cfg.node.mttr,
+            cfg.node.distribution,
+            interval,
+        )
+        for lt, chain in zip(lifetimes, chains):
+            for crash, recover in chain:
                 emit_pair(lt, crash, recover)
 
     # Correlated failure groups: one shared crash process per group; every
     # member whose lifetime covers the full (crash, recover) span goes down
-    # and comes back together (blast radius).
+    # and comes back together (blast radius). Groups carry their own
+    # mttf/mttr, so each is its own (single-process) batched call.
     for gi, group in enumerate(cfg.failure_groups or []):
-        for crash, recover in _chain(
+        for crash, recover in _chains_batched(
             seed,
             STREAM_GROUP,
             cluster_idx,
-            gi,
-            0.0,
-            np.inf,
+            [gi],
+            [0.0],
+            [np.inf],
             horizon,
             group.mttf,
             group.mttr,
             group.distribution,
             interval,
-        ):
+        )[0]:
             for name in group.members:
                 for lt in by_name.get(name, []):
                     if (

@@ -41,6 +41,7 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.experimental import enable_x64 as jax_enable_x64_ctx
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -48,6 +49,9 @@ _NEG_INF = float(np.float32(-np.inf))
 
 _LANE = 128  # clusters per grid program (lane tile)
 _SUB = 8  # f32/i32 sublane tile
+
+# pltpu.CompilerParams in newer JAX, TPUCompilerParams in the 0.4.x line.
+_COMPILER_PARAMS = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
 
 
 def default_enabled() -> bool:
@@ -344,7 +348,7 @@ def fused_select_schedule_cycle(
     cand_spec = pl.BlockSpec((Kp, _LANE), lambda i: (0, i), memory_space=pltpu.VMEM)
 
     kernel = functools.partial(_select_cycle_kernel, N, K)
-    with jax.enable_x64(False):
+    with jax_enable_x64_ctx(False):
         cpu_o, ram_o, cand_o, valid_o, assign_o, fitany_o, best_o = pl.pallas_call(
             kernel,
             grid=(Cp // _LANE,),
@@ -360,7 +364,7 @@ def fused_select_schedule_cycle(
                 jax.ShapeDtypeStruct((Kp, Cp), jnp.int32),
             ],
             scratch_shapes=[pltpu.VMEM((Pp, _LANE), jnp.int32)],
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_COMPILER_PARAMS(
                 vmem_limit_bytes=_SELECT_VMEM_LIMIT
             ),
             interpret=interpret,
@@ -502,7 +506,7 @@ def fused_free_resources(
     pod_spec = pl.BlockSpec((Pp, _LANE), lambda i: (0, i), memory_space=pltpu.VMEM)
     stats_spec = pl.BlockSpec((8, _LANE), lambda i: (0, i), memory_space=pltpu.VMEM)
 
-    with jax.enable_x64(False):
+    with jax_enable_x64_ctx(False):
         acpu_o, aram_o, stats_o = pl.pallas_call(
             _free_kernel,
             grid=(Cp // _LANE,),
@@ -514,7 +518,7 @@ def fused_free_resources(
                 jax.ShapeDtypeStruct((8, Cp), jnp.float32),
             ],
             scratch_shapes=[pltpu.VMEM((Pp, _LANE), jnp.int32)],
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_COMPILER_PARAMS(
                 vmem_limit_bytes=_SELECT_VMEM_LIMIT
             ),
             interpret=interpret,
@@ -665,14 +669,14 @@ def fused_event_scatter(
         jax.ShapeDtypeStruct((Pp, Cp), jnp.int32),
         jax.ShapeDtypeStruct((Pp, Cp), jnp.float32),
     ]
-    with jax.enable_x64(False):
+    with jax_enable_x64_ctx(False):
         created_o, nrm_o, pcr_o, pseq_o, prm_o = pl.pallas_call(
             _event_kernel,
             grid=(Cp // _LANE,),
             in_specs=[spec(Ep)] * 5 + [spec(Np)] * 2 + [spec(Pp)] * 3,
             out_specs=[spec(Np)] * 2 + [spec(Pp)] * 3,
             out_shape=shapes,
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_COMPILER_PARAMS(
                 vmem_limit_bytes=_SELECT_VMEM_LIMIT
             ),
             interpret=interpret,
@@ -800,7 +804,7 @@ def fused_commit_scatter(
     def spec(n_sub):
         return pl.BlockSpec((n_sub, _LANE), lambda i: (0, i), memory_space=pltpu.VMEM)
 
-    with jax.enable_x64(False):
+    with jax_enable_x64_ctx(False):
         phase_o, node_o, start_o, park_o = pl.pallas_call(
             _commit_kernel,
             grid=(Cp // _LANE,),
@@ -812,7 +816,7 @@ def fused_commit_scatter(
                 jax.ShapeDtypeStruct((Pp, Cp), jnp.float32),
                 jax.ShapeDtypeStruct((Pp, Cp), jnp.float32),
             ],
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_COMPILER_PARAMS(
                 vmem_limit_bytes=_SELECT_VMEM_LIMIT
             ),
             interpret=interpret,
@@ -876,7 +880,9 @@ def fused_schedule_cycle(
     # jax_enable_x64 for its f64 time arrays, but under x64 pallas_call's own
     # index bookkeeping traces as i64, which Mosaic fails to legalize
     # (func.return). Everything crossing this boundary is i32/bool.
-    with jax.enable_x64(False):
+    # (jax.experimental.enable_x64: the installed 0.4.x has no top-level
+    # jax.enable_x64.)
+    with jax_enable_x64_ctx(False):
         cpu_o, ram_o, assign_o, fitany_o, best_o = pl.pallas_call(
             kernel,
             grid=(Cp // _LANE,),
@@ -1121,7 +1127,7 @@ def fused_select_cycle_commit(
     stat_spec = pl.BlockSpec((8, _LANE), lambda i: (0, i), memory_space=pltpu.VMEM)
 
     kernel = functools.partial(_select_cycle_commit_kernel, N, K)
-    with jax.enable_x64(False):
+    with jax_enable_x64_ctx(False):
         (cpu_o, ram_o, phase_o, node_o, start_o, park_o, stats_o) = pl.pallas_call(
             kernel,
             grid=(Cp // _LANE,),
@@ -1137,7 +1143,7 @@ def fused_select_cycle_commit(
                 jax.ShapeDtypeStruct((8, Cp), jnp.float32),
             ],
             scratch_shapes=[pltpu.VMEM((Pp, _LANE), jnp.int32)],
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_COMPILER_PARAMS(
                 vmem_limit_bytes=_SELECT_VMEM_LIMIT
             ),
             interpret=interpret,

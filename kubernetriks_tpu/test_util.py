@@ -50,3 +50,35 @@ def check_expected_node_appeared_in_components(
     assert kube_sim.api_server.get_node_component(node_name) is not None
     assert kube_sim.persistent_storage.get_node(node_name) is not None
     kube_sim.scheduler.get_node(node_name)
+
+
+# --- Alibaba CSV real-format quirk rendering (shared by the Python-oracle
+# and native-feeder quirk suites, so both always test the SAME quirked
+# input) --------------------------------------------------------------------
+
+ALIBABA_INSTANCE_HEADER = (
+    "start_ts,end_ts,job_id,task_id,machine_id,status,seq_no,total_seq_no"
+)
+ALIBABA_TASK_HEADER = (
+    "create_ts,end_ts,job_id,task_id,inst_num,status,plan_cpu,plan_mem"
+)
+ALIBABA_MACHINE_HEADER = "ts,machine_id,event_type,event_detail,cap_cpu,cap_mem"
+
+
+def quirkify_csv(text, crlf=False, quote=False, header=None):
+    """Re-render a clean CSV body with real-format quirks: quote every other
+    field (RFC4180 — including empty fields, which stay empty), prepend an
+    optional header row, and optionally join with CRLF endings."""
+    lines = text.strip("\n").split("\n")
+    if quote:
+        lines = [
+            ",".join(
+                f'"{f}"' if (li + fi) % 2 == 0 else f
+                for fi, f in enumerate(line.split(","))
+            )
+            for li, line in enumerate(lines)
+        ]
+    if header is not None:
+        lines.insert(0, header)
+    eol = "\r\n" if crlf else "\n"
+    return eol.join(lines) + eol

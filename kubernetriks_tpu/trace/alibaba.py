@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import csv
 import io
+import re
 from dataclasses import dataclass
 from typing import Dict, List, Optional
 
@@ -22,6 +23,37 @@ from kubernetriks_tpu.trace.interface import Trace, TraceEvents
 # (reference: src/trace/alibaba_cluster_trace_v2017/common.rs:1-6).
 DENORMALIZATION_BASE = 128 * 1024**3
 CPU_BASE = 1000
+
+
+# ASCII integer-literal syntax (optional sign, digits, single underscores
+# BETWEEN digits) — the header rule's integer test. ASCII-only on purpose:
+# Python's int() also accepts Unicode digits, which the native feeder's
+# byte-level scan (LooksLikePythonInt) cannot see, so the shared rule pins
+# the ASCII subset both sides implement identically.
+_ASCII_INT_RE = re.compile(r"[+-]?[0-9](?:_?[0-9])*")
+
+
+def _data_rows(text: str):
+    """CSV rows of a real-format Alibaba dump, tolerant of the quirks the
+    circulating files actually carry: CRLF line endings and quoted fields
+    (both handled by the csv module's RFC4180 state machine) plus an
+    OPTIONAL header line. Header rule, shared verbatim with the native
+    feeder (native/trace_feeder.cc IsHeaderRow): the FIRST row is a header
+    iff its first field (ASCII-whitespace-trimmed) is non-empty and not an
+    ASCII integer literal — every data row's first column is either an
+    integer timestamp or empty (batch_instance's optional start_ts), while
+    header names never are. Only the first row is eligible, so a malformed
+    later row still surfaces as a parse error."""
+    first = True
+    for row in csv.reader(io.StringIO(text)):
+        if not row:
+            continue
+        if first:
+            first = False
+            head = row[0].strip(" \t\f\v")
+            if head and not _ASCII_INT_RE.fullmatch(head):
+                continue
+        yield row
 
 
 def _opt_int(value: str) -> Optional[int]:
@@ -90,9 +122,7 @@ def read_batch_tasks(text: str) -> Dict[int, BatchTask]:
     """task_id-keyed; duplicate task ids are an input error
     (reference: workload.rs:152-166)."""
     tasks: Dict[int, BatchTask] = {}
-    for row in csv.reader(io.StringIO(text)):
-        if not row:
-            continue
+    for row in _data_rows(text):
         task = BatchTask.from_row(row)
         if task.task_id in tasks:
             raise ValueError(f"duplicated task id: {task.task_id}")
@@ -101,7 +131,7 @@ def read_batch_tasks(text: str) -> Dict[int, BatchTask]:
 
 
 def read_batch_instances(text: str) -> List[BatchInstance]:
-    return [BatchInstance.from_row(row) for row in csv.reader(io.StringIO(text)) if row]
+    return [BatchInstance.from_row(row) for row in _data_rows(text)]
 
 
 class AlibabaWorkloadTraceV2017(Trace):
@@ -198,7 +228,7 @@ class MachineEvent:
 
 
 def read_machine_events(text: str) -> List[MachineEvent]:
-    return [MachineEvent.from_row(row) for row in csv.reader(io.StringIO(text)) if row]
+    return [MachineEvent.from_row(row) for row in _data_rows(text)]
 
 
 class AlibabaClusterTraceV2017(Trace):

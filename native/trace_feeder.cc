@@ -47,8 +47,12 @@ struct OptF64 {
   bool present = false;
 };
 
-// One CSV line split into fields (no quoting in the Alibaba traces; the
-// reference's csv crate is configured with default comma framing too).
+// One CSV line split into fields. Real-format Alibaba dumps circulate with
+// RFC4180 quirks the reference's csv crate also absorbs: quoted fields
+// (commas inside quotes, "" escaping a literal quote) and CRLF endings
+// (ReadLines strips the \r). Quoted fields with EMBEDDED newlines are not
+// supported — none of the circulating traces use them and line framing
+// happens before field splitting.
 struct Row {
   std::vector<std::string> fields;
 };
@@ -95,16 +99,76 @@ bool ReadLines(const std::string& path, std::vector<std::string>* lines,
 
 void SplitCsv(const std::string& line, Row* row) {
   row->fields.clear();
-  size_t start = 0;
+  size_t i = 0;
+  std::string field;
   while (true) {
-    size_t comma = line.find(',', start);
-    if (comma == std::string::npos) {
-      row->fields.emplace_back(line, start, line.size() - start);
-      break;
+    field.clear();
+    if (i < line.size() && line[i] == '"') {
+      // Quoted field: runs to the matching quote; "" is a literal quote.
+      ++i;
+      while (i < line.size()) {
+        if (line[i] == '"') {
+          if (i + 1 < line.size() && line[i + 1] == '"') {
+            field.push_back('"');
+            i += 2;
+          } else {
+            ++i;  // closing quote
+            break;
+          }
+        } else {
+          field.push_back(line[i++]);
+        }
+      }
+      // Trailing unquoted residue after a closing quote (malformed input)
+      // rides along verbatim, like Python's csv reader.
+      while (i < line.size() && line[i] != ',') field.push_back(line[i++]);
+    } else {
+      while (i < line.size() && line[i] != ',') field.push_back(line[i++]);
     }
-    row->fields.emplace_back(line, start, comma - start);
-    start = comma + 1;
+    row->fields.push_back(field);
+    if (i >= line.size()) break;
+    ++i;  // skip the comma
   }
+}
+
+// ASCII integer-literal syntax: optional sign, then digits with single
+// underscores allowed BETWEEN digits — trace/alibaba.py's _ASCII_INT_RE,
+// byte for byte (the Python side deliberately restricts itself to the
+// ASCII subset so this scan can match it exactly; Unicode digits are a
+// header on BOTH sides). A pure syntax test — Python ints are unbounded,
+// so an out-of-int64-range digit string is still an integer (a DATA row);
+// strtoll's ERANGE must not reclassify it.
+bool LooksLikePythonInt(const std::string& s) {
+  size_t i = 0;
+  if (i < s.size() && (s[i] == '+' || s[i] == '-')) ++i;
+  if (i >= s.size()) return false;
+  bool prev_digit = false;
+  for (; i < s.size(); ++i) {
+    char c = s[i];
+    if (c >= '0' && c <= '9') {
+      prev_digit = true;
+    } else if (c == '_') {
+      if (!prev_digit) return false;
+      prev_digit = false;
+    } else {
+      return false;
+    }
+  }
+  return prev_digit;
+}
+
+// Header rule shared verbatim with the Python parsers (trace/alibaba.py
+// _data_rows): the FIRST row of a file is a header iff its first field is
+// non-empty and not an integer — data rows lead with an integer timestamp
+// or an empty optional field, header names never do. Whitespace-trimmed
+// like Python's str.strip before the test.
+bool IsHeaderRow(const Row& row) {
+  if (row.fields.empty()) return false;
+  const std::string& raw = row.fields[0];
+  size_t b = raw.find_first_not_of(" \t\f\v");
+  if (b == std::string::npos) return false;  // empty/blank -> data row
+  size_t e = raw.find_last_not_of(" \t\f\v");
+  return !LooksLikePythonInt(raw.substr(b, e - b + 1));
 }
 
 bool ParseI64(const std::string& s, int64_t* out, std::string* error,
@@ -200,8 +264,13 @@ Handle* feeder_parse_workload(const char* instance_path,
   std::unordered_map<int64_t, TaskInfo> tasks;
   tasks.reserve(task_lines.size() * 2);
   Row row;
+  bool first_task = true;
   for (const std::string& line : task_lines) {
     SplitCsv(line, &row);
+    if (first_task) {
+      first_task = false;
+      if (IsHeaderRow(row)) continue;
+    }
     if (row.fields.size() < 6) {
       return Fail(h, "batch_task row has fewer than 6 fields: " + line);
     }
@@ -235,8 +304,13 @@ Handle* feeder_parse_workload(const char* instance_path,
 
   int64_t pod_counter = 0;
   h->start_ts.reserve(inst_lines.size());
+  bool first_inst = true;
   for (const std::string& line : inst_lines) {
     SplitCsv(line, &row);
+    if (first_inst) {
+      first_inst = false;
+      if (IsHeaderRow(row)) continue;
+    }
     if (row.fields.size() < 8) {
       return Fail(h, "batch_instance row has fewer than 8 fields: " + line);
     }
@@ -312,8 +386,13 @@ Handle* feeder_parse_machines(const char* machine_events_path) {
 
   std::unordered_set<int64_t> created, removed;
   Row row;
+  bool first_machine = true;
   for (const std::string& line : lines) {
     SplitCsv(line, &row);
+    if (first_machine) {
+      first_machine = false;
+      if (IsHeaderRow(row)) continue;
+    }
     if (row.fields.size() < 3) {
       return Fail(h, "machine_events row has fewer than 3 fields: " + line);
     }

@@ -147,3 +147,79 @@ def test_generators():
     assert 50 < len(pevents) < 200
     cluster = UniformClusterTrace(10)
     assert len(cluster.convert_to_simulator_events()) == 10
+
+
+# ---------------------------------------------------------------------------
+# Real-format CSV quirks: the circulating Alibaba dumps carry CRLF line
+# endings, RFC4180-quoted fields and (sometimes) a header line. The parser
+# must absorb all three — and the header rule (first row only, first field
+# non-empty and non-integer) must never eat a data row.
+# ---------------------------------------------------------------------------
+
+INSTANCE_BASE = (
+    "41562,41618,120,686,299,Terminated,1,1\n"
+    ",41618,120,686,,Interrupted,1,1\n"  # optional start/machine empty
+    "41563,41620,120,686,300,Terminated,2,2\n"
+)
+TASK_BASE = (
+    "10718,12897,15,64,2003,Terminated,50,0.016007\n"
+    "10720,12899,15,65,1,Waiting,,\n"
+)
+MACHINE_BASE = "10,1,add,,64,0.69\n50,1,softerror,links_broken,,\n"
+
+from kubernetriks_tpu.test_util import (
+    ALIBABA_INSTANCE_HEADER as INSTANCE_HEADER,
+    ALIBABA_TASK_HEADER as TASK_HEADER,
+    ALIBABA_MACHINE_HEADER as MACHINE_HEADER,
+    quirkify_csv as _quirkify,
+)
+
+
+QUIRKS = [
+    dict(crlf=True),
+    dict(quote=True),
+    dict(crlf=True, quote=True),
+    "header",
+    "header+crlf+quote",
+]
+
+
+@pytest.mark.parametrize("quirk", QUIRKS, ids=str)
+def test_csv_quirks_parse_identically(quirk):
+    for base, header, read in (
+        (INSTANCE_BASE, INSTANCE_HEADER, read_batch_instances),
+        (TASK_BASE, TASK_HEADER, read_batch_tasks),
+        (MACHINE_BASE, MACHINE_HEADER, read_machine_events),
+    ):
+        if quirk == "header":
+            kw = dict(header=header)
+        elif quirk == "header+crlf+quote":
+            kw = dict(header=header, crlf=True, quote=True)
+        else:
+            kw = quirk
+        assert read(_quirkify(base, **kw)) == read(base), (quirk, header)
+
+
+def test_first_row_with_empty_leading_field_is_data_not_header():
+    """batch_instance's start_ts is OPTIONAL: a file whose first row has an
+    empty first field must parse as data (the header rule requires a
+    non-empty, non-integer first field)."""
+    rows = read_batch_instances(",41618,120,686,,Interrupted,1,1\n")
+    assert len(rows) == 1 and rows[0].start_timestamp is None
+
+
+def test_header_rule_applies_to_first_row_only():
+    """A malformed non-integer first field PAST row one is a parse error,
+    not a silently skipped header."""
+    with pytest.raises(ValueError):
+        read_batch_tasks(
+            "10718,12897,15,64,2003,Terminated,50,0.016\n"
+            "oops,12899,15,65,1,Waiting,,\n"
+        )
+
+
+def test_quoted_field_with_embedded_comma():
+    """RFC4180 quoting protects commas inside fields (machine event_detail
+    free text is where real dumps use it)."""
+    events = read_machine_events('50,1,softerror,"links, broken",,\n')
+    assert events[0].event_detail == "links, broken"

@@ -1,50 +1,62 @@
-"""bench.py --smoke: the CPU-safe plumbing check for the three tracked
-bench lines (continuity shape, composed flagship, north-star stand-in).
-Asserts all three lines build, RUN their full machinery — the composed
-line includes real window slides, HPA scale-ups and CA provisioning, the
-same in-bench asserts the flagship line enforces on hardware — and emit
-parseable JSON with the headline fields. Values are not performance
-numbers; tier-1 runs this under JAX_PLATFORMS=cpu (conftest pins it)."""
+"""bench.py --smoke: the CPU-safe plumbing check for the tracked bench
+lines (continuity shape, composed flagship, superspan machinery,
+north-star stand-in). Asserts every line builds, RUNS its full machinery —
+the composed lines include real window slides, HPA scale-ups and CA
+provisioning, the same in-bench asserts the flagship line enforces on
+hardware; the superspan line additionally asserts the SCANNED executor
+dispatched (so CI catches a silent fallback to the ladder path) — and
+emits parseable JSON with the headline fields. Composed lines time >= 5
+repeated spans and carry the median + min/max spread. Values are not
+performance numbers; tier-1 runs this under JAX_PLATFORMS=cpu (conftest
+pins it)."""
 
 import json
 import os
 import sys
 
 
-def test_bench_smoke_emits_three_parseable_lines(capsys):
+def _smoke_records(capsys, args):
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     import bench
 
-    bench.main(["--smoke"])
+    bench.main(args)
     lines = [
         ln for ln in capsys.readouterr().out.strip().splitlines() if ln.strip()
     ]
-    assert len(lines) == 3, lines
     records = [json.loads(ln) for ln in lines]
     for rec in records:
-        assert set(rec) == {"metric", "value", "unit", "vs_baseline"}
+        assert set(rec) - {"spans"} == {"metric", "value", "unit", "vs_baseline"}
         assert rec["unit"] == "decisions/s"
         assert rec["value"] > 0
         # Smoke values are toy-shape numbers; the rounded-to-3-decimals
         # ratio can legitimately print as 0.0.
         assert rec["vs_baseline"] >= 0
-    # Line order is part of the contract: continuity, composed, north-star
-    # (the LAST line is the headline the driver reads).
+    return records
+
+
+def test_bench_smoke_emits_four_parseable_lines(capsys):
+    records = _smoke_records(capsys, ["--smoke"])
+    assert len(records) == 4, records
+    # Line order is part of the contract: continuity, composed, superspan
+    # machinery, north-star (the LAST line is the headline the driver
+    # reads).
     assert "composed" in records[1]["metric"]
-    assert "north-star" in records[2]["metric"]
+    assert "superspan" in records[2]["metric"]
+    assert "north-star" in records[3]["metric"]
+    # Composed lines report the >= 5-span median with min/max spread; the
+    # plain-shape lines keep the bare single-region value.
+    for rec in records[1:3]:
+        spans = rec["spans"]
+        assert spans["n"] >= 5
+        assert spans["min"] <= rec["value"] <= spans["max"]
+    assert "spans" not in records[0] and "spans" not in records[3]
 
 
 def test_bench_smoke_faults_adds_chaos_line(capsys):
     """--faults appends a fault-enabled composed smoke line (the chaos
-    engine's dispatch/throughput tracker) after the standard three."""
-    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-    import bench
-
-    bench.main(["--smoke", "--faults"])
-    lines = [
-        ln for ln in capsys.readouterr().out.strip().splitlines() if ln.strip()
-    ]
-    assert len(lines) == 4, lines
-    records = [json.loads(ln) for ln in lines]
-    assert "chaos" in records[3]["metric"]
-    assert records[3]["value"] > 0
+    engine's dispatch/throughput tracker) after the standard four."""
+    records = _smoke_records(capsys, ["--smoke", "--faults"])
+    assert len(records) == 5, records
+    assert "chaos" in records[4]["metric"]
+    assert records[4]["value"] > 0
+    assert records[4]["spans"]["n"] >= 5

@@ -401,3 +401,128 @@ def test_real_alibaba_cluster_native_matches_python():
             assert nev.node.status.capacity.ram == pev.node.status.capacity.ram
         else:
             assert nev.node_name == pev.node_name
+
+
+# ---------------------------------------------------------------------------
+# Real-format CSV quirks (CRLF endings, RFC4180-quoted fields, optional
+# header): the native feeder's SplitCsv/IsHeaderRow must match the Python
+# oracle's csv-module + _data_rows behavior on the same quirked files.
+# ---------------------------------------------------------------------------
+
+from kubernetriks_tpu.test_util import (
+    ALIBABA_INSTANCE_HEADER as INSTANCE_HEADER,
+    ALIBABA_TASK_HEADER as TASK_HEADER,
+    ALIBABA_MACHINE_HEADER as MACHINE_HEADER,
+    quirkify_csv as _quirkify,
+)
+
+
+def _assert_workload_matches(native, python):
+    assert len(native) == len(python)
+    for (nts, nev), (pts, pev) in zip(native, python):
+        assert nts == pts
+        assert nev.pod.metadata.name == pev.pod.metadata.name
+        assert nev.pod.spec.resources.requests.cpu == pev.pod.spec.resources.requests.cpu
+        assert nev.pod.spec.resources.requests.ram == pev.pod.spec.resources.requests.ram
+        assert nev.pod.spec.running_duration == pev.pod.spec.running_duration
+
+
+QUIRK_CASES = [
+    dict(crlf=True),
+    dict(quote=True),
+    dict(crlf=True, quote=True),
+    dict(header=True),
+    dict(header=True, crlf=True, quote=True),
+]
+
+
+@pytest.mark.parametrize("quirk", QUIRK_CASES, ids=str)
+def test_workload_csv_quirks_native_matches_python(tmp_path, quirk):
+    kw = dict(quirk)
+    use_header = kw.pop("header", False)
+    inst_text = _quirkify(
+        WORKLOAD_INSTANCES, header=INSTANCE_HEADER if use_header else None, **kw
+    )
+    task_text = _quirkify(
+        WORKLOAD_TASKS, header=TASK_HEADER if use_header else None, **kw
+    )
+    inst = _write(tmp_path, "bi.csv", inst_text)
+    task = _write(tmp_path, "bt.csv", task_text)
+
+    native = feeder.workload_events_from_arrays(
+        feeder.load_workload_arrays(inst, task)
+    )
+    python = _python_workload_events(inst_text, task_text)
+    assert len(native) == 4  # quirks change NOTHING about the join/filter
+    _assert_workload_matches(native, python)
+
+
+@pytest.mark.parametrize("quirk", QUIRK_CASES, ids=str)
+def test_cluster_csv_quirks_native_matches_python(tmp_path, quirk):
+    kw = dict(quirk)
+    use_header = kw.pop("header", False)
+    text = _quirkify(
+        MACHINE_EVENTS, header=MACHINE_HEADER if use_header else None, **kw
+    )
+    path = _write(tmp_path, "me.csv", text)
+
+    native = feeder.cluster_events_from_arrays(feeder.load_cluster_arrays(path))
+    python = _python_cluster_events(text)
+    assert len(native) == len(python) == 5
+    for (nts, nev), (pts, pev) in zip(native, python):
+        assert nts == pts
+        assert type(nev) is type(pev)
+
+
+def test_native_quoted_field_with_embedded_comma(tmp_path):
+    """RFC4180: commas inside quotes are field content ("" is a literal
+    quote) — the machine event_detail free-text column is where real dumps
+    use both."""
+    text = '10,1,add,,64,0.69\n50,1,softerror,"links, ""b"" broken",,\n'
+    path = _write(tmp_path, "me.csv", text)
+    native = feeder.cluster_events_from_arrays(feeder.load_cluster_arrays(path))
+    python = _python_cluster_events(text)
+    assert len(native) == len(python) == 2
+    assert isinstance(native[1][1], RemoveNodeRequest)
+
+
+def test_native_first_row_empty_leading_field_is_data(tmp_path):
+    """An empty first field on row one is DATA (batch_instance's optional
+    start_ts), not a header — the row must flow through the join/filter
+    exactly as the Python oracle drops it (no start -> filtered), without
+    desyncing the rows behind it."""
+    inst_text = (
+        ",41618,1,10,299,Interrupted,1,2\n"       # empty start: data, filtered
+        "41562,41618,1,10,299,Terminated,1,2\n"   # survives
+    )
+    task_text = "100,200,1,10,2,Terminated,50,0.015625\n"
+    inst = _write(tmp_path, "bi.csv", inst_text)
+    task = _write(tmp_path, "bt.csv", task_text)
+    native = feeder.workload_events_from_arrays(
+        feeder.load_workload_arrays(inst, task)
+    )
+    python = _python_workload_events(inst_text, task_text)
+    assert len(native) == 1
+    _assert_workload_matches(native, python)
+
+
+def test_native_non_ascii_digit_first_row_is_header_on_both_sides(tmp_path):
+    """The header rule's integer test is the ASCII subset on BOTH sides: a
+    first row leading with full-width digits (which Python's bare int()
+    would happily parse, but a byte-level C scan cannot) is a header for
+    the Python oracle AND the native feeder, so the two parses never desync
+    by a row. Pins the _ASCII_INT_RE / LooksLikePythonInt equivalence at
+    its one divergence-prone edge."""
+    inst_text = (
+        "４１５６２,41618,1,10,299,Terminated,1,2\n"
+        "41562,41618,1,10,299,Terminated,1,2\n"   # survives on both sides
+    )
+    task_text = "100,200,1,10,2,Terminated,50,0.015625\n"
+    inst = _write(tmp_path, "bi.csv", inst_text)
+    task = _write(tmp_path, "bt.csv", task_text)
+    native = feeder.workload_events_from_arrays(
+        feeder.load_workload_arrays(inst, task)
+    )
+    python = _python_workload_events(inst_text, task_text)
+    assert len(native) == len(python) == 1
+    _assert_workload_matches(native, python)

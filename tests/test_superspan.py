@@ -1,0 +1,248 @@
+"""Device-resident superspan executor (step.run_superspan).
+
+The superspan path runs up to K consecutive slide-spans entirely on device —
+window chunks, shift computation, quantization and slide application inside
+ONE while_loop, refill columns drawn from a device-resident staging slab —
+and must be BIT-IDENTICAL to the ladder path it replaces:
+
+1. Composed flagship run (HPA + CA + sliding pod window), superspan ON vs
+   the unfused two-dispatch-slide ladder: every state leaf exact, metrics
+   exact, same slide trajectory — fault-free AND with fault_injection
+   enabled (the commit-time threefry draws are slot-keyed and
+   slide-invariant, so the on-device slides must not perturb them).
+2. The bounded RefillStage path (whole-trace payload over budget): staging
+   installs, the double-buffered successor, and the SUPERSPAN_STAGE
+   mid-flight exhaustion exit all preserve bit-identity.
+3. The SUPERSPAN_GROW exit: a dense stretch with no terminal leading pod
+   must grow the window in place, matching the full-resident run.
+4. precompile_chunks warms the ONE superspan program instead of the ladder.
+"""
+
+import numpy as np
+import pytest
+
+import kubernetriks_tpu.batched.engine as engine_mod
+from kubernetriks_tpu.batched.state import compare_states
+from kubernetriks_tpu.test_util import default_test_simulation_config
+
+from test_pod_window_growth import _build as _build_growth
+from test_pod_window_growth import _long_running_workload
+from test_window_donation_dispatch import _build_composed
+
+FAULT_SUFFIX = """
+fault_injection:
+  enabled: true
+  node:
+    mttf: 2500.0
+    mttr: 120.0
+  pod:
+    fail_prob: 0.12
+    backoff_base: 10.0
+    backoff_cap: 300.0
+    restart_limit: 3
+"""
+
+
+def _run(sim, ends=(150.0, 300.0, 450.0)):
+    for end in ends:
+        sim.step_until_time(end)
+    return sim
+
+
+def _assert_superspan_matches_ladder(ss, ladder):
+    # The superspan path really ran (and never silently fell back to the
+    # ladder), and the run exercised slides — otherwise parity is vacuous.
+    assert ss.dispatch_stats["superspans"] > 0
+    assert ss.dispatch_stats["window_chunks"] == 0
+    assert ss._pod_base > 0
+    assert ladder.dispatch_stats["superspans"] == 0
+
+    assert ss._pod_base == ladder._pod_base
+    assert ss.next_window_idx == ladder.next_window_idx
+    assert compare_states(ss.state, ladder.state) == []
+    assert ss.metrics_summary() == ladder.metrics_summary()
+    if ss.autoscale_statics is not None:
+        # The carried windowed name ranks land back in the statics.
+        np.testing.assert_array_equal(
+            np.asarray(ss.autoscale_statics.pod_name_rank),
+            np.asarray(ladder.autoscale_statics.pod_name_rank),
+        )
+
+
+def test_superspan_composed_bit_identical():
+    """Flagship composition: superspan ON (donated, whole-trace payload) ==
+    the plain two-dispatch-slide ladder, bit for bit."""
+    ss = _run(
+        _build_composed(superspan=True, superspan_k=4, superspan_chunk=4)
+    )
+    assert ss._superspan_ok()
+    ladder = _run(_build_composed(donate=False, fuse_slide=False))
+    _assert_superspan_matches_ladder(ss, ladder)
+    # Steady-state sync economy: one progress readback per superspan
+    # dispatch, nothing else.
+    assert ss.dispatch_stats["slide_syncs"] == ss.dispatch_stats["superspans"]
+
+
+def test_superspan_composed_bit_identical_under_faults():
+    """Same flagship parity with the chaos engine on: node crash chains ride
+    the slab, pod-attempt threefry draws happen at commit inside the scanned
+    windows — the on-device slides must leave every draw slot-keyed exactly
+    as the ladder path sees it."""
+    ss = _run(
+        _build_composed(
+            config_suffix=FAULT_SUFFIX,
+            superspan=True,
+            superspan_k=4,
+            superspan_chunk=4,
+        )
+    )
+    assert ss.fault_params is not None
+    ladder = _run(
+        _build_composed(
+            config_suffix=FAULT_SUFFIX, donate=False, fuse_slide=False
+        )
+    )
+    counters = ss.metrics_summary()["counters"]
+    assert counters["pod_interruptions"] + counters["pods_failed"] > 0, (
+        "fault run produced no faults; parity under faults is vacuous"
+    )
+    _assert_superspan_matches_ladder(ss, ladder)
+
+
+def test_superspan_bounded_stage_and_exhaustion_exit(monkeypatch):
+    """Over-budget traces stage refill columns through bounded RefillStage
+    slabs. A minimal-width stage (W + W/2) exhausts after a single max
+    slide, forcing SUPERSPAN_STAGE exits and restages mid-run — the end
+    state must still match the ladder, and the engine must never spin on an
+    exhausted buffer (the regression this test pins: _stage_covers accepts
+    a stage with zero slide headroom left)."""
+    monkeypatch.setattr(engine_mod, "_DEVICE_SLIDE_BUDGET_BYTES", 0)
+    ss = _build_composed(
+        superspan=True,
+        superspan_k=8,
+        superspan_chunk=4,
+        superspan_stage_cols=96,  # W=64: minimum width, 32 columns headroom
+        fuse_slide=False,
+    )
+    assert ss._device_slide is None, "budget monkeypatch did not take"
+    _run(ss)
+    monkeypatch.setattr(engine_mod, "_DEVICE_SLIDE_BUDGET_BYTES", 2 << 30)
+    ladder = _run(_build_composed(donate=False, fuse_slide=False))
+    _assert_superspan_matches_ladder(ss, ladder)
+    # The initial install plus at least one mid-run restage happened.
+    assert ss.dispatch_stats["stage_refills"] >= 2
+
+
+def test_superspan_grow_exit_matches_resident():
+    """SUPERSPAN_GROW: long-running pods leave no terminal leading slot, so
+    the scanned loop reports shift == 0 and the engine grows the window in
+    place — same counters and terminal phases as the full-resident run."""
+    workload = _long_running_workload(n_pods=120, duration=600.0)
+    ss = _build_growth(
+        workload,
+        pod_window=64,
+        superspan=True,
+        superspan_k=4,
+        superspan_chunk=4,
+        fast_forward=False,
+    )
+    ss.step_until_time(1200.0)
+    assert ss.pod_window == 120, "window never grew"
+    assert ss.dispatch_stats["superspans"] > 0
+    ref = _build_growth(workload, fast_forward=False)
+    ref.step_until_time(1200.0)
+    assert (
+        ss.metrics_summary()["counters"] == ref.metrics_summary()["counters"]
+    )
+    P_real = np.asarray(ss.state.pods.phase).shape[1]
+    np.testing.assert_array_equal(
+        np.asarray(ref.state.pods.phase)[:, :P_real],
+        np.asarray(ss.state.pods.phase),
+    )
+
+
+def test_precompile_warms_superspan_program():
+    """A superspan engine warms exactly ONE program shape (the scanned loop
+    serves every span/target); the warm dispatch must not perturb state or
+    host mirrors."""
+    ss = _build_composed(superspan=True, superspan_k=4, superspan_chunk=4)
+    before = (ss.next_window_idx, ss._pod_base)
+    snap = {
+        k: np.asarray(v).copy()
+        for k, v in (("phase", ss.state.pods.phase), ("time", ss.state.time))
+    }
+    assert ss.precompile_chunks() == 1
+    assert (ss.next_window_idx, ss._pod_base) == before
+    np.testing.assert_array_equal(np.asarray(ss.state.pods.phase), snap["phase"])
+    np.testing.assert_array_equal(np.asarray(ss.state.time), snap["time"])
+    # And the warmed program is the one the loop then uses: no ladder chunks.
+    _run(ss)
+    assert ss.dispatch_stats["window_chunks"] == 0
+    assert ss.dispatch_stats["superspans"] > 0
+
+
+def _exact_exhaustion_workload(W=64):
+    """Engineered for the capacity-unreadable staging edge: pods 0..W/2-1
+    terminate before the first slide, pods W/2..(3W/2)-1 run long enough to
+    be live across it, and the final W/2 pods create after a long gap. The
+    first slide is then EXACTLY the max quantum W/2 — landing a minimal
+    (W + W/2)-wide stage's capacity column exactly at its edge with a live
+    front pod and the true capacity far away. A blocked slide there must
+    exit SUPERSPAN_STAGE (restage, re-read the real capacity), never
+    SUPERSPAN_GROW: the ladder path never grows on this trace."""
+    half = W // 2
+    pods = [(1.0 + i, i, 20.0 if i < half else 100.0) for i in range(W + half)]
+    pods += [(2001.0 + j, W + half + j, 20.0) for j in range(half)]
+    from kubernetriks_tpu.trace.generic import GenericWorkloadTrace
+
+    return GenericWorkloadTrace.from_yaml(
+        "events:"
+        + "".join(
+            f"""
+- timestamp: {ts}
+  event_type:
+    !CreatePod
+      pod:
+        metadata:
+          name: pod_{i:04d}
+        spec:
+          resources:
+            requests: {{cpu: 10, ram: 10485760}}
+            limits: {{cpu: 10, ram: 10485760}}
+          running_duration: {dur}
+"""
+            for ts, i, dur in pods
+        )
+    ).convert_to_simulator_events()
+
+
+def test_superspan_capacity_edge_restages_instead_of_growing(monkeypatch):
+    """Regression: a blocked slide whose capacity column lies beyond the
+    stage (col == L after a max slide consumed all headroom) must exit
+    SUPERSPAN_STAGE, not SUPERSPAN_GROW — growing there diverges from the
+    ladder (which reads the TRUE capacity and just keeps running)."""
+    W = 64
+    workload = _exact_exhaustion_workload(W)
+    monkeypatch.setattr(engine_mod, "_DEVICE_SLIDE_BUDGET_BYTES", 0)
+    ss = _build_growth(
+        workload,
+        pod_window=W,
+        superspan=True,
+        superspan_k=8,
+        superspan_chunk=4,
+        superspan_stage_cols=W + W // 2,  # minimum width: zero slack
+        fast_forward=False,
+    )
+    assert ss._device_slide is None, "budget monkeypatch did not take"
+    ss.step_until_time(2200.0)
+    # The edge fired (initial install + at least one mid-run restage) and
+    # was answered with a restage, not a spurious growth.
+    assert ss.dispatch_stats["stage_refills"] >= 2
+    assert ss.pod_window == W, "capacity-unreadable slide grew the window"
+    monkeypatch.setattr(engine_mod, "_DEVICE_SLIDE_BUDGET_BYTES", 2 << 30)
+    ladder = _build_growth(
+        workload, pod_window=W, fast_forward=False, fuse_slide=False
+    )
+    ladder.step_until_time(2200.0)
+    assert ladder.pod_window == W
+    _assert_superspan_matches_ladder(ss, ladder)
