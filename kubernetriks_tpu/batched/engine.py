@@ -66,6 +66,8 @@ from kubernetriks_tpu.config import (
     KubeHorizontalPodAutoscalerConfig,
     SimulationConfig,
 )
+from kubernetriks_tpu import sanitize
+from kubernetriks_tpu.flags import flag_bool, flag_tristate
 
 
 # Device-resident slide payload budget: req/ram + duration pair +
@@ -212,7 +214,7 @@ def _slide_apply_device(pods, rank, pay, base, s: int, W: int):
     return new_pods, new_rank
 
 
-def _lex_name_ranks(names) -> np.ndarray:
+def _lex_name_ranks(names) -> np.ndarray:  # ktpu: sync-ok(host-side name-rank table builder over python name lists, no device values)
     """Rank of each slot's name in the stable lexicographic sort of
     `names` — THE scalar-parity ordering primitive (the scalar storage
     walks name-sorted snapshots). Used by both the autoscale statics and
@@ -488,7 +490,7 @@ def build_autoscale_statics(
 
 
 class BatchedSimulation:
-    def __init__(
+    def __init__(  # ktpu: sync-ok(engine build: cold-path host compilation of traces/tables, outside every timed region)
         self,
         config: SimulationConfig,
         compiled_traces: Sequence[CompiledClusterTrace],
@@ -510,8 +512,21 @@ class BatchedSimulation:
         superspan_k: int = 16,
         superspan_chunk: int = 8,
         superspan_stage_cols: Optional[int] = None,
+        sanitize_mode: Optional[bool] = None,
     ) -> None:
         self.config = config
+        # Runtime sanitizer (KTPU_SANITIZE / sanitize_mode arg): the
+        # steady-state dispatch region runs under a device-to-host
+        # transfer guard (waived syncs carry explicit allow scopes that
+        # mirror the lint pass's sync-ok waivers), donated inputs are
+        # force-deleted after donated calls so read-after-donate raises
+        # even on CPU, and the KTPU_DEBUG_FINITE sweep runs at every
+        # dispatch boundary. See kubernetriks_tpu/sanitize.py.
+        self._sanitize = (
+            bool(sanitize_mode)
+            if sanitize_mode is not None
+            else sanitize.sanitize_default()
+        )
         # Buffer donation (KTPU_DONATE / donate arg): the steady-state
         # dispatch loop consumes its input state buffers in place instead of
         # re-materializing the full (C,N)/(C,P) state every dispatch.
@@ -525,10 +540,9 @@ class BatchedSimulation:
         if donate is not None:
             self.donate = bool(donate)
         else:
-            env = os.environ.get("KTPU_DONATE")
+            env = flag_tristate("KTPU_DONATE")
             self.donate = (
-                env != "0" if env is not None
-                else jax.default_backend() != "cpu"
+                env if env is not None else jax.default_backend() != "cpu"
             )
         # Fused chunk+slide megastep (KTPU_FUSED_SLIDE / fuse_slide arg):
         # the last ladder chunk of a slide span also computes, quantizes and
@@ -542,10 +556,9 @@ class BatchedSimulation:
         if fuse_slide is not None:
             self._fuse_slide = bool(fuse_slide)
         else:
-            env = os.environ.get("KTPU_FUSED_SLIDE")
+            env = flag_tristate("KTPU_FUSED_SLIDE")
             self._fuse_slide = (
-                env != "0" if env is not None
-                else jax.default_backend() != "cpu"
+                env if env is not None else jax.default_backend() != "cpu"
             )
         # Superspan executor (KTPU_SUPERSPAN / superspan arg): the
         # steady-state sliding loop dispatches ONE device program per up-to-K
@@ -561,10 +574,9 @@ class BatchedSimulation:
         if superspan is not None:
             self._superspan = bool(superspan)
         else:
-            env = os.environ.get("KTPU_SUPERSPAN")
+            env = flag_tristate("KTPU_SUPERSPAN")
             self._superspan = (
-                env != "0" if env is not None
-                else jax.default_backend() != "cpu"
+                env if env is not None else jax.default_backend() != "cpu"
             )
         self._superspan_k = max(1, int(superspan_k))
         self._superspan_chunk = max(1, int(superspan_chunk))
@@ -677,7 +689,7 @@ class BatchedSimulation:
         # from the plain-slot count, and the device window W is already the
         # caller's tile-friendly choice.
         n_pods_aligned = None
-        if pod_window is None and os.environ.get("KTPU_ALIGN_PODS", "1") != "0":
+        if pod_window is None and flag_bool("KTPU_ALIGN_PODS"):
             p_max = max((c.n_pods for c in compiled_traces), default=0)
             n_pods_aligned = -(-max(p_max, 1) // 128) * 128
 
@@ -700,7 +712,7 @@ class BatchedSimulation:
         from kubernetriks_tpu.chaos import make_fault_params
 
         self.fault_params = make_fault_params(config)
-        self._debug_finite = os.environ.get("KTPU_DEBUG_FINITE") == "1"
+        self._debug_finite = flag_bool("KTPU_DEBUG_FINITE")
 
         if pod_window is not None:
             # Cross-process meshes are supported through the device-resident
@@ -880,7 +892,7 @@ class BatchedSimulation:
 
         self.use_megakernel = (
             self.use_pallas_select
-            and os.environ.get("KTPU_MEGAKERNEL", "1") != "0"
+            and flag_bool("KTPU_MEGAKERNEL")
             and select_commit_kernel_fits(
                 self.n_nodes, self.n_pods, self.max_pods_per_cycle
             )
@@ -1239,6 +1251,7 @@ class BatchedSimulation:
         readback starts immediately but is only consumed at the span
         boundary (_resolve_pending_slide), so no sync lands here."""
         self.dispatch_stats["window_chunks"] += 1
+        donated_in = self.state if (self.donate and self._sanitize) else None
         if fuse_slide:
             self.dispatch_stats["fused_slides"] += 1
             fn = _fused_chunk_slide_donated if self.donate else _fused_chunk_slide
@@ -1253,6 +1266,8 @@ class BatchedSimulation:
                 **self._window_call_kwargs(),
             )
             self.state = state
+            if donated_in is not None:
+                sanitize.consume_donated(donated_in)
             if new_rank is not None:
                 # Device-to-device swap, no sync; identical values when the
                 # slide turns out to be a no-op (s == 0).
@@ -1260,7 +1275,10 @@ class BatchedSimulation:
                     pod_name_rank=new_rank
                 )
             if hasattr(s, "copy_to_host_async"):
-                s.copy_to_host_async()
+                with sanitize.allow_transfer(
+                    self._sanitize, "async shift prefetch"
+                ):
+                    s.copy_to_host_async()  # ktpu: sync-ok(async initiation of the waived 4-byte shift readback — does not block)
             self._pending_shift = s
             self.next_window_idx = int(idxs[-1]) + 1
             return
@@ -1283,6 +1301,8 @@ class BatchedSimulation:
                 flush_windows=self._flush_windows,
                 **self._window_call_kwargs(),
             )
+            if donated_in is not None:
+                sanitize.consume_donated(donated_in)
             self.next_window_idx = int(idxs[-1]) + 1
             return
         from kubernetriks_tpu.batched.step import run_windows_donated
@@ -1298,10 +1318,15 @@ class BatchedSimulation:
         )
         if self.collect_gauges:
             self.state, gauges = out
-            self._gauge_windows.append(np.asarray(idxs))
-            self._gauge_samples.append(to_host(gauges))
+            with sanitize.allow_transfer(
+                self._sanitize, "gauge time-series readback"
+            ):
+                self._gauge_windows.append(np.asarray(idxs))  # ktpu: sync-ok(gauge instrumentation: per-chunk time-series readback, gauge runs are not the steady-state path)
+                self._gauge_samples.append(to_host(gauges))  # ktpu: sync-ok(gauge instrumentation: per-chunk time-series readback)
         else:
             self.state = out
+        if donated_in is not None:
+            sanitize.consume_donated(donated_in)
         self.next_window_idx = int(idxs[-1]) + 1
 
     def precompile_chunks(self, max_chunk: int = 128) -> int:
@@ -1362,7 +1387,7 @@ class BatchedSimulation:
                 chunk=self._superspan_chunk,
                 **self._window_call_kwargs(),
             )
-            jax.block_until_ready(out)
+            jax.block_until_ready(out)  # ktpu: sync-ok(warm-up: AOT compile of the superspan program, outside every timed region)
             return 1
         from kubernetriks_tpu.batched.step import run_windows_donated
 
@@ -1381,7 +1406,7 @@ class BatchedSimulation:
                 collect_gauges=self.collect_gauges,
                 **self._window_call_kwargs(),
             )
-            jax.block_until_ready(out)  # discarded: warm-up only
+            jax.block_until_ready(out)  # discarded: warm-up only  # ktpu: sync-ok(warm-up: AOT compile of the ladder shapes, outside every timed region)
             n += 1
             if warm_fused:
                 fn = (
@@ -1399,11 +1424,19 @@ class BatchedSimulation:
                     W=self.pod_window,
                     **self._window_call_kwargs(),
                 )
-                jax.block_until_ready(out)
+                jax.block_until_ready(out)  # ktpu: sync-ok(warm-up: AOT compile of the fused chunk+slide shapes, outside every timed region)
                 n += 1
         return n
 
     def step_until_time(self, until_time: float) -> None:
+        """Advance to `until_time`. THE steady-state dispatch region: under
+        KTPU_SANITIZE it runs inside a device-to-host transfer guard — any
+        sync not inside an explicit sanitize.allow_transfer scope (the
+        runtime mirror of the lint pass's sync-ok waivers) raises."""
+        with sanitize.guard(self._sanitize):
+            self._step_until_time(until_time)
+
+    def _step_until_time(self, until_time: float) -> None:
         idxs = self.window_idxs(until_time)
         if len(idxs) == 0:
             return
@@ -1650,6 +1683,9 @@ class BatchedSimulation:
                 jnp.int32,
             )
             self.dispatch_stats["superspans"] += 1
+            donated_in = (
+                self.state if (self.donate and self._sanitize) else None
+            )
             state, rank, progress = fn(
                 self.state,
                 rank,
@@ -1665,16 +1701,25 @@ class BatchedSimulation:
                 **self._window_call_kwargs(),
             )
             self.state = state
+            if donated_in is not None:
+                sanitize.consume_donated(donated_in)
             if rank is not None:
                 self.autoscale_statics = self.autoscale_statics._replace(
                     pod_name_rank=rank
                 )
             if hasattr(progress, "copy_to_host_async"):
-                progress.copy_to_host_async()
+                with sanitize.allow_transfer(
+                    self._sanitize, "async progress prefetch"
+                ):
+                    progress.copy_to_host_async()  # ktpu: sync-ok(async initiation of the waived progress readback — does not block)
             # Overlap the next stage's host assembly + H2D with the device
             # program still running, BEFORE the blocking readback.
             self._prefetch_stage(lo)
-            w, base, spans, code = (int(v) for v in to_host(progress))
+            with sanitize.allow_transfer(
+                self._sanitize, "superspan progress readback"
+            ):
+                w, base, spans, code = (int(v) for v in to_host(progress))  # ktpu: sync-ok(THE steady-state sync: one async-prefetched (4,)-i32 progress readback per superspan dispatch)
+            self._check_finite()
             self.dispatch_stats["slide_syncs"] += 1
             self.dispatch_stats["superspan_spans"] += spans
             self.next_window_idx = w
@@ -1715,7 +1760,10 @@ class BatchedSimulation:
         s_arr = self._pending_shift
         self._pending_shift = None
         self.dispatch_stats["slide_syncs"] += 1
-        s = int(s_arr)
+        with sanitize.allow_transfer(
+            self._sanitize, "fused-slide shift readback"
+        ):
+            s = int(s_arr)  # ktpu: sync-ok(the fused span's only host sync: async-prefetched 4-byte shift readback, consumed at the span boundary)
         if s <= 0:
             # The fused slide was the identity (statics rank swap included);
             # nothing moved on device or host.
@@ -1815,16 +1863,22 @@ class BatchedSimulation:
             # engines.)
             self.dispatch_stats["slide_dispatches"] += 1
             self.dispatch_stats["slide_syncs"] += 1
-            s = int(
-                _slide_shift_device(
-                    self.state.pods.phase[:, :W],
-                    self._device_slide["create_win"],
-                    jnp.asarray(win_lo, jnp.int32),
+            with sanitize.allow_transfer(
+                self._sanitize, "two-dispatch slide shift readback"
+            ):
+                s = int(  # ktpu: sync-ok(blocking 4-byte shift readback gating the slide decision on the two-dispatch path; the steady-state loop fuses this away)
+                    _slide_shift_device(
+                        self.state.pods.phase[:, :W],
+                        self._device_slide["create_win"],
+                        jnp.asarray(win_lo, jnp.int32),
+                    )
                 )
-            )
         else:
             self.dispatch_stats["slide_syncs"] += 1
-            phases = to_host(self.state.pods.phase)[:, :W]
+            with sanitize.allow_transfer(
+                self._sanitize, "host slide path phase fetch"
+            ):
+                phases = to_host(self.state.pods.phase)[:, :W]  # ktpu: sync-ok(host slide path: blocking (C, W) phase fetch — the round-trip the device-resident payload eliminates)
             terminal = (
                 (phases == PHASE_SUCCEEDED)
                 | (phases == PHASE_REMOVED)
@@ -2085,13 +2139,20 @@ class BatchedSimulation:
         "maximum",
     )
 
-    def _check_finite(self) -> None:
+    def _check_finite(self) -> None:  # ktpu: sync-ok(guard-mode state sweep: KTPU_DEBUG_FINITE / KTPU_SANITIZE readback, off on the production hot path)
         """KTPU_DEBUG_FINITE=1 guard mode: sweep every float leaf of the
         state after a dispatched chunk — NaN anywhere, or inf outside the
         documented sentinel fields, raises with the offending field name.
-        Host-side readback, so the donated hot path is untouched when off."""
-        if not self._debug_finite:
+        Host-side readback, so the donated hot path is untouched when off.
+        KTPU_SANITIZE folds this sweep in at every dispatch boundary (on
+        the superspan path: once per superspan, where the progress
+        readback already syncs)."""
+        if not (self._debug_finite or self._sanitize):
             return
+        with sanitize.allow_transfer(self._sanitize, "finite-guard sweep"):
+            self._check_finite_now()
+
+    def _check_finite_now(self) -> None:  # ktpu: sync-ok(guard-mode state sweep body: full host readback is the point)
         flat, _ = jax.tree_util.tree_flatten_with_path(self.state)
         for path, leaf in flat:
             arr = np.asarray(to_host(leaf))
@@ -2129,21 +2190,25 @@ class BatchedSimulation:
             if self.profile_dir
             else contextlib.nullcontext()
         )
-        before = (
-            int(to_host(self.state.metrics.scheduling_decisions).sum())
-            if self.log_throughput
-            else 0
-        )
+        before = 0
+        if self.log_throughput:
+            with sanitize.allow_transfer(
+                self._sanitize, "log_throughput decisions fetch"
+            ):
+                before = int(to_host(self.state.metrics.scheduling_decisions).sum())  # ktpu: sync-ok(log_throughput instrumentation: per-chunk decisions counter fetch, instrumented runs only)
         t0 = time.perf_counter()
         with ctx:
             self._dispatch_windows(idxs, fuse_slide=fuse_slide)
-            jax.block_until_ready(self.state.time)
+            jax.block_until_ready(self.state.time)  # ktpu: sync-ok(instrumented path: fence so the per-chunk clock measures device work, not dispatch)
         elapsed = time.perf_counter() - t0
         self._check_finite()
         if self.log_throughput:
-            decisions = (
-                int(to_host(self.state.metrics.scheduling_decisions).sum()) - before
-            )
+            with sanitize.allow_transfer(
+                self._sanitize, "log_throughput decisions fetch"
+            ):
+                decisions = (
+                    int(to_host(self.state.metrics.scheduling_decisions).sum()) - before  # ktpu: sync-ok(log_throughput instrumentation: per-chunk decisions counter fetch, instrumented runs only)
+                )
             cluster_windows = len(idxs) * self.n_clusters
             logging.getLogger(__name__).info(
                 "chunk of %d windows in %.3fs: %.0f decisions/s, "
@@ -2186,9 +2251,9 @@ class BatchedSimulation:
             from kubernetriks_tpu.batched.step import gauge_snapshot
 
             self._gauge_windows.append(
-                np.asarray([self.next_window_idx], np.int32)
+                np.asarray([self.next_window_idx], np.int32)  # ktpu: sync-ok(single-window test helper: host-side window index, no device value)
             )
-            self._gauge_samples.append(to_host(gauge_snapshot(self.state))[None])
+            self._gauge_samples.append(to_host(gauge_snapshot(self.state))[None])  # ktpu: sync-ok(gauge instrumentation in the single-window test helper)
         self.next_window_idx += 1
 
     def run_to_completion(self, max_time: float = 1e7) -> None:
@@ -2206,8 +2271,8 @@ class BatchedSimulation:
             # have advanced strictly past last_event_time + interval.
             if self.next_window <= last_event_time + interval:
                 continue
-            phases = to_host(self.state.pods.phase)
-            service = to_host(self.state.pods.duration.win) < 0
+            phases = to_host(self.state.pods.phase)  # ktpu: sync-ok(completion poll at chunk boundary — the batched analog of the scalar run-until-finished callback)
+            service = to_host(self.state.pods.duration.win) < 0  # ktpu: sync-ok(completion poll at chunk boundary)
             # Finite-duration pods not yet terminal?
             live = (
                 ((phases == PHASE_QUEUED) | (phases == PHASE_UNSCHEDULABLE))
@@ -2223,7 +2288,7 @@ class BatchedSimulation:
 
     # --- readout ------------------------------------------------------------
 
-    def check_autoscaler_bounds(self) -> None:
+    def check_autoscaler_bounds(self) -> None:  # ktpu: sync-ok(readout: divergence counters fetched once at summary time)
         """Raise loudly when a documented autoscaler work bound was crossed
         and the trajectory has (or is about to) diverge from the scalar
         semantics (autoscale.py "Remaining bounded deviations"):
@@ -2281,7 +2346,7 @@ class BatchedSimulation:
                 "trajectory."
             )
 
-    def metrics_summary(self) -> Dict:
+    def metrics_summary(self) -> Dict:  # ktpu: sync-ok(readout: one-shot cross-cluster metric reduction after the run)
         """Cross-cluster reduction into the scalar printer's shape. On a
         cross-process mesh the metric arrays allgather over DCN first.
         Raises via check_autoscaler_bounds when a documented autoscaler
@@ -2334,7 +2399,7 @@ class BatchedSimulation:
             },
         }
 
-    def cluster_metrics(self, cluster: int) -> Dict:
+    def cluster_metrics(self, cluster: int) -> Dict:  # ktpu: sync-ok(readout: per-cluster counters after the run)
         m = self.state.metrics
         return {
             "pods_succeeded": int(m.pods_succeeded[cluster]),
@@ -2343,7 +2408,7 @@ class BatchedSimulation:
             "scheduling_decisions": int(m.scheduling_decisions[cluster]),
         }
 
-    def hpa_replicas(self, cluster: int) -> Dict[str, int]:
+    def hpa_replicas(self, cluster: int) -> Dict[str, int]:  # ktpu: sync-ok(readout: replica counts after the run)
         """Per-pod-group created replica counts (scalar equivalent:
         len(PodGroupInfo.created_pods))."""
         auto = self.state.auto
@@ -2353,13 +2418,13 @@ class BatchedSimulation:
         names = self.pod_group_names[cluster]
         return {name: int(tail[i] - head[i]) for i, name in enumerate(names)}
 
-    def ca_node_counts(self, cluster: int) -> np.ndarray:
+    def ca_node_counts(self, cluster: int) -> np.ndarray:  # ktpu: sync-ok(readout: node counts after the run)
         """Current cluster-autoscaler node count per node group."""
         auto = self.state.auto
         assert auto is not None, "autoscaling is not enabled"
         return to_host(auto.ca_count)[cluster]
 
-    def node_count_at(self, t: float, cluster: int = 0) -> int:
+    def node_count_at(self, t: float, cluster: int = 0) -> int:  # ktpu: sync-ok(readout: point-in-time node count query)
         """Alive node count at absolute time t, resolving pending
         create/remove effects with effect time <= t. The step applies an
         effect when it next runs a window PAST the effect's time — an
@@ -2427,7 +2492,7 @@ class BatchedSimulation:
             # (gauge-less) state on restore.
             os.remove(sidecar)
 
-    def load_checkpoint(self, path: str) -> None:
+    def load_checkpoint(self, path: str) -> None:  # ktpu: sync-ok(checkpoint restore: cold path)
         """Restore state saved by save_checkpoint into this simulation (which
         must have been built from the same config/traces — the current state
         pytree provides the restore structure). Restored arrays land
@@ -2495,7 +2560,7 @@ class BatchedSimulation:
                      float(row[3]), float(row[4]), float(row[5]), float(row[6])]
                 )
 
-    def pod_view(self, cluster: int) -> Dict[str, Dict]:
+    def pod_view(self, cluster: int) -> Dict[str, Dict]:  # ktpu: sync-ok(readout: name-keyed pod states for equivalence tests)
         """Name-keyed pod states for equivalence tests against the scalar
         path. With a sliding pod window, only the currently-resident slots
         appear (shifted-out pods are terminal and already counted)."""

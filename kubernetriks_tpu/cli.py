@@ -33,7 +33,9 @@ def setup_logging(config: SimulationConfig) -> None:
     (reference: main.rs:33-50)."""
     from logging.handlers import RotatingFileHandler
 
-    level = os.environ.get("KUBERNETRIKS_LOG", "INFO").upper()
+    from kubernetriks_tpu.flags import flag_str
+
+    level = (flag_str("KUBERNETRIKS_LOG") or "INFO").upper()
     if config.logs_filepath:
         # The reference logs EXCLUSIVELY to the rotating file when a path is
         # configured (main.rs:40-47) — no console duplicate.

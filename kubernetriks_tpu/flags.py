@@ -1,0 +1,175 @@
+"""Central registry of KTPU_* / KUBERNETRIKS_* environment flags.
+
+Every environment flag the framework reads is declared here — name, type,
+default, and documentation — and every read goes through the typed helpers
+below. This is enforced by the env-flag lint pass
+(kubernetriks_tpu/lint/envflags.py): an `os.environ` / `os.getenv` read of a
+KTPU_*/KUBERNETRIKS_* name anywhere outside this module is a lint violation,
+and a helper read of an unregistered name raises here at runtime.
+
+Why a registry: before PR 6, `"0"` / empty-string / unset truthiness was
+decided ad hoc at each read site (`env != "0"`, `== "1"`,
+`bool(os.environ.get(...))` — three different rules, one of which made
+`KUBERNETRIKS_FAST_TESTS=0` truthy). The registry gives every flag ONE
+parser, one default, and one greppable declaration.
+
+Truthiness rule (flag_bool / flag_tristate): unset -> default (or None for
+tristate); `"0"`, `""`, `"false"`, `"no"`, `"off"` (case-insensitive) ->
+False; anything else -> True.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, NamedTuple, Optional
+
+
+class Flag(NamedTuple):
+    name: str
+    type: str  # "bool" | "tristate" | "str"
+    default: object
+    doc: str
+
+
+_FLAGS = [
+    Flag(
+        "KTPU_DONATE",
+        "tristate",
+        None,
+        "Buffer donation for the steady-state dispatch loop (donated jit "
+        "entries consume the input state in place). Unset: on for "
+        "accelerator backends, off on CPU hosts.",
+    ),
+    Flag(
+        "KTPU_FUSED_SLIDE",
+        "tristate",
+        None,
+        "Fused chunk+slide megastep: the last ladder chunk of a slide span "
+        "also computes and applies the window slide on device. Unset: on "
+        "for accelerator backends, off on CPU hosts.",
+    ),
+    Flag(
+        "KTPU_SUPERSPAN",
+        "tristate",
+        None,
+        "Superspan executor: one jitted while_loop retires up to K "
+        "consecutive slide-spans per dispatch. Unset: on for accelerator "
+        "backends, off on CPU hosts.",
+    ),
+    Flag(
+        "KTPU_ALIGN_PODS",
+        "bool",
+        True,
+        "128-align the pod axis of full-resident runs so Pallas block pads "
+        "are no-ops.",
+    ),
+    Flag(
+        "KTPU_MEGAKERNEL",
+        "bool",
+        True,
+        "Fused selection+cycle+commit Pallas megakernel on the dense path "
+        "(0 selects the two-kernel path for A/B measurement). Read at "
+        "engine build time and threaded as a jit-static.",
+    ),
+    Flag(
+        "KTPU_DEBUG_FINITE",
+        "bool",
+        False,
+        "Guard mode: host-side NaN/inf sweep over every float state leaf "
+        "after each dispatched chunk, naming the offending field. Keeps "
+        "the ladder path (per-chunk localization).",
+    ),
+    Flag(
+        "KTPU_SANITIZE",
+        "bool",
+        False,
+        "Runtime sanitizer: the engine's steady-state dispatch region runs "
+        "under jax.transfer_guard('disallow_explicit') for device-to-host "
+        "transfers (waived syncs carry explicit allow scopes), donated "
+        "inputs are force-deleted after donated calls so read-after-donate "
+        "crashes even on CPU (where XLA donation is a no-op), and the "
+        "KTPU_DEBUG_FINITE state sweep runs at every dispatch boundary.",
+    ),
+    Flag(
+        "KUBERNETRIKS_PALLAS",
+        "tristate",
+        None,
+        "Force the Pallas scheduling-cycle kernels on (1) or off (0). "
+        "Unset: auto — on for TPU backends whose blocks fit VMEM.",
+    ),
+    Flag(
+        "KUBERNETRIKS_LOG",
+        "str",
+        "INFO",
+        "CLI logging level (DEBUG/INFO/WARNING/ERROR).",
+    ),
+    Flag(
+        "KUBERNETRIKS_FAST_TESTS",
+        "bool",
+        False,
+        "DEPRECATED no-op since PR 6: the fast scales it used to opt into "
+        "are the tier-1 default, and the reference-scale runs live behind "
+        "`-m slow`. Registered so existing scripts that set it keep "
+        "passing the env-flag lint; nothing reads it.",
+    ),
+    Flag(
+        "KUBERNETRIKS_ALIBABA_DIR",
+        "str",
+        None,
+        "Directory holding the real Alibaba v2017 trace CSVs; enables the "
+        "real-trace feeder tests when set.",
+    ),
+]
+
+REGISTRY: Dict[str, Flag] = {f.name: f for f in _FLAGS}
+
+_FALSY = frozenset({"0", "", "false", "no", "off"})
+
+
+def _lookup(name: str, expected: str) -> Flag:
+    flag = REGISTRY.get(name)
+    if flag is None:
+        raise KeyError(
+            f"environment flag {name!r} is not registered in "
+            "kubernetriks_tpu.flags — declare it (name, type, default, doc) "
+            "before reading it"
+        )
+    if flag.type != expected:
+        raise TypeError(
+            f"environment flag {name!r} is registered as {flag.type!r}, "
+            f"read as {expected!r}"
+        )
+    return flag
+
+
+def parse_bool(raw: str) -> bool:
+    """THE truthiness rule for flag strings (see module docstring)."""
+    return raw.strip().lower() not in _FALSY
+
+
+def flag_bool(name: str) -> bool:
+    """Boolean flag: unset -> registered default; else parse_bool."""
+    flag = _lookup(name, "bool")
+    raw = os.environ.get(name)
+    if raw is None:
+        return bool(flag.default)
+    return parse_bool(raw)
+
+
+def flag_tristate(name: str) -> Optional[bool]:
+    """Tri-state flag: None when unset (caller picks a platform default),
+    else parse_bool."""
+    _lookup(name, "tristate")
+    raw = os.environ.get(name)
+    if raw is None:
+        return None
+    return parse_bool(raw)
+
+
+def flag_str(name: str) -> Optional[str]:
+    """String flag: unset -> registered default (may be None)."""
+    flag = _lookup(name, "str")
+    raw = os.environ.get(name)
+    if raw is None:
+        return flag.default  # type: ignore[return-value]
+    return raw

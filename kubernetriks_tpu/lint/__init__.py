@@ -1,0 +1,486 @@
+"""ktpu-lint: framework-invariant static analysis for kubernetriks-tpu.
+
+The framework's correctness rests on invariants no general-purpose tool
+checks; this package turns them into machine-checked AST passes
+(`python -m kubernetriks_tpu.lint`):
+
+1. donation  — no read of a variable after it was passed at a donated
+   position of a `donate_argnums` jit entry, unless rebound first. The bug
+   class silently PASSES on CPU CI (donation is a no-op there) and corrupts
+   state only on TPU. The donated-entry table is built by scanning
+   `jax.jit` / `partial(jax.jit, ...)` sites, not hardcoded.
+2. hostsync  — hot-path modules must not grow implicit host syncs:
+   `.item()`, `int()`/`float()`/`bool()` on array-valued expressions,
+   `np.asarray` / `jax.device_get` / `to_host` / `block_until_ready`, and
+   Python branches on traced values. Every legitimate sync carries a
+   `# ktpu: sync-ok(<reason>)` waiver, making the sync budget greppable.
+3. jitstatic — every `static_argnames` entry names a parameter of the
+   wrapped function, and paired donated/undonated entries declare identical
+   static sets (drift makes a kwarg traced in one variant only).
+4. prng      — simulation-path modules draw no ad-hoc randomness
+   (`jax.random.*`, `np.random.*`, stdlib `random`): all draws route
+   through the counter-based threefry keying in `chaos.py`, or
+   scalar/batched bit-identity breaks.
+5. envflags  — every `os.environ` / `os.getenv` read of a KTPU_* /
+   KUBERNETRIKS_* name resolves against the central registry
+   (`kubernetriks_tpu/flags.py`) and happens inside it.
+
+Waiver syntax (same line as the violation, or on the `def` line to waive a
+whole function for hostsync): `# ktpu: <pass>-ok(<reason>)` with a
+non-empty reason, e.g. `# ktpu: sync-ok(async 4-byte shift readback)`.
+File pragmas: `# ktpu: hot-path` opts a module into the hostsync pass,
+`# ktpu: sim-path` into the prng pass (the built-in module lists cover the
+real package; pragmas serve the self-test fixtures and future modules).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+PASS_IDS = ("donation", "hostsync", "jitstatic", "prng", "envflags")
+
+# Modules whose steady-state dispatch regions are hot: a stray host sync
+# here undoes the dispatch-overhaul work (ROADMAP item 1 — the composed
+# flagship is host-dispatch bound). Relative to the repo root.
+HOT_MODULES = (
+    "kubernetriks_tpu/batched/step.py",
+    "kubernetriks_tpu/batched/engine.py",
+    "kubernetriks_tpu/batched/autoscale.py",
+    "kubernetriks_tpu/ops/",
+)
+
+# Modules on the simulation path, where every random draw must route
+# through chaos.py's counter-based threefry keying (scalar/batched
+# bit-identity). chaos.py itself is the key constructor and is exempt.
+SIM_MODULES = (
+    "kubernetriks_tpu/batched/",
+    "kubernetriks_tpu/ops/",
+    "kubernetriks_tpu/sim/",
+    "kubernetriks_tpu/core/",
+    "kubernetriks_tpu/autoscalers/",
+)
+
+# Self-test fixtures hold seeded violations on purpose; the default scope
+# must stay golden-clean without them.
+DEFAULT_EXCLUDE = ("tests/lint_fixtures/",)
+
+# Reason is greedy to the LAST ')' on the line, so reasons containing
+# parentheses ("(4,)-i32 readback") survive intact; convention is one
+# waiver per line.
+_WAIVER_RE = re.compile(r"#\s*ktpu:\s*([a-z]+)-ok\((.*)\)")
+_PRAGMA_RE = re.compile(r"#\s*ktpu:\s*(hot-path|sim-path)\b")
+
+
+@dataclass(frozen=True)
+class Violation:
+    path: str
+    line: int
+    pass_id: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.pass_id}] {self.message}"
+
+
+@dataclass
+class JitEntry:
+    """One jax.jit wrapping site found in the package."""
+
+    name: str  # bound name (decorated def or assignment target)
+    path: str
+    line: int
+    static_argnames: Optional[Tuple[str, ...]]  # None = unresolvable
+    static_resolved: bool
+    donate_argnums: Tuple[int, ...]
+    params: Optional[Tuple[str, ...]]  # wrapped function params, if resolved
+    has_varkw: bool = False
+
+
+@dataclass
+class SourceFile:
+    path: str  # repo-relative, forward slashes
+    abspath: str
+    text: str
+    lines: List[str]
+    tree: ast.AST
+    waivers: Dict[int, List[Tuple[str, str]]]  # line -> [(pass tag, reason)]
+    pragmas: frozenset
+
+    def waived(self, line: int, pass_id: str) -> bool:
+        tag = {"hostsync": "sync", "envflags": "flag", "jitstatic": "static"}.get(
+            pass_id, pass_id
+        )
+        return any(t == tag and r.strip() for t, r in self.waivers.get(line, []))
+
+
+@dataclass
+class LintContext:
+    """Package-wide tables built in phase 1, shared by every pass."""
+
+    files: List[SourceFile] = field(default_factory=list)
+    jit_entries: List[JitEntry] = field(default_factory=list)
+    # bare entry name -> donated positional indices (non-empty only)
+    donated: Dict[str, Tuple[int, ...]] = field(default_factory=dict)
+    # bare names of ALL jit entries (hostsync taint sources)
+    jit_names: frozenset = frozenset()
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def local_entry_aliases(scope: ast.AST, entries) -> Dict[str, set]:
+    """Local names that may hold one of `entries` (bare names of jit/donated
+    entry points): `f = entry`, `f = entry if c else other`, `f = a or b`.
+    Returns alias name -> set of matched entry names. Shared by the donation
+    pass (poisons alias-call arguments) and the hostsync pass (alias calls
+    seed taint) so the recognized alias shapes can't drift apart."""
+    aliases: Dict[str, set] = {}
+
+    def entry_names(node: ast.AST) -> set:
+        out: set = set()
+        if isinstance(node, ast.IfExp):
+            out |= entry_names(node.body) | entry_names(node.orelse)
+        elif isinstance(node, ast.BoolOp):
+            for v in node.values:
+                out |= entry_names(v)
+        else:
+            name = dotted_name(node)
+            if name is not None:
+                bare = name.rsplit(".", 1)[-1]
+                if bare in entries:
+                    out.add(bare)
+        return out
+
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt = node.targets[0]
+            if isinstance(tgt, ast.Name):
+                found = entry_names(node.value)
+                if found:
+                    aliases[tgt.id] = found
+    return aliases
+
+
+def _scan_waivers(lines: Sequence[str]) -> Dict[int, List[Tuple[str, str]]]:
+    out: Dict[int, List[Tuple[str, str]]] = {}
+    for i, line in enumerate(lines, start=1):
+        for m in _WAIVER_RE.finditer(line):
+            out.setdefault(i, []).append((m.group(1), m.group(2)))
+    return out
+
+
+def _scan_pragmas(lines: Sequence[str]) -> frozenset:
+    found = set()
+    for line in lines:
+        for m in _PRAGMA_RE.finditer(line):
+            found.add(m.group(1))
+    return frozenset(found)
+
+
+def load_file(abspath: str, root: str) -> SourceFile:
+    with open(abspath, encoding="utf-8") as fh:
+        text = fh.read()
+    rel = os.path.relpath(abspath, root).replace(os.sep, "/")
+    lines = text.splitlines()
+    return SourceFile(
+        path=rel,
+        abspath=abspath,
+        text=text,
+        lines=lines,
+        tree=ast.parse(text, filename=rel),
+        waivers=_scan_waivers(lines),
+        pragmas=_scan_pragmas(lines),
+    )
+
+
+def collect_files(
+    paths: Sequence[str], root: str, exclude: Sequence[str] = DEFAULT_EXCLUDE
+) -> List[SourceFile]:
+    out: List[Tuple[str, bool]] = []  # (abspath, from directory walk)
+    seen = set()
+    for p in paths:
+        ap = os.path.abspath(os.path.join(root, p) if not os.path.isabs(p) else p)
+        if os.path.isdir(ap):
+            for dirpath, dirnames, filenames in os.walk(ap):
+                dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        out.append((os.path.join(dirpath, fn), True))
+        elif ap.endswith(".py"):
+            # explicitly-named files always lint (that's how the self-test
+            # fixtures are invoked); excludes only prune directory walks
+            out.append((ap, False))
+    files: List[SourceFile] = []
+    for ap, walked in out:
+        rel = os.path.relpath(ap, root).replace(os.sep, "/")
+        if ap in seen or (walked and any(rel.startswith(e) for e in exclude)):
+            continue
+        seen.add(ap)
+        files.append(load_file(ap, root))
+    return files
+
+
+def is_hot(sf: SourceFile) -> bool:
+    return "hot-path" in sf.pragmas or any(
+        sf.path.startswith(m) if m.endswith("/") else sf.path == m
+        for m in HOT_MODULES
+    )
+
+
+def is_sim_path(sf: SourceFile) -> bool:
+    return "sim-path" in sf.pragmas or any(
+        sf.path.startswith(m) for m in SIM_MODULES
+    )
+
+
+# --- phase 1: jit-entry and module-constant tables ---------------------------
+
+
+def _const_str_tuple(node: ast.AST, consts: Dict[str, Tuple[str, ...]]):
+    """Resolve an expression to a tuple of strings: literal tuples, names of
+    module-level string-tuple constants, and + concatenations of those."""
+    if isinstance(node, ast.Tuple):
+        vals = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                vals.append(elt.value)
+            else:
+                return None
+        return tuple(vals)
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, ast.Name):
+        return consts.get(node.id)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        left = _const_str_tuple(node.left, consts)
+        right = _const_str_tuple(node.right, consts)
+        if left is not None and right is not None:
+            return left + right
+    return None
+
+
+def _const_int_tuple(node: ast.AST) -> Tuple[int, ...]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, ast.Tuple):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                out.append(elt.value)
+            else:
+                return ()
+        return tuple(out)
+    return ()
+
+
+def _is_jax_jit(node: ast.AST) -> bool:
+    return dotted_name(node) in ("jax.jit", "jit")
+
+
+def _is_partial(node: ast.AST) -> bool:
+    return dotted_name(node) in ("partial", "functools.partial")
+
+
+def _jit_kwargs(call: ast.Call) -> Optional[Dict[str, ast.AST]]:
+    """kwargs of a jax.jit(...) or partial(jax.jit, ...) call, else None."""
+    if _is_jax_jit(call.func):
+        return {kw.arg: kw.value for kw in call.keywords if kw.arg}
+    if (
+        _is_partial(call.func)
+        and call.args
+        and _is_jax_jit(call.args[0])
+    ):
+        return {kw.arg: kw.value for kw in call.keywords if kw.arg}
+    return None
+
+
+def _module_functions(tree: ast.AST) -> Dict[str, ast.FunctionDef]:
+    return {
+        n.name: n
+        for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+
+
+def _func_params(fn: ast.FunctionDef) -> Tuple[Tuple[str, ...], bool]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    return tuple(names), a.kwarg is not None
+
+
+def build_context(files: List[SourceFile]) -> LintContext:
+    ctx = LintContext(files=files)
+    # Pass A: module-level string-tuple constants, per file AND pooled
+    # package-wide so imported constants resolve (`from ..step import
+    # _STEP_STATICS`); a name defined differently in two modules is
+    # ambiguous and dropped from the pool.
+    per_file_consts: Dict[str, Dict[str, Tuple[str, ...]]] = {}
+    global_consts: Dict[str, Tuple[str, ...]] = {}
+    # Two rounds so a constant built from an IMPORTED constant
+    # (`_FUSED_STATICS = _STEP_STATICS + ("W",)`) resolves once the import's
+    # definition entered the pool in round one.
+    for _ in range(2):
+        ambiguous: set = set()
+        for sf in files:
+            consts: Dict[str, Tuple[str, ...]] = dict(global_consts)
+            local: Dict[str, Tuple[str, ...]] = {}
+            for node in sf.tree.body if isinstance(sf.tree, ast.Module) else []:
+                if (
+                    isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                ):
+                    val = _const_str_tuple(node.value, consts)
+                    if val is not None:
+                        name = node.targets[0].id
+                        consts[name] = val
+                        local[name] = val
+                        if name in global_consts and global_consts[name] != val:
+                            ambiguous.add(name)
+                        else:
+                            global_consts[name] = val
+            per_file_consts[sf.path] = local
+        for name in ambiguous:
+            global_consts.pop(name, None)
+    for sf in files:
+        consts = dict(global_consts)
+        consts.update(per_file_consts[sf.path])
+        funcs = _module_functions(sf.tree)
+
+        def add_entry(name, line, kwargs, wrapped_name):
+            static_node = kwargs.get("static_argnames")
+            statics = (
+                _const_str_tuple(static_node, consts)
+                if static_node is not None
+                else ()
+            )
+            donate = _const_int_tuple(kwargs["donate_argnums"]) if (
+                "donate_argnums" in kwargs
+            ) else ()
+            params = None
+            has_varkw = False
+            fn = funcs.get(wrapped_name) if wrapped_name else None
+            if fn is not None:
+                params, has_varkw = _func_params(fn)
+            ctx.jit_entries.append(
+                JitEntry(
+                    name=name,
+                    path=sf.path,
+                    line=line,
+                    static_argnames=statics,
+                    static_resolved=statics is not None,
+                    donate_argnums=donate,
+                    params=params,
+                    has_varkw=has_varkw,
+                )
+            )
+            if donate:
+                ctx.donated[name] = donate
+
+        for node in ast.walk(sf.tree):
+            # @jax.jit / @partial(jax.jit, ...) decorators
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if isinstance(dec, ast.Call):
+                        kwargs = _jit_kwargs(dec)
+                        if kwargs is not None:
+                            add_entry(node.name, node.lineno, kwargs, node.name)
+                    elif _is_jax_jit(dec):
+                        add_entry(node.name, node.lineno, {}, node.name)
+            # name = jax.jit(fn, ...) / name = partial(jax.jit, ...)(fn)
+            elif isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                if len(node.targets) != 1 or not isinstance(
+                    node.targets[0], ast.Name
+                ):
+                    continue
+                tgt = node.targets[0].id
+                call = node.value
+                kwargs = _jit_kwargs(call)
+                if kwargs is not None and not _is_partial(call.func):
+                    # jax.jit(fn, ...)
+                    wrapped = (
+                        call.args[0].id
+                        if call.args and isinstance(call.args[0], ast.Name)
+                        else None
+                    )
+                    add_entry(tgt, node.lineno, kwargs, wrapped)
+                elif isinstance(call.func, ast.Call):
+                    # partial(jax.jit, ...)(fn)
+                    inner_kwargs = _jit_kwargs(call.func)
+                    if inner_kwargs is not None:
+                        wrapped = (
+                            call.args[0].id
+                            if call.args
+                            and isinstance(call.args[0], ast.Name)
+                            else None
+                        )
+                        add_entry(tgt, node.lineno, inner_kwargs, wrapped)
+    ctx.jit_names = frozenset(e.name for e in ctx.jit_entries)
+    return ctx
+
+
+# --- driver ------------------------------------------------------------------
+
+
+def run_lint(
+    paths: Sequence[str],
+    root: str,
+    passes: Optional[Sequence[str]] = None,
+    exclude: Sequence[str] = DEFAULT_EXCLUDE,
+) -> List[Violation]:
+    from kubernetriks_tpu.lint import (
+        donation,
+        envflags,
+        hostsync,
+        jitstatic,
+        prng,
+    )
+
+    selected = tuple(passes) if passes else PASS_IDS
+    unknown = set(selected) - set(PASS_IDS)
+    if unknown:
+        raise ValueError(f"unknown lint pass(es): {sorted(unknown)}")
+    files = collect_files(paths, root, exclude=exclude)
+    ctx = build_context(files)
+    checkers = {
+        "donation": donation.check,
+        "hostsync": hostsync.check,
+        "jitstatic": jitstatic.check,
+        "prng": prng.check,
+        "envflags": envflags.check,
+    }
+    violations: List[Violation] = []
+    seen = set()
+    for pass_id in selected:
+        for v in checkers[pass_id](ctx):
+            # loop bodies are walked twice (donation) — dedupe exact repeats
+            if v not in seen:
+                seen.add(v)
+                violations.append(v)
+    violations.sort(key=lambda v: (v.path, v.line, v.pass_id))
+    return violations
+
+
+def list_waivers(paths: Sequence[str], root: str) -> List[str]:
+    """Greppable sync-budget listing: every waiver in scope with its reason."""
+    out = []
+    for sf in collect_files(paths, root):
+        for line, entries in sorted(sf.waivers.items()):
+            for tag, reason in entries:
+                out.append(f"{sf.path}:{line}: {tag}-ok({reason})")
+    return out

@@ -1,0 +1,104 @@
+"""CLI: `python -m kubernetriks_tpu.lint [paths...]`.
+
+Default scope is the repo's lintable surface: the package, bench.py,
+tests/, scripts/ and experiments/ (the self-test fixtures under
+tests/lint_fixtures/ are excluded — they hold seeded violations on
+purpose; pass their paths explicitly to lint them, as tests/test_lint.py
+does). Exit status: 0 clean, 1 violations, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from kubernetriks_tpu.lint import PASS_IDS, list_waivers, run_lint
+
+DEFAULT_SCOPE = (
+    "kubernetriks_tpu",
+    "bench.py",
+    "tests",
+    "scripts",
+    "experiments",
+)
+
+
+def _find_root(start: str) -> str:
+    """Repo root = nearest ancestor holding the package directory."""
+    cur = os.path.abspath(start)
+    while True:
+        if os.path.isdir(os.path.join(cur, "kubernetriks_tpu")):
+            return cur
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            return os.path.abspath(start)
+        cur = parent
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m kubernetriks_tpu.lint",
+        description="ktpu-lint: framework-invariant static analysis "
+        "(donation safety, host-sync discipline, jit-static discipline, "
+        "PRNG hygiene, env-flag registry).",
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        help="files/directories to lint (default: the repo surface)",
+    )
+    parser.add_argument(
+        "--pass",
+        dest="passes",
+        action="append",
+        choices=PASS_IDS,
+        help="run only the named pass (repeatable; default: all five)",
+    )
+    parser.add_argument(
+        "--root",
+        default=None,
+        help="repo root for relative paths (default: auto-detected)",
+    )
+    parser.add_argument(
+        "--list-waivers",
+        action="store_true",
+        help="print every # ktpu: *-ok(reason) waiver in scope (the "
+        "greppable sync budget) and exit 0",
+    )
+    args = parser.parse_args(argv)
+
+    root = os.path.abspath(args.root) if args.root else _find_root(os.getcwd())
+    paths = args.paths or [
+        p for p in DEFAULT_SCOPE if os.path.exists(os.path.join(root, p))
+    ]
+    if not paths:
+        print("ktpu-lint: nothing to lint", file=sys.stderr)
+        return 2
+
+    if args.list_waivers:
+        for line in list_waivers(paths, root):
+            print(line)
+        return 0
+
+    violations = run_lint(paths, root, passes=args.passes)
+    for v in violations:
+        print(v.render())
+    n_files = len(
+        {v.path for v in violations}
+    )
+    if violations:
+        print(
+            f"ktpu-lint: {len(violations)} violation(s) in {n_files} file(s)",
+            file=sys.stderr,
+        )
+        return 1
+    print("ktpu-lint: clean", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # `| head` closed the pipe; not an error
+        sys.exit(0)

@@ -1,0 +1,238 @@
+"""Donation-safety pass: no read-after-donate.
+
+A call to a `donate_argnums` jit entry consumes the buffers passed at the
+donated positions — on TPU they are reused for the outputs, and any later
+read of the donated variable observes garbage (or raises). On CPU donation
+is a no-op, so the bug class silently passes CI. This pass flags, within a
+function body, any read of a variable (dotted path: `state`, `self.state`)
+passed positionally at a donated index of a known donated entry AFTER the
+call, unless the variable was rebound (typically from the call's own
+result) first.
+
+The donated-entry table comes from scanning `jax.jit` / `partial(jax.jit,
+...)` sites package-wide (lint.build_context), not from a hardcoded list.
+Local aliases are tracked (`fn = run_windows_donated if donate else
+run_windows` makes `fn(...)` a possibly-donating call).
+
+Analysis is a linear abstract interpretation over statement lists: branch
+arms are analyzed with the same entry state and their poison sets union at
+the join (conservative: rebinding on only one arm keeps the variable
+poisoned); loop bodies run twice so a read before the donating call is
+caught on the simulated second iteration when the rebind is missing.
+
+Waive with `# ktpu: donation-ok(<reason>)` on the read's line.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from kubernetriks_tpu.lint import (
+    LintContext,
+    SourceFile,
+    Violation,
+    dotted_name,
+    local_entry_aliases,
+)
+
+PASS_ID = "donation"
+
+
+class _FunctionChecker:
+    def __init__(
+        self,
+        sf: SourceFile,
+        ctx: LintContext,
+        fn: ast.FunctionDef,
+        violations: List[Violation],
+    ):
+        self.sf = sf
+        self.ctx = ctx
+        self.fn = fn
+        self.violations = violations
+        self.aliases = local_entry_aliases(fn, ctx.donated)
+        # poisoned dotted path -> (donating entry name, call line)
+        self.poisoned: Dict[str, tuple] = {}
+
+    # -- helpers --------------------------------------------------------------
+
+    def _donated_positions(self, call: ast.Call) -> Optional[tuple]:
+        name = dotted_name(call.func)
+        if name is None:
+            return None
+        bare = name.rsplit(".", 1)[-1]
+        if bare in self.ctx.donated:
+            return self.ctx.donated[bare]
+        if bare in self.aliases:
+            # union of donated positions across possible targets
+            pos: Set[int] = set()
+            for entry in self.aliases[bare]:
+                pos.update(self.ctx.donated[entry])
+            return tuple(sorted(pos))
+        return None
+
+    def _check_reads(self, node: ast.AST) -> None:
+        """Flag loads of poisoned paths anywhere in an expression tree
+        (outermost chain node only: `state.time` is one read, not two)."""
+        inner = set()
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Attribute):
+                v = sub.value
+                while isinstance(v, ast.Attribute):
+                    inner.add(id(v))
+                    v = v.value
+                if isinstance(v, ast.Name):
+                    inner.add(id(v))
+        for sub in ast.walk(node):
+            if not isinstance(sub, (ast.Name, ast.Attribute)):
+                continue
+            if id(sub) in inner:
+                continue
+            if not isinstance(getattr(sub, "ctx", None), ast.Load):
+                continue
+            path = dotted_name(sub)
+            if path is None:
+                continue
+            for poisoned, (entry, call_line) in self.poisoned.items():
+                if path == poisoned or path.startswith(poisoned + "."):
+                    line = sub.lineno
+                    if not self.sf.waived(line, PASS_ID):
+                        self.violations.append(
+                            Violation(
+                                self.sf.path,
+                                line,
+                                PASS_ID,
+                                f"read of {path!r} after it was donated to "
+                                f"{entry}() on line {call_line}; rebind it "
+                                "from the call's result (or waive: "
+                                "# ktpu: donation-ok(reason))",
+                            )
+                        )
+                    break
+
+    def _poison_calls(self, node: ast.AST) -> None:
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            positions = self._donated_positions(sub)
+            if not positions:
+                continue
+            name = dotted_name(sub.func)
+            bare = name.rsplit(".", 1)[-1] if name else "<call>"
+            for idx in positions:
+                if idx < len(sub.args):
+                    path = dotted_name(sub.args[idx])
+                    if path is not None:
+                        self.poisoned[path] = (bare, sub.lineno)
+
+    def _unpoison_targets(self, targets) -> None:
+        for tgt in targets:
+            if isinstance(tgt, (ast.Tuple, ast.List)):
+                self._unpoison_targets(tgt.elts)
+                continue
+            path = dotted_name(tgt)
+            if path is None:
+                continue
+            for poisoned in list(self.poisoned):
+                if poisoned == path or poisoned.startswith(path + "."):
+                    del self.poisoned[poisoned]
+
+    # -- statement walk -------------------------------------------------------
+
+    def run(self) -> None:
+        self.visit_stmts(self.fn.body)
+
+    def visit_stmts(self, stmts) -> None:
+        for st in stmts:
+            self.visit_stmt(st)
+
+    def _expr_parts(self, st: ast.stmt):
+        """Expression children of a statement, EXCLUDING nested bodies."""
+        for fld, value in ast.iter_fields(st):
+            if fld in ("body", "orelse", "finalbody", "handlers"):
+                continue
+            if isinstance(value, ast.expr):
+                yield value
+            elif isinstance(value, list):
+                for v in value:
+                    if isinstance(v, ast.expr):
+                        yield v
+
+    def _simple(self, st: ast.stmt) -> None:
+        """Read-check, then poison donating calls, then apply rebinds —
+        in that order, so `state = f(state)` is clean (the arg read happens
+        at the donation itself, and the target rebind lifts the poison)."""
+        for part in self._expr_parts(st):
+            self._check_reads(part)
+            self._poison_calls(part)
+        if isinstance(st, ast.Assign):
+            self._unpoison_targets(st.targets)
+        elif isinstance(st, (ast.AugAssign, ast.AnnAssign)) and st.target:
+            self._unpoison_targets([st.target])
+        elif isinstance(st, ast.Delete):
+            self._unpoison_targets(st.targets)
+
+    def visit_stmt(self, st: ast.stmt) -> None:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return  # nested scopes are analyzed as their own functions
+        if isinstance(st, ast.If):
+            self._check_reads(st.test)
+            self._poison_calls(st.test)
+            self._branch([st.body, st.orelse])
+            return
+        if isinstance(st, (ast.For, ast.AsyncFor)):
+            self._check_reads(st.iter)
+            self._poison_calls(st.iter)
+            self._loop(st.body)
+            self.visit_stmts(st.orelse)
+            return
+        if isinstance(st, ast.While):
+            self._check_reads(st.test)
+            self._poison_calls(st.test)
+            self._loop(st.body, extra_exprs=[st.test])
+            self.visit_stmts(st.orelse)
+            return
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            for item in st.items:
+                self._check_reads(item.context_expr)
+                self._poison_calls(item.context_expr)
+                if item.optional_vars is not None:
+                    self._unpoison_targets([item.optional_vars])
+            self.visit_stmts(st.body)
+            return
+        if isinstance(st, ast.Try):
+            self.visit_stmts(st.body)
+            for handler in st.handlers:
+                self.visit_stmts(handler.body)
+            self.visit_stmts(st.orelse)
+            self.visit_stmts(st.finalbody)
+            return
+        self._simple(st)
+
+    def _branch(self, arms) -> None:
+        entry = dict(self.poisoned)
+        merged: Dict[str, tuple] = {}
+        for arm in arms:
+            self.poisoned = dict(entry)
+            self.visit_stmts(arm)
+            merged.update(self.poisoned)
+        self.poisoned = merged
+
+    def _loop(self, body, extra_exprs=()) -> None:
+        # Two iterations: the second catches loop-carried reads of a
+        # variable donated (and not rebound) on the first.
+        for _ in range(2):
+            self.visit_stmts(body)
+            for e in extra_exprs:
+                self._check_reads(e)
+                self._poison_calls(e)
+
+
+def check(ctx: LintContext) -> List[Violation]:
+    violations: List[Violation] = []
+    for sf in ctx.files:
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                _FunctionChecker(sf, ctx, node, violations).run()
+    return violations

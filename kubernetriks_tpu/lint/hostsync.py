@@ -1,0 +1,350 @@
+"""Host-sync discipline pass: hot paths must not grow implicit host syncs.
+
+Within hot-path modules (lint.HOT_MODULES, or any file carrying a
+`# ktpu: hot-path` pragma), flags:
+
+- `.item()` calls and `.block_until_ready()` / `jax.block_until_ready`;
+- `jax.device_get`, `to_host` (the multihost device-get wrapper),
+  `np.asarray` / `np.array` — host materialization of device values;
+- `int()` / `float()` / `bool()` applied to array-valued expressions
+  (blocking device-to-host readback through `__int__`/`__bool__`);
+- Python `if`/`while` branching on traced/array values (an implicit
+  `bool()` sync).
+
+"Array-valued" is a function-local taint analysis: `jnp.*` / `jax.lax.*`
+expressions and calls to known jitted entries (the package-wide jit table,
+plus local aliases like `fn = run_windows_donated if ... else run_windows`)
+are sources; taint propagates through names assigned from tainted
+expressions, through `self.X` attributes assigned from tainted expressions
+anywhere in the same class, and through arithmetic/subscripts/attribute
+access — but NOT through the sync calls themselves (`int(...)`,
+`to_host(...)`, `np.asarray(...)` yield host values: the sync is flagged
+at the conversion, and downstream host logic stays clean). `is`/`is not`
+comparisons, `hasattr`, `isinstance`, `len` and `.shape`/`.dtype`/`.ndim`
+reads never sync and never taint.
+
+Every legitimate sync carries `# ktpu: sync-ok(<reason>)` on its line — or
+on the enclosing `def` line to waive a whole (cold-path) function — which
+makes the hot paths' sync budget greppable:
+    grep -rn "ktpu: sync-ok" kubernetriks_tpu/
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from kubernetriks_tpu.lint import (
+    LintContext,
+    SourceFile,
+    Violation,
+    dotted_name,
+    is_hot,
+    local_entry_aliases,
+)
+
+PASS_ID = "hostsync"
+
+_SYNC_FUNCS = {
+    "jax.device_get": "jax.device_get",
+    "device_get": "device_get",
+    "jax.block_until_ready": "jax.block_until_ready",
+    "block_until_ready": "block_until_ready",
+    "to_host": "to_host (device-to-host fetch)",
+    "np.asarray": "np.asarray on device values",
+    "np.array": "np.array on device values",
+    "numpy.asarray": "np.asarray on device values",
+    "numpy.array": "np.array on device values",
+}
+_SYNC_METHODS = {"item", "block_until_ready"}
+_CAST_FUNCS = {"int", "float", "bool"}
+# Never sync and never propagate taint.
+_NEUTRAL_FUNCS = {"hasattr", "isinstance", "len", "getattr", "type", "id"}
+_NEUTRAL_ATTRS = {"shape", "dtype", "ndim", "size", "sharding"}
+_TAINT_ROOTS = ("jnp.", "jax.")
+
+
+class _ClassTaint:
+    """self.X attributes assigned from tainted expressions anywhere in a
+    class body taint `self.X` reads in every method of that class."""
+
+    def __init__(self):
+        self.attrs: Set[str] = set()
+
+
+class _FunctionChecker:
+    def __init__(
+        self,
+        sf: SourceFile,
+        ctx: LintContext,
+        fn: ast.FunctionDef,
+        class_taint: Optional[_ClassTaint],
+        violations: List[Violation],
+    ):
+        self.sf = sf
+        self.ctx = ctx
+        self.fn = fn
+        self.class_taint = class_taint
+        self.violations = violations
+        self.tainted: Set[str] = set()
+        self.fn_waived = sf.waived(fn.lineno, PASS_ID)
+        self.jit_like = self._local_jit_aliases()
+
+    def _local_jit_aliases(self) -> Set[str]:
+        return set(self.ctx.jit_names) | set(
+            local_entry_aliases(self.fn, self.ctx.jit_names)
+        )
+
+    # -- taint ----------------------------------------------------------------
+
+    def _is_tainted(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Call):
+            fname = dotted_name(node.func)
+            if fname is not None:
+                bare = fname.rsplit(".", 1)[-1]
+                if fname in _SYNC_FUNCS or bare in _CAST_FUNCS:
+                    return False  # conversion yields a host value
+                if bare in _NEUTRAL_FUNCS:
+                    return False
+                if fname.startswith(_TAINT_ROOTS) or bare in self.jit_like:
+                    return True
+            # method calls on tainted receivers stay tainted (.sum(), .any())
+            if isinstance(node.func, ast.Attribute):
+                if node.func.attr in _SYNC_METHODS:
+                    return False
+                return self._is_tainted(node.func.value)
+            return False
+        if isinstance(node, ast.Attribute):
+            if node.attr in _NEUTRAL_ATTRS:
+                return False
+            path = dotted_name(node)
+            if path is not None:
+                if path in self.tainted:
+                    return True
+                if (
+                    self.class_taint is not None
+                    and path.startswith("self.")
+                    and path.split(".")[1] in self.class_taint.attrs
+                ):
+                    return True
+                if path.startswith(_TAINT_ROOTS):
+                    return False  # module constant like jnp.int32
+            return self._is_tainted(node.value)
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Subscript):
+            return self._is_tainted(node.value)
+        if isinstance(node, ast.BinOp):
+            return self._is_tainted(node.left) or self._is_tainted(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self._is_tainted(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any(self._is_tainted(v) for v in node.values)
+        if isinstance(node, ast.Compare):
+            # `x is None` / `x is not y` never reads the array's value.
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return False
+            return self._is_tainted(node.left) or any(
+                self._is_tainted(c) for c in node.comparators
+            )
+        if isinstance(node, ast.IfExp):
+            return self._is_tainted(node.body) or self._is_tainted(node.orelse)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self._is_tainted(e) for e in node.elts)
+        if isinstance(node, ast.Starred):
+            return self._is_tainted(node.value)
+        return False
+
+    def _assign_taint(self, targets, value) -> None:
+        tainted = self._is_tainted(value)
+
+        def mark(tgt, is_tainted):
+            if isinstance(tgt, (ast.Tuple, ast.List)):
+                # tuple unpack of a tainted rhs taints every element
+                for e in tgt.elts:
+                    mark(e, is_tainted)
+                return
+            path = dotted_name(tgt)
+            if path is None:
+                return
+            if is_tainted:
+                self.tainted.add(path)
+            else:
+                self.tainted.discard(path)
+
+        for tgt in targets:
+            mark(tgt, tainted)
+
+    # -- violations -----------------------------------------------------------
+
+    def _flag(self, node: ast.AST, message: str) -> None:
+        line = node.lineno
+        if self.fn_waived or self.sf.waived(line, PASS_ID):
+            return
+        self.violations.append(
+            Violation(
+                self.sf.path,
+                line,
+                PASS_ID,
+                f"{message} in hot-path module; waive a legitimate sync "
+                "with # ktpu: sync-ok(reason)",
+            )
+        )
+
+    def _check_expr(self, node: ast.AST) -> None:
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            fname = dotted_name(sub.func)
+            if fname in _SYNC_FUNCS:
+                self._flag(sub, f"host sync: {_SYNC_FUNCS[fname]}")
+                continue
+            if (
+                isinstance(sub.func, ast.Attribute)
+                and sub.func.attr in _SYNC_METHODS
+                and not sub.args
+            ):
+                self._flag(sub, f"host sync: .{sub.func.attr}()")
+                continue
+            if (
+                fname in _CAST_FUNCS
+                and len(sub.args) == 1
+                and self._is_tainted(sub.args[0])
+            ):
+                self._flag(
+                    sub,
+                    f"host sync: {fname}() on an array-valued expression "
+                    "(blocking device-to-host readback)",
+                )
+
+    # -- walk -----------------------------------------------------------------
+
+    def run(self) -> None:
+        self.visit_stmts(self.fn.body)
+
+    def visit_stmts(self, stmts) -> None:
+        for st in stmts:
+            self.visit_stmt(st)
+
+    def visit_stmt(self, st: ast.stmt) -> None:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return
+        if isinstance(st, (ast.If, ast.While)):
+            self._check_expr(st.test)
+            if self._is_tainted(st.test):
+                self._flag(
+                    st,
+                    "Python branch on a traced/array value (implicit bool() "
+                    "sync)",
+                )
+            for body in (st.body, st.orelse):
+                self.visit_stmts(body)
+            return
+        if isinstance(st, (ast.For, ast.AsyncFor)):
+            self._check_expr(st.iter)
+            if self._is_tainted(st.iter):
+                self._flag(st, "Python iteration over a traced/array value")
+            self.visit_stmts(st.body)
+            self.visit_stmts(st.orelse)
+            return
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            for item in st.items:
+                self._check_expr(item.context_expr)
+            self.visit_stmts(st.body)
+            return
+        if isinstance(st, ast.Try):
+            self.visit_stmts(st.body)
+            for handler in st.handlers:
+                self.visit_stmts(handler.body)
+            self.visit_stmts(st.orelse)
+            self.visit_stmts(st.finalbody)
+            return
+        # simple statement: check expressions, then propagate assignment taint
+        for fld, value in ast.iter_fields(st):
+            if isinstance(value, ast.expr):
+                self._check_expr(value)
+            elif isinstance(value, list):
+                for v in value:
+                    if isinstance(v, ast.expr):
+                        self._check_expr(v)
+        if isinstance(st, ast.Assign):
+            self._assign_taint(st.targets, st.value)
+        elif isinstance(st, ast.AnnAssign) and st.value is not None:
+            self._assign_taint([st.target], st.value)
+        elif isinstance(st, ast.AugAssign):
+            if self._is_tainted(st.value):
+                path = dotted_name(st.target)
+                if path is not None:
+                    self.tainted.add(path)
+
+
+def _collect_class_taint(cls: ast.ClassDef, ctx: LintContext) -> _ClassTaint:
+    taint = _ClassTaint()
+
+    def expr_seeds(node, jit_names) -> bool:
+        """Seed-level taint for class attrs: jnp/jax expressions and jitted
+        calls (no fixpoint across methods — one level is what the real
+        code needs: self.state / self._pending_shift style mirrors)."""
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                fname = dotted_name(sub.func)
+                if fname is not None:
+                    bare = fname.rsplit(".", 1)[-1]
+                    if bare in ("int", "float", "bool", "to_host", "asarray"):
+                        return False
+                    if fname.startswith(_TAINT_ROOTS) or bare in jit_names:
+                        return True
+        return False
+
+    # Collect names assigned from jitted-call results per method, then mark
+    # self.X = <such name> too (the `state, rank, p = fn(...)` ->
+    # `self.state = state` pattern).
+    for method in cls.body:
+        if not isinstance(method, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        jit_like = set(ctx.jit_names) | set(
+            local_entry_aliases(method, ctx.jit_names)
+        )
+        local_tainted: Set[str] = set()
+        for node in ast.walk(method):
+            if not isinstance(node, ast.Assign):
+                continue
+            value_tainted = expr_seeds(node.value, jit_like)
+            if not value_tainted:
+                name = dotted_name(node.value)
+                value_tainted = name in local_tainted if name else False
+            for tgt in node.targets:
+                elts = (
+                    tgt.elts if isinstance(tgt, (ast.Tuple, ast.List)) else [tgt]
+                )
+                for e in elts:
+                    path = dotted_name(e)
+                    if path is None:
+                        continue
+                    if value_tainted:
+                        if path.startswith("self."):
+                            taint.attrs.add(path.split(".")[1])
+                        else:
+                            local_tainted.add(path)
+    return taint
+
+
+def check(ctx: LintContext) -> List[Violation]:
+    violations: List[Violation] = []
+    for sf in ctx.files:
+        if not is_hot(sf):
+            continue
+        # top-level functions
+        for node in sf.tree.body if isinstance(sf.tree, ast.Module) else []:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                _FunctionChecker(sf, ctx, node, None, violations).run()
+            elif isinstance(node, ast.ClassDef):
+                taint = _collect_class_taint(node, ctx)
+                for method in node.body:
+                    if isinstance(
+                        method, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        _FunctionChecker(
+                            sf, ctx, method, taint, violations
+                        ).run()
+    return violations

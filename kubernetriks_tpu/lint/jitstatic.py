@@ -1,0 +1,90 @@
+"""Jit-static discipline pass.
+
+Two rules over the package-wide jit table (lint.build_context — every
+`jax.jit` / `partial(jax.jit, ...)` site, decorator or assignment form,
+with `static_argnames` resolved through module-level tuple constants and
+`+` concatenations):
+
+1. Every `static_argnames` entry must name a parameter of the wrapped
+   function. A stale static name silently traces the (vanished or renamed)
+   kwarg — the PR 2 `fault_params` regression class — or raises only at
+   first call.
+2. Paired donated/undonated entries (`X` and `X_donated` in the same
+   module) must declare identical static sets: drift makes a kwarg static
+   in one variant and traced in the other, so the "bit-identical" pair
+   quietly compiles different programs (they drifted once already in
+   step.py).
+
+Unresolvable `static_argnames` expressions (anything beyond literals,
+module constants and `+`) are themselves violations: the discipline is
+only checkable when the set is statically known.
+
+Waive with `# ktpu: static-ok(<reason>)` on the jit site's line.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, List, Tuple
+
+from kubernetriks_tpu.lint import JitEntry, LintContext, Violation
+
+PASS_ID = "jitstatic"
+
+
+def check(ctx: LintContext) -> List[Violation]:
+    violations: List[Violation] = []
+    by_file = {sf.path: sf for sf in ctx.files}
+
+    def flag(entry: JitEntry, message: str) -> None:
+        sf = by_file.get(entry.path)
+        if sf is not None and sf.waived(entry.line, PASS_ID):
+            return
+        violations.append(Violation(entry.path, entry.line, PASS_ID, message))
+
+    # Rule 1: statics name real parameters.
+    for entry in ctx.jit_entries:
+        if not entry.static_resolved:
+            flag(
+                entry,
+                f"static_argnames of {entry.name} could not be resolved "
+                "statically (use a literal tuple, a module-level tuple "
+                "constant, or + concatenations of those)",
+            )
+            continue
+        if entry.params is None:
+            continue  # wrapped function defined elsewhere; nothing to check
+        for static in entry.static_argnames or ():
+            if static not in entry.params and not entry.has_varkw:
+                flag(
+                    entry,
+                    f"static_argnames entry {static!r} of {entry.name} names "
+                    "no parameter of the wrapped function (params: "
+                    f"{', '.join(entry.params)})",
+                )
+
+    # Rule 2: donated/undonated pairs declare identical static sets.
+    by_name: Dict[Tuple[str, str], List[JitEntry]] = defaultdict(list)
+    for entry in ctx.jit_entries:
+        by_name[(entry.path, entry.name)].append(entry)
+    for (path, name), entries in sorted(by_name.items()):
+        if not name.endswith("_donated"):
+            continue
+        base = by_name.get((path, name[: -len("_donated")]))
+        if not base:
+            continue
+        donated_entry, base_entry = entries[0], base[0]
+        if not (donated_entry.static_resolved and base_entry.static_resolved):
+            continue  # already flagged by rule 1
+        d_set = frozenset(donated_entry.static_argnames or ())
+        b_set = frozenset(base_entry.static_argnames or ())
+        if d_set != b_set:
+            diff = sorted(d_set.symmetric_difference(b_set))
+            flag(
+                donated_entry,
+                f"static sets of {name} and {base_entry.name} differ "
+                f"(line {base_entry.line}): {diff} — paired "
+                "donated/undonated entries must declare identical "
+                "static_argnames or they compile different programs",
+            )
+    return violations

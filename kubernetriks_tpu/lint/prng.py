@@ -1,0 +1,99 @@
+"""PRNG-hygiene pass: simulation-path randomness routes through chaos.py.
+
+Scalar/batched bit-identity (the framework's core exactness promise, pinned
+by the equivalence suites) holds because every random draw on the
+simulation path flows through the counter-based threefry keying in
+`chaos.py` — keys are pure functions of (seed, stream, cluster, object,
+counter), so the scalar oracle and the batched engine draw identical
+numbers in any order. An ad-hoc `jax.random.PRNGKey` / `np.random` /
+stdlib-`random` draw in a simulation-path module breaks that silently.
+
+Within simulation-path modules (lint.SIM_MODULES, or a
+`# ktpu: sim-path` pragma), flags:
+
+- any `jax.random.*` attribute use (PRNGKey, split, uniform, ...);
+- any `np.random.*` / `numpy.random.*` use;
+- stdlib `random` usage (`import random`, `random.*`, `from random
+  import ...`);
+- `from jax import random` / `from jax.random import ...` and
+  `from numpy.random import ...` imports.
+
+chaos.py itself (the key constructor) lives at the package root, outside
+the simulation-path module set. Waive deliberate uses with
+`# ktpu: prng-ok(<reason>)` — e.g. the scalar kernel's seeded
+reference-port RNG.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from kubernetriks_tpu.lint import (
+    LintContext,
+    SourceFile,
+    Violation,
+    dotted_name,
+    is_sim_path,
+)
+
+PASS_ID = "prng"
+
+_FORBIDDEN_PREFIXES = ("jax.random.", "np.random.", "numpy.random.", "random.")
+_FORBIDDEN_IMPORT_MODULES = ("jax.random", "numpy.random", "random")
+
+
+def _flag(sf: SourceFile, node: ast.AST, what: str, out: List[Violation]):
+    if sf.waived(node.lineno, PASS_ID):
+        return
+    out.append(
+        Violation(
+            sf.path,
+            node.lineno,
+            PASS_ID,
+            f"{what} in a simulation-path module: route all draws through "
+            "the counter-based key constructors in chaos.py "
+            "(object_uniforms / pod_attempt_uniforms) or scalar/batched "
+            "bit-identity breaks; waive with # ktpu: prng-ok(reason)",
+        )
+    )
+
+
+def check(ctx: LintContext) -> List[Violation]:
+    violations: List[Violation] = []
+    for sf in ctx.files:
+        if not is_sim_path(sf):
+            continue
+        # `import random` presence makes bare `random.` stdlib usage — track
+        # whether the name is bound to something else (e.g. a local module).
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name in _FORBIDDEN_IMPORT_MODULES:
+                        _flag(sf, node, f"import of {alias.name!r}", violations)
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if mod in _FORBIDDEN_IMPORT_MODULES:
+                    _flag(
+                        sf,
+                        node,
+                        f"import from {mod!r} "
+                        f"({', '.join(a.name for a in node.names)})",
+                        violations,
+                    )
+                elif mod == "jax" and any(
+                    a.name == "random" for a in node.names
+                ):
+                    _flag(sf, node, "import of jax.random", violations)
+                elif mod == "numpy" and any(
+                    a.name == "random" for a in node.names
+                ):
+                    _flag(sf, node, "import of numpy.random", violations)
+            elif isinstance(node, ast.Attribute):
+                path = dotted_name(node)
+                if path is not None and any(
+                    path.startswith(p) or path == p.rstrip(".")
+                    for p in _FORBIDDEN_PREFIXES
+                ):
+                    _flag(sf, node, f"use of {path}", violations)
+    return violations

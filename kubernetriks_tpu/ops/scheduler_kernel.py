@@ -35,7 +35,6 @@ check in scripts/check_tpu_parity.py.
 from __future__ import annotations
 
 import functools
-import os
 from typing import Tuple
 
 import jax
@@ -57,9 +56,11 @@ _COMPILER_PARAMS = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerPa
 def default_enabled() -> bool:
     """Use the kernel when running on a real TPU backend unless overridden
     via KUBERNETRIKS_PALLAS=0/1."""
-    env = os.environ.get("KUBERNETRIKS_PALLAS")
+    from kubernetriks_tpu.flags import flag_tristate
+
+    env = flag_tristate("KUBERNETRIKS_PALLAS")
     if env is not None:
-        return env not in ("0", "false", "off")
+        return env
     try:
         return jax.default_backend() == "tpu"
     except Exception:

@@ -24,6 +24,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
+from kubernetriks_tpu.sanitize import assert_sync_allowed
+
 
 def initialize_from_env(
     coordinator_address: Optional[str] = None,
@@ -92,7 +94,13 @@ def put_global(tree, shardings):
 def to_host(x) -> np.ndarray:
     """Global host copy of a (possibly cross-process sharded) array: plain
     np.asarray when this process addresses all shards, otherwise an
-    allgather over DCN."""
+    allgather over DCN.
+
+    THE framework's device-to-host choke point: under KTPU_SANITIZE an
+    unwaived call inside the sanitized dispatch region raises (jax's
+    transfer guard never fires on the CPU backend, so the sanitizer
+    carries its own net here)."""
+    assert_sync_allowed("to_host")
     if getattr(x, "is_fully_addressable", True):
         return np.asarray(x)
     from jax.experimental import multihost_utils

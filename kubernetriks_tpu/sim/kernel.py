@@ -16,7 +16,7 @@ in emission order, which reproduces DSLab's stable FIFO-per-timestamp ordering.
 from __future__ import annotations
 
 import heapq
-import random
+import random  # ktpu: prng-ok(scalar oracle kernel: the reference simulator's own seeded RNG — reference-port semantics, isolated from the batched path)
 import string
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
@@ -108,7 +108,7 @@ class Simulation:
     """The global event loop (DSLab Simulation equivalent)."""
 
     def __init__(self, seed: int) -> None:
-        self.rng = random.Random(seed)
+        self.rng = random.Random(seed)  # ktpu: prng-ok(seeded reference-port RNG; the batched path never consumes it)
         self._queue: List[Event] = []
         self._next_event_id = 0
         self._time = 0.0

@@ -1,0 +1,32 @@
+"""Seeded violations: donation through a local alias and through `self.X`.
+
+The engine's real call sites go through aliases
+(`fn = run_donated if self.donate else run_plain`), so the pass must
+poison arguments of alias calls too; and the donated argument is usually
+an attribute path (`self.state`), which must poison deeper reads
+(`self.state.pods`) until the attribute is rebound.
+"""
+
+import jax
+
+
+def _impl(state, w):
+    return state
+
+
+run_plain = jax.jit(_impl)
+run_donated = jax.jit(_impl, donate_argnums=(0,))
+
+
+class Driver:
+    def step_bad(self, w):
+        fn = run_donated if self.donate else run_plain
+        out = fn(self.state, w)
+        phases = self.state.pods  # BAD: read before rebinding self.state
+        self.state = out
+        return phases
+
+    def step_good(self, w):
+        fn = run_donated if self.donate else run_plain
+        self.state = fn(self.state, w)
+        return self.state.pods  # fine: rebound

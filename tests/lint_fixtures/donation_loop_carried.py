@@ -1,0 +1,24 @@
+"""Seeded violation: loop-carried read-after-donate.
+
+The first iteration donates `state` and stores the result somewhere else;
+the second iteration's `state.time` read hits the donated buffer. A single
+linear walk misses it — the pass walks loop bodies twice.
+"""
+
+import jax
+
+
+@jax.jit
+def _impl(state):
+    return state
+
+
+step_donated = jax.jit(_impl, donate_argnums=(0,))
+
+
+def bad_loop(state, n):
+    outs = []
+    for _ in range(n):
+        outs.append(step_donated(state))  # BAD on iteration 2: state was
+        # donated on iteration 1 and never rebound
+    return outs

@@ -1,0 +1,25 @@
+"""Seeded violation: read of a donated variable after the donated call.
+
+On TPU, `state`'s buffers are consumed by the call; the `.time` read on the
+last line observes garbage. On CPU (donation no-op) it silently passes —
+exactly the bug class the donation pass exists for.
+"""
+
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def consume_state(state, idxs):
+    return state
+
+
+def bad_driver(state, idxs):
+    new_state = consume_state(state, idxs)
+    return new_state, state.time  # BAD: read after donate
+
+
+def good_driver(state, idxs):
+    state = consume_state(state, idxs)
+    return state, state.time  # fine: rebound from the call's result

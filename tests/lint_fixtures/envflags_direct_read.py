@@ -1,0 +1,9 @@
+"""Seeded violation: direct environment read of a registered KTPU_* flag
+outside flags.py (ad-hoc truthiness — the `env != "0"` class)."""
+
+import os
+
+
+def superspan_enabled():
+    env = os.environ.get("KTPU_SUPERSPAN")  # BAD: bypasses the registry
+    return env != "0" if env is not None else False

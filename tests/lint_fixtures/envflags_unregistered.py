@@ -1,0 +1,10 @@
+"""Seeded violations: reads of KTPU_* names missing from the registry."""
+
+import os
+
+
+def mystery_knobs():
+    a = os.environ["KTPU_NOT_A_FLAG"]  # BAD: unregistered (and direct)
+    b = "KUBERNETRIKS_SECRET_MODE" in os.environ  # BAD: unregistered read
+    c = os.getenv("KTPU_TURBO", "1")  # BAD: unregistered (and direct)
+    return a, b, c

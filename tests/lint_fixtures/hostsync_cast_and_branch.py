@@ -1,0 +1,19 @@
+# ktpu: hot-path
+"""Seeded violations: implicit syncs through int() casts and Python
+branches on traced values (the `if shift > 0:` class that undoes the
+async-readback work)."""
+
+import jax.numpy as jnp
+
+
+def decide_slide(phase, create_win, base):
+    shift = jnp.argmax(phase, axis=1).min()
+    s = int(shift)  # BAD: blocking device-to-host readback via __int__
+    return s
+
+
+def branch_on_traced(state):
+    pending = jnp.sum(state.pods.phase == 1)
+    if pending > 0:  # BAD: Python branch forces bool() on a traced value
+        return True
+    return False

@@ -1,0 +1,19 @@
+# ktpu: hot-path
+"""Seeded violations: blocking host syncs in a hot-path module."""
+
+import jax
+import numpy as np
+
+
+def read_counter(state):
+    return state.metrics.decisions.sum().item()  # BAD: .item() sync
+
+
+def snapshot(state):
+    phases = np.asarray(state.pods.phase)  # BAD: np.asarray materialization
+    jax.block_until_ready(state)  # BAD: blocking fence
+    return phases
+
+
+def waived_counter(state):
+    return state.metrics.decisions.sum().item()  # ktpu: sync-ok(test waiver: readout at span boundary)

@@ -1,0 +1,20 @@
+"""Seeded violation: donated/undonated pair declaring DIFFERENT static
+sets — the drift makes `collect_gauges` traced in one variant only, so the
+"bit-identical pair" compiles different programs (it happened once in
+step.py)."""
+
+import jax
+
+_STATICS = ("max_events", "use_kernel")
+
+
+def _impl(state, slab, max_events, use_kernel, collect_gauges=False):
+    return state
+
+
+run_entry = jax.jit(_impl, static_argnames=_STATICS + ("collect_gauges",))
+run_entry_donated = jax.jit(
+    _impl,
+    static_argnames=_STATICS,  # BAD: missing "collect_gauges"
+    donate_argnums=(0,),
+)

@@ -1,0 +1,15 @@
+"""Seeded violation: static_argnames naming a parameter that doesn't exist
+(the renamed-kwarg regression class: the static set silently stops
+matching and the kwarg traces)."""
+
+from functools import partial
+
+import jax
+
+_STATICS = ("n_windows", "use_kernel")
+
+
+@partial(jax.jit, static_argnames=_STATICS + ("max_pods",))
+def run(state, n_windows, use_kernel, max_pods_per_cycle):
+    # BAD: "max_pods" is not a parameter (it is max_pods_per_cycle)
+    return state

@@ -1,0 +1,11 @@
+# ktpu: sim-path
+"""Seeded violation: ad-hoc jax.random keying on the simulation path
+(order-dependent draws break scalar/batched bit-identity)."""
+
+import jax
+
+
+def crash_draws(seed, n):
+    key = jax.random.PRNGKey(seed)  # BAD
+    keys = jax.random.split(key, n)  # BAD
+    return jax.random.uniform(keys[0], (n,))  # BAD

@@ -1,0 +1,11 @@
+# ktpu: sim-path
+"""Seeded violations: np.random and stdlib random on the simulation path."""
+
+import random  # BAD: stdlib random import
+
+import numpy as np
+
+
+def jitter(n):
+    rng = np.random.default_rng(0)  # BAD
+    return rng.uniform(size=n) + random.random()  # BAD (stdlib draw)

@@ -340,7 +340,9 @@ def test_native_rejects_malformed_machine_id(tmp_path):
 
 import os
 
-_REAL_DIR = os.environ.get("KUBERNETRIKS_ALIBABA_DIR")
+from kubernetriks_tpu.flags import flag_str
+
+_REAL_DIR = flag_str("KUBERNETRIKS_ALIBABA_DIR")
 
 
 def _real_path(name):
