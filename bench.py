@@ -32,6 +32,14 @@ runs and parses end-to-end — the values are meaningless as performance
 numbers — plus a superspan-MACHINERY line (scanned executor forced on,
 in-bench asserts fail on silent fallback to the ladder).
 tests/test_bench_smoke.py pins it under JAX_PLATFORMS=cpu.
+
+`--trace` arms the flight recorder (kubernetriks_tpu/telemetry) on the
+composed lines: the JSON record gains a "telemetry" summary (per-phase
+host wall time, observed syncs vs the documented steady-state budget,
+dispatch stats, device-ring totals) and each traced line writes a
+Perfetto-loadable Chrome trace next to the bench (KTPU_TRACE_PATH stem).
+Telemetry-on is bit-identical to telemetry-off and gated <3% overhead
+(tests/test_telemetry.py), so the traced number IS the tracked number.
 """
 
 import json
@@ -163,6 +171,8 @@ def run_composed(
     faults: bool = False,
     superspan=None,  # tri-state like use_pallas; True also asserts it engaged
     fast_forward=None,
+    trace: bool = False,  # --trace: flight recorder + telemetry in the JSON
+    trace_path: str = None,  # Chrome trace output (Perfetto-loadable)
 ) -> dict:
     """The COMPOSED flagship configuration as a tracked line (VERDICT r3
     item 4): HPA pod groups + cluster autoscaler + sliding pod window +
@@ -237,6 +247,13 @@ cluster_autoscaler:
         use_pallas=use_pallas,
         superspan=superspan,
         fast_forward=fast_forward,
+        # --trace arms the flight recorder: host span tracer + device
+        # metrics ring. Bit-identical to telemetry-off and inside the <3%
+        # overhead gate (tests/test_telemetry.py), so the traced line IS
+        # the tracked line — the BENCH JSON carries its own anatomy.
+        # Without --trace, pass None so a user's KTPU_TRACE=1 still arms
+        # the recorder (a concrete False would override the env flag).
+        telemetry=True if trace else None,
     )
 
     def decisions_now() -> int:
@@ -279,7 +296,7 @@ cluster_autoscaler:
         assert sim.dispatch_stats["window_chunks"] == 0, (
             "composed bench: superspan engine dispatched ladder chunks"
         )
-    return {
+    out = {
         "value": float(np.median(rates)),
         "spans": {
             "n": len(rates),
@@ -287,15 +304,46 @@ cluster_autoscaler:
             "max": round(max(rates)),
         },
     }
+    if trace:
+        # Compact telemetry summary riding in the same JSON line: per-phase
+        # host wall time, the observed sync count vs the documented
+        # steady-state budget (1 progress readback per superspan + 1 shift
+        # readback per fused slide), dispatch stats incl. ladder_fallbacks,
+        # and the device ring's per-window totals.
+        rep = sim.telemetry_report()
+        out["telemetry"] = {
+            "spans_ms": {
+                name: round(s["total_ms"], 3)
+                for name, s in rep["spans"].items()
+            },
+            "sync_budget": rep["sync_budget"],
+            "dispatch_stats": rep["dispatch_stats"],
+            "ring_totals": rep.get("ring", {}).get("totals", {}),
+        }
+        if trace_path:
+            sim.write_chrome_trace(trace_path)
+    return out
+
+
+def _trace_path(label: str) -> str:
+    """Per-line Chrome trace file: <KTPU_TRACE_PATH or ./ktpu_trace>_<label>.json
+    (each traced composed line writes its own file; CI uploads the glob)."""
+    from kubernetriks_tpu.flags import flag_str
+
+    stem = flag_str("KTPU_TRACE_PATH") or "ktpu_trace"
+    return f"{stem}_{label}.json"
 
 
 def _emit(metric: str, value) -> None:
-    # run_composed returns {"value": median, "spans": {n, min, max}} — the
-    # spread rides along in the same JSON line; run_shape returns a bare
-    # float (single timed region, no spread to report).
+    # run_composed returns {"value": median, "spans": {n, min, max}} plus,
+    # under --trace, a "telemetry" summary — both ride along in the same
+    # JSON line; run_shape returns a bare float (single timed region, no
+    # spread to report).
     rec = {"metric": metric}
     if isinstance(value, dict):
         rec["spans"] = value["spans"]
+        if "telemetry" in value:
+            rec["telemetry"] = value["telemetry"]
         value = value["value"]
     rec.update(
         value=round(value),
@@ -309,6 +357,10 @@ def main(argv=None) -> None:
     args = argv if argv is not None else sys.argv[1:]
     smoke = "--smoke" in args
     faults = "--faults" in args
+    # --trace: arm the flight recorder on the composed lines — the
+    # telemetry summary lands in their JSON records and each traced line
+    # writes a Perfetto-loadable Chrome trace (see _trace_path).
+    trace = "--trace" in args
     if smoke:
         # CPU-safe plumbing check: every line must build, run its full
         # composed machinery (slides, HPA, CA asserts included) and print
@@ -327,7 +379,9 @@ def main(argv=None) -> None:
         _emit(
             "pod-scheduling decisions/sec (SMOKE, composed flagship: "
             "4 clusters x HPA+CA+sliding window)",
-            run_composed(4, 8, **smoke_composed),
+            run_composed(4, 8, trace=trace,
+                         trace_path=_trace_path("smoke_composed") if trace else None,
+                         **smoke_composed),
         )
         _emit(
             # The superspan-MACHINERY line: same composed shape, scanned
@@ -339,6 +393,8 @@ def main(argv=None) -> None:
             "pod-scheduling decisions/sec (SMOKE, composed flagship + "
             "superspan executor)",
             run_composed(4, 8, superspan=True, fast_forward=False,
+                         trace=trace,
+                         trace_path=_trace_path("smoke_superspan") if trace else None,
                          **smoke_composed),
         )
         _emit(
@@ -371,7 +427,10 @@ def main(argv=None) -> None:
     _emit(
         "pod-scheduling decisions/sec (single chip, composed flagship: "
         "256 clusters x HPA+CA+sliding window+Pallas)",
-        run_composed(),
+        run_composed(
+            trace=trace,
+            trace_path=_trace_path("composed") if trace else None,
+        ),
     )
     _emit(
         "pod-scheduling decisions/sec (single chip, 1250x1000-node clusters "

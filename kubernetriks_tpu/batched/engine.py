@@ -68,6 +68,28 @@ from kubernetriks_tpu.config import (
 )
 from kubernetriks_tpu import sanitize
 from kubernetriks_tpu.flags import flag_bool, flag_tristate
+from kubernetriks_tpu.telemetry import (
+    GaugeSeries,
+    NULL_TRACER,
+    SpanTracer,
+    log_chunk_throughput,
+)
+from kubernetriks_tpu.telemetry.tracer import (
+    PH_CKPT_RESTORE,
+    PH_CKPT_SAVE,
+    PH_FUSED_CHUNK_SLIDE,
+    PH_PRECOMPILE,
+    PH_PROGRESS_WAIT,
+    PH_REFILL_PREFETCH,
+    PH_SHIFT_WAIT,
+    PH_SLIDE,
+    PH_STAGE_ASSEMBLE,
+    PH_STAGE_PREFETCH,
+    PH_STAGE_PUT,
+    PH_SUPERSPAN,
+    PH_WINDOW_CHUNK,
+    PH_WINDOW_GROW,
+)
 
 
 # Device-resident slide payload budget: req/ram + duration pair +
@@ -513,8 +535,29 @@ class BatchedSimulation:
         superspan_chunk: int = 8,
         superspan_stage_cols: Optional[int] = None,
         sanitize_mode: Optional[bool] = None,
+        telemetry: Optional[bool] = None,
+        telemetry_ring: int = 1024,
     ) -> None:
         self.config = config
+        # Flight recorder (KTPU_TRACE / telemetry arg): host-side span
+        # tracer over every dispatch phase + the device-side per-window
+        # metrics ring carried in ClusterBatchState (attached below, once
+        # C is known). Off: NULL_TRACER no-ops and the state carries
+        # telemetry=None, compiling programs identical to the
+        # pre-telemetry build. telemetry_ring: ring capacity in windows
+        # (the engine drains before wrap at existing sync boundaries).
+        if telemetry is not None:
+            self._telemetry = bool(telemetry)
+        else:
+            self._telemetry = flag_bool("KTPU_TRACE")
+        self.tracer = SpanTracer() if self._telemetry else NULL_TRACER
+        self._telemetry_ring_size = max(8, int(telemetry_ring))
+        # window-index -> (C, K) drained ring rows; bounded by distinct
+        # windows, deduped across overlapping drains (telemetry/ring.py).
+        self._ring_seen: dict = {}
+        self._ring_windows_recorded = 0  # device cursor high-water mark
+        self._ring_drained_at = 0  # window cursor of the last ring drain
+        self._pending_flow = 0  # tracer flow id of an in-flight readback
         # Runtime sanitizer (KTPU_SANITIZE / sanitize_mode arg): the
         # steady-state dispatch region runs under a device-to-host
         # transfer guard (waived syncs carry explicit allow scopes that
@@ -575,7 +618,7 @@ class BatchedSimulation:
             self._superspan = bool(superspan)
         else:
             env = flag_tristate("KTPU_SUPERSPAN")
-            self._superspan = (
+            self._superspan = bool(
                 env if env is not None else jax.default_backend() != "cpu"
             )
         self._superspan_k = max(1, int(superspan_k))
@@ -607,6 +650,11 @@ class BatchedSimulation:
         # slide-spans those dispatches completed on device; stage_refills
         # counts staging-buffer installs (whole-trace-payload engines never
         # restage).
+        # ladder_fallbacks counts step_until_time calls where a
+        # superspan-selected engine dispatched the ladder instead
+        # (instrumented modes, gauge collection, fast-forward) — the
+        # silent-fallback observable bench.py --smoke asserts on, now
+        # visible in every telemetry_report.
         self.dispatch_stats = {
             "window_chunks": 0,
             "fused_slides": 0,
@@ -616,6 +664,7 @@ class BatchedSimulation:
             "superspans": 0,
             "superspan_spans": 0,
             "stage_refills": 0,
+            "ladder_fallbacks": 0,
         }
         self._use_pallas_requested = use_pallas
         self.pallas_interpret = bool(pallas_interpret)
@@ -975,6 +1024,16 @@ class BatchedSimulation:
                         hpa_idx=jnp.asarray(hpa_idx0)
                     )
                 )
+        if self._telemetry:
+            # Attach the device metrics ring BEFORE mesh placement below,
+            # so its leaves pick up the state sharding like every other
+            # (C, ...) array. Presence is a structural static (like
+            # `auto`): telemetry-off engines compile identical programs.
+            from kubernetriks_tpu.telemetry.ring import init_ring
+
+            self.state = self.state._replace(
+                telemetry=init_ring(C, self._telemetry_ring_size)
+            )
         ev_win, ev_off = from_f64_np(ev_time, config.scheduling_cycle_interval)
         self.slab = TraceSlab.build(ev_win, ev_off, ev_kind, ev_slot)
         self._ev_time_np = ev_time  # host copy (f64) for completion checks
@@ -992,10 +1051,11 @@ class BatchedSimulation:
         self.next_window_idx = 0
         # Per-window gauge collection (batched analog of the scalar 5 s gauge
         # cycle): enable with collect_gauges, read via gauge_series() or
-        # write_gauge_csv().
+        # write_gauge_csv(). The series buffer lives in the telemetry
+        # package (telemetry/gauges.py owns concat/CSV/sidecar); the
+        # engine only performs the (waived) device fetches.
         self.collect_gauges = False
-        self._gauge_windows: list = []
-        self._gauge_samples: list = []
+        self._gauges = GaugeSeries()
         # Profiling hooks: set profile_dir to capture a jax.profiler trace of
         # every step_until_time dispatch; set log_throughput for a per-chunk
         # decisions/s + cluster-windows/s log line (TPU analog of the scalar
@@ -1251,10 +1311,13 @@ class BatchedSimulation:
         readback starts immediately but is only consumed at the span
         boundary (_resolve_pending_slide), so no sync lands here."""
         self.dispatch_stats["window_chunks"] += 1
+        tr = self.tracer
+        tr.count(f"dispatch_chunk_{len(idxs)}")
         donated_in = self.state if (self.donate and self._sanitize) else None
         if fuse_slide:
             self.dispatch_stats["fused_slides"] += 1
             fn = _fused_chunk_slide_donated if self.donate else _fused_chunk_slide
+            t0 = tr.begin()
             state, new_rank, s = fn(
                 self.state,
                 self.slab,
@@ -1265,6 +1328,7 @@ class BatchedSimulation:
                 W=self.pod_window,
                 **self._window_call_kwargs(),
             )
+            tr.end(PH_FUSED_CHUNK_SLIDE, t0)
             self.state = state
             if donated_in is not None:
                 sanitize.consume_donated(donated_in)
@@ -1279,6 +1343,7 @@ class BatchedSimulation:
                     self._sanitize, "async shift prefetch"
                 ):
                     s.copy_to_host_async()  # ktpu: sync-ok(async initiation of the waived 4-byte shift readback — does not block)
+            self._pending_flow = tr.flow_start(PH_SHIFT_WAIT)
             self._pending_shift = s
             self.next_window_idx = int(idxs[-1]) + 1
             return
@@ -1292,6 +1357,7 @@ class BatchedSimulation:
             )
 
             skip_fn = run_windows_skip_donated if self.donate else run_windows_skip
+            t0 = tr.begin()
             self.state = skip_fn(
                 self.state,
                 self.slab,
@@ -1301,6 +1367,7 @@ class BatchedSimulation:
                 flush_windows=self._flush_windows,
                 **self._window_call_kwargs(),
             )
+            tr.end(PH_WINDOW_CHUNK, t0)
             if donated_in is not None:
                 sanitize.consume_donated(donated_in)
             self.next_window_idx = int(idxs[-1]) + 1
@@ -1308,6 +1375,7 @@ class BatchedSimulation:
         from kubernetriks_tpu.batched.step import run_windows_donated
 
         win_fn = run_windows_donated if self.donate else run_windows
+        t0 = tr.begin()
         out = win_fn(
             self.state,
             self.slab,
@@ -1316,13 +1384,13 @@ class BatchedSimulation:
             collect_gauges=self.collect_gauges,
             **self._window_call_kwargs(),
         )
+        tr.end(PH_WINDOW_CHUNK, t0)
         if self.collect_gauges:
             self.state, gauges = out
             with sanitize.allow_transfer(
                 self._sanitize, "gauge time-series readback"
             ):
-                self._gauge_windows.append(np.asarray(idxs))  # ktpu: sync-ok(gauge instrumentation: per-chunk time-series readback, gauge runs are not the steady-state path)
-                self._gauge_samples.append(to_host(gauges))  # ktpu: sync-ok(gauge instrumentation: per-chunk time-series readback)
+                self._gauges.append(np.asarray(idxs), to_host(gauges))  # ktpu: sync-ok(gauge instrumentation: per-chunk time-series readback, gauge runs are not the steady-state path)
         else:
             self.state = out
         if donated_in is not None:
@@ -1363,6 +1431,7 @@ class BatchedSimulation:
             # warm it instead of the ladder; a no-op progress code compiles
             # the whole while_loop without executing a window. Dispatched
             # against a scratch copy like the ladder shapes (donation).
+            t_warm = self.tracer.begin()
             stage, lo = self._current_stage()
             rank = (
                 self.autoscale_statics.pod_name_rank
@@ -1388,11 +1457,13 @@ class BatchedSimulation:
                 **self._window_call_kwargs(),
             )
             jax.block_until_ready(out)  # ktpu: sync-ok(warm-up: AOT compile of the superspan program, outside every timed region)
+            self.tracer.end(PH_PRECOMPILE, t_warm)
             return 1
         from kubernetriks_tpu.batched.step import run_windows_donated
 
         win_fn = run_windows_donated if self.donate else run_windows
         n = 0
+        t_warm = self.tracer.begin()
         warm_fused = self._fused_slide_ok()
         for chunk in _CHUNK_LADDER:
             if chunk > max_chunk:
@@ -1426,6 +1497,7 @@ class BatchedSimulation:
                 )
                 jax.block_until_ready(out)  # ktpu: sync-ok(warm-up: AOT compile of the fused chunk+slide shapes, outside every timed region)
                 n += 1
+        self.tracer.end(PH_PRECOMPILE, t_warm)
         return n
 
     def step_until_time(self, until_time: float) -> None:
@@ -1433,8 +1505,29 @@ class BatchedSimulation:
         KTPU_SANITIZE it runs inside a device-to-host transfer guard — any
         sync not inside an explicit sanitize.allow_transfer scope (the
         runtime mirror of the lint pass's sync-ok waivers) raises."""
+        if self.state.telemetry is not None:
+            # Entry-side wrap guard (host arithmetic only): the incoming
+            # span's window count is known here, so drain the undrained
+            # rows NOW if this call would wrap past them — loss can then
+            # only happen when ONE call spans more than the ring itself
+            # (disclosed via windows_recorded > windows_kept).
+            pending = self.next_window_idx - self._ring_drained_at
+            interval = self.config.scheduling_cycle_interval
+            n_new = max(
+                0,
+                int(math.floor(until_time / interval))
+                - self.next_window_idx
+                + 1,
+            )
+            if pending > 0 and pending + n_new > self._telemetry_ring_size:
+                self._maybe_drain_ring(force=True)
         with sanitize.guard(self._sanitize):
             self._step_until_time(until_time)
+        # Telemetry ring pressure check (host-side arithmetic only): drain
+        # before records wrap out. Lands OUTSIDE the transfer-guard region
+        # at a boundary where callers already block (bench span fetches),
+        # so telemetry-on adds no sync inside the steady-state loop.
+        self._maybe_drain_ring()
 
     def _step_until_time(self, until_time: float) -> None:
         idxs = self.window_idxs(until_time)
@@ -1464,6 +1557,11 @@ class BatchedSimulation:
         if self._superspan_ok():
             self._run_superspans(target)
             return
+        if self._superspan:
+            # Superspan selected but not dispatchable (instrumented mode,
+            # gauges, fast-forward, debug-finite): count the silent ladder
+            # fallback so it is observable outside bench.py --smoke.
+            self.dispatch_stats["ladder_fallbacks"] += 1
         while self.next_window_idx <= target:
             sub = min(target, self._pod_capacity_window())
             will_slide = sub < target
@@ -1560,6 +1658,7 @@ class BatchedSimulation:
         from kubernetriks_tpu.batched.state import duration_pair_np
         from kubernetriks_tpu.batched.trace_compile import stage_segment
 
+        t0 = self.tracer.begin()
         seg = stage_segment(
             self._full_pods,
             self._pod_create_win,
@@ -1574,6 +1673,8 @@ class BatchedSimulation:
         dur = duration_pair_np(
             seg.pop("duration"), self.config.scheduling_cycle_interval
         )
+        self.tracer.end(PH_STAGE_ASSEMBLE, t0)
+        t0 = self.tracer.begin()
         stage = RefillStage(
             req_cpu=jnp.asarray(seg["req_cpu"]),
             req_ram=jnp.asarray(seg["req_ram"]),
@@ -1597,6 +1698,7 @@ class BatchedSimulation:
                 stage,
                 jax.tree.map(lambda _: row, stage),
             )
+        self.tracer.end(PH_STAGE_PUT, t0)
         return stage
 
     def _stage_covers(self, lo: int, stage: RefillStage) -> bool:
@@ -1633,8 +1735,14 @@ class BatchedSimulation:
             return stage, lo
         nxt, self._stage_next = self._stage_next, None
         if nxt is not None and self._stage_covers(*nxt):
+            # Prefetch HIT: the double-buffered successor assembled while
+            # the previous superspan ran covers the restage point.
+            self.tracer.count("stage_prefetch_hit")
             self._stage_cur = nxt
         else:
+            # Prefetch MISS: rebuild at the base on the span boundary's
+            # critical path (the stall the tracer makes visible).
+            self.tracer.count("stage_prefetch_miss")
             lo = self._pod_base
             self._stage_cur = (lo, self._make_stage(lo, self._stage_width()))
         self.dispatch_stats["stage_refills"] += 1
@@ -1660,7 +1768,9 @@ class BatchedSimulation:
             return
         if self._stage_next is not None and self._stage_next[0] == lo_pred:
             return
+        t0 = self.tracer.begin()
         self._stage_next = (lo_pred, self._make_stage(lo_pred, Lw))
+        self.tracer.end(PH_STAGE_PREFETCH, t0)
 
     def _run_superspans(self, target: int) -> None:
         """The superspan dispatch loop: one device program per up-to-K
@@ -1670,6 +1780,7 @@ class BatchedSimulation:
         cursor, carried name ranks), and — over-budget engines only — the
         overlapped staging assembly."""
         fn = run_superspan_donated if self.donate else run_superspan
+        tr = self.tracer
         while self.next_window_idx <= target:
             W = self.pod_window
             stage, lo = self._current_stage()
@@ -1686,6 +1797,7 @@ class BatchedSimulation:
             donated_in = (
                 self.state if (self.donate and self._sanitize) else None
             )
+            t0 = tr.begin()
             state, rank, progress = fn(
                 self.state,
                 rank,
@@ -1700,6 +1812,7 @@ class BatchedSimulation:
                 chunk=self._superspan_chunk,
                 **self._window_call_kwargs(),
             )
+            tr.end(PH_SUPERSPAN, t0)
             self.state = state
             if donated_in is not None:
                 sanitize.consume_donated(donated_in)
@@ -1712,13 +1825,17 @@ class BatchedSimulation:
                     self._sanitize, "async progress prefetch"
                 ):
                     progress.copy_to_host_async()  # ktpu: sync-ok(async initiation of the waived progress readback — does not block)
+            fid = tr.flow_start(PH_PROGRESS_WAIT)
             # Overlap the next stage's host assembly + H2D with the device
             # program still running, BEFORE the blocking readback.
             self._prefetch_stage(lo)
+            t0 = tr.begin()
             with sanitize.allow_transfer(
                 self._sanitize, "superspan progress readback"
             ):
                 w, base, spans, code = (int(v) for v in to_host(progress))  # ktpu: sync-ok(THE steady-state sync: one async-prefetched (4,)-i32 progress readback per superspan dispatch)
+            tr.end(PH_PROGRESS_WAIT, t0)
+            tr.flow_end(PH_PROGRESS_WAIT, fid)
             self._check_finite()
             self.dispatch_stats["slide_syncs"] += 1
             self.dispatch_stats["superspan_spans"] += spans
@@ -1760,10 +1877,13 @@ class BatchedSimulation:
         s_arr = self._pending_shift
         self._pending_shift = None
         self.dispatch_stats["slide_syncs"] += 1
+        t0 = self.tracer.begin()
         with sanitize.allow_transfer(
             self._sanitize, "fused-slide shift readback"
         ):
             s = int(s_arr)  # ktpu: sync-ok(the fused span's only host sync: async-prefetched 4-byte shift readback, consumed at the span boundary)
+        self.tracer.end(PH_SHIFT_WAIT, t0)
+        self.tracer.flow_end(PH_SHIFT_WAIT, self._pending_flow)
         if s <= 0:
             # The fused slide was the identity (statics rank swap included);
             # nothing moved on device or host.
@@ -1787,7 +1907,9 @@ class BatchedSimulation:
         ):
             return
         self.dispatch_stats["refill_prefetches"] += 1
+        t0 = self.tracer.begin()
         self._refill_prefetch = (start, width, self._make_refill(start, width))
+        self.tracer.end(PH_REFILL_PREFETCH, t0)
 
     def _pod_capacity_window(self) -> int:
         """Largest window index dispatchable before a pod creation would land
@@ -1828,6 +1950,13 @@ class BatchedSimulation:
         )
 
     def _advance_pod_window(self) -> bool:
+        t0 = self.tracer.begin()
+        try:
+            return self._advance_pod_window_impl()
+        finally:
+            self.tracer.end(PH_SLIDE, t0)
+
+    def _advance_pod_window_impl(self) -> bool:
         """Shift the device pod window past the leading run of terminal pods
         (uniform shift across clusters), refilling the tail from the host
         payload. Only the window segment [0, pod_window) moves; the resident
@@ -2001,6 +2130,13 @@ class BatchedSimulation:
         return refill
 
     def _grow_pod_window(self) -> bool:
+        t0 = self.tracer.begin()
+        try:
+            return self._grow_pod_window_impl()
+        finally:
+            self.tracer.end(PH_WINDOW_GROW, t0)
+
+    def _grow_pod_window_impl(self) -> bool:
         """Double the sliding window IN PLACE when a dense stretch of the
         trace outgrows it (peak live-pod span > pod_window, so no slide is
         possible): insert fresh plain-pod slots between the window segment
@@ -2172,6 +2308,15 @@ class BatchedSimulation:
                     f"{key} after window {self.next_window_idx - 1}"
                 )
 
+    def _decisions_total(self) -> int:  # ktpu: sync-ok(log_throughput instrumentation: per-chunk decisions counter fetch, instrumented runs only)
+        """Blocking fetch of the summed decisions counter — the ONE owner
+        of the instrumented path's throughput probe (PR 8 deduped the
+        before/after fetch sites onto it)."""
+        with sanitize.allow_transfer(
+            self._sanitize, "log_throughput decisions fetch"
+        ):
+            return int(to_host(self.state.metrics.scheduling_decisions).sum())
+
     def _step_idxs(self, idxs: np.ndarray, fuse_slide: bool = False) -> None:
         if not (self.profile_dir or self.log_throughput):
             self._dispatch_windows(idxs, fuse_slide=fuse_slide)
@@ -2180,7 +2325,12 @@ class BatchedSimulation:
 
         # Instrumented path: optional jax.profiler capture + a per-chunk
         # decisions/s log line (TPU analog of the scalar events/s log,
-        # reference: src/simulator.rs:363-368).
+        # reference: src/simulator.rs:363-368). The per-chunk timing and
+        # log formatting live on the tracer (telemetry/tracer.py); while a
+        # profiler capture is active, tracer spans also enter
+        # jax.profiler.TraceAnnotations so host phases land in the xplane
+        # next to the device ops they dispatched
+        # (scripts/profile_composed_xplane.py correlates them).
         import contextlib
         import logging
         import time
@@ -2190,32 +2340,24 @@ class BatchedSimulation:
             if self.profile_dir
             else contextlib.nullcontext()
         )
-        before = 0
-        if self.log_throughput:
-            with sanitize.allow_transfer(
-                self._sanitize, "log_throughput decisions fetch"
-            ):
-                before = int(to_host(self.state.metrics.scheduling_decisions).sum())  # ktpu: sync-ok(log_throughput instrumentation: per-chunk decisions counter fetch, instrumented runs only)
+        from kubernetriks_tpu.telemetry.tracer import PH_CHUNK_FENCED
+
+        self.tracer.annotate = bool(self.profile_dir)
+        before = self._decisions_total() if self.log_throughput else 0
         t0 = time.perf_counter()
-        with ctx:
+        with ctx, self.tracer.span(PH_CHUNK_FENCED):
             self._dispatch_windows(idxs, fuse_slide=fuse_slide)
             jax.block_until_ready(self.state.time)  # ktpu: sync-ok(instrumented path: fence so the per-chunk clock measures device work, not dispatch)
         elapsed = time.perf_counter() - t0
+        self.tracer.annotate = False
         self._check_finite()
         if self.log_throughput:
-            with sanitize.allow_transfer(
-                self._sanitize, "log_throughput decisions fetch"
-            ):
-                decisions = (
-                    int(to_host(self.state.metrics.scheduling_decisions).sum()) - before  # ktpu: sync-ok(log_throughput instrumentation: per-chunk decisions counter fetch, instrumented runs only)
-                )
-            cluster_windows = len(idxs) * self.n_clusters
-            logging.getLogger(__name__).info(
-                "chunk of %d windows in %.3fs: %.0f decisions/s, "
-                "%.0f cluster-windows/s",
-                len(idxs), elapsed,
-                decisions / max(elapsed, 1e-9),
-                cluster_windows / max(elapsed, 1e-9),
+            log_chunk_throughput(
+                logging.getLogger(__name__),
+                len(idxs),
+                self.n_clusters,
+                self._decisions_total() - before,
+                elapsed,
             )
 
     def step_window(self) -> None:
@@ -2250,10 +2392,10 @@ class BatchedSimulation:
         if self.collect_gauges:
             from kubernetriks_tpu.batched.step import gauge_snapshot
 
-            self._gauge_windows.append(
-                np.asarray([self.next_window_idx], np.int32)  # ktpu: sync-ok(single-window test helper: host-side window index, no device value)
+            self._gauges.append(
+                np.asarray([self.next_window_idx], np.int32),  # ktpu: sync-ok(single-window test helper: host-side window index, no device value)
+                to_host(gauge_snapshot(self.state))[None],  # ktpu: sync-ok(gauge instrumentation in the single-window test helper)
             )
-            self._gauge_samples.append(to_host(gauge_snapshot(self.state))[None])  # ktpu: sync-ok(gauge instrumentation in the single-window test helper)
         self.next_window_idx += 1
 
     def run_to_completion(self, max_time: float = 1e7) -> None:
@@ -2445,6 +2587,93 @@ class BatchedSimulation:
         due_remove = (rw < win) | ((rw == win) & (ro <= off))
         return int(((alive | due_create) & ~due_remove).sum())
 
+    # --- telemetry readout --------------------------------------------------
+
+    def _maybe_drain_ring(self, force: bool = False) -> None:
+        """Drain the device telemetry ring before records wrap out. The
+        pressure check is pure host arithmetic (window cursor vs ring
+        capacity); the blocking fetch itself lives in telemetry/ring.py
+        and only ever runs at boundaries where the host already blocks —
+        step_until_time exit and readout — never inside the dispatch loop
+        (the no-new-syncs half of the telemetry contract)."""
+        if self.state.telemetry is None:
+            return
+        pending = self.next_window_idx - self._ring_drained_at
+        if not force and pending * 2 < self._telemetry_ring_size:
+            return
+        from kubernetriks_tpu.telemetry import ring as dring
+
+        buf, cursor = dring.snapshot(self.state.telemetry)
+        dring.merge_snapshot(self._ring_seen, buf)
+        self._ring_windows_recorded = max(
+            self._ring_windows_recorded, cursor
+        )
+        self._ring_drained_at = self.next_window_idx
+
+    def telemetry_window_series(self):
+        """(windows (Wn,), records (Wn, C, K)) device-ring per-window
+        series; columns follow telemetry.ring.RING_COLUMNS. Empty arrays
+        when telemetry is off."""
+        from kubernetriks_tpu.telemetry import ring as dring
+
+        self._maybe_drain_ring(force=True)
+        return dring.series(self._ring_seen, self.n_clusters)
+
+    def telemetry_report(self) -> Dict:
+        """Aggregated flight-recorder readout: per-phase host wall time
+        (exact even when the span ring wrapped), dispatch stats incl.
+        ladder_fallbacks, the observed sync count vs the documented
+        steady-state budget (1 progress readback per superspan + 1 shift
+        readback per fused slide — the lint pass's sync-ok waiver set),
+        stage-prefetch hit/miss counts, the dispatch-chunk histogram, and
+        the device ring's totals. Callable with telemetry off (dispatch
+        stats only, enabled: False)."""
+        stats = dict(self.dispatch_stats)
+        rep = {"enabled": self._telemetry, "dispatch_stats": stats}
+        rep.update(self.tracer.report())
+        rep["sync_budget"] = {
+            "steady_state_expected": stats["superspans"]
+            + stats["fused_slides"],
+            "observed_slide_syncs": stats["slide_syncs"],
+        }
+        hits = rep["counters"].get("stage_prefetch_hit", 0)
+        misses = rep["counters"].get("stage_prefetch_miss", 0)
+        if hits + misses:
+            rep["stage_prefetch_hit_rate"] = hits / (hits + misses)
+        if self.state.telemetry is not None:
+            from kubernetriks_tpu.telemetry import ring as dring
+
+            wins, data = self.telemetry_window_series()
+            rep["ring"] = {
+                "columns": list(dring.RING_COLUMNS),
+                "windows_recorded": self._ring_windows_recorded,
+                "windows_kept": int(len(wins)),
+                "totals": {
+                    name: int(data[:, :, col].sum()) if len(wins) else 0
+                    for col, name in enumerate(dring.RING_COLUMNS)
+                    if col > 0
+                },
+            }
+        return rep
+
+    def write_chrome_trace(self, path: str) -> str:
+        """Write the Chrome trace-event JSON (Perfetto-loadable): host
+        spans, async-readback flow arrows, and the device ring as
+        sim-time counter tracks. Requires telemetry on."""
+        if not self._telemetry:
+            raise ValueError(
+                "telemetry is off — build with telemetry=True or KTPU_TRACE=1"
+            )
+        extra = None
+        if self.state.telemetry is not None:
+            from kubernetriks_tpu.telemetry import ring as dring
+
+            wins, data = self.telemetry_window_series()
+            extra = dring.counter_events(
+                wins, data, self.config.scheduling_cycle_interval
+            )
+        return self.tracer.write_chrome_trace(path, extra)
+
     # --- checkpoint / resume ------------------------------------------------
     # The whole simulation state is one pytree of arrays, so checkpointing is
     # a direct orbax save (SURVEY §5.4: absent in the reference — runs are
@@ -2463,34 +2692,35 @@ class BatchedSimulation:
         numpy sidecar next to it."""
         from kubernetriks_tpu.checkpoint import ckpt_save
 
-        ckpt_save(path, self._ckpt_payload())
-        # The window can GROW mid-run (_grow_pod_window), changing the pod
-        # arrays' shapes — record it so load_checkpoint can grow a freshly
-        # built engine to match before restoring.
-        meta_path = os.path.abspath(path) + ".meta.json"
-        if self.pod_window is not None:
-            import json
+        with self.tracer.span(PH_CKPT_SAVE):
+            ckpt_save(path, self._ckpt_payload())
+            # The window can GROW mid-run (_grow_pod_window), changing the
+            # pod arrays' shapes — record it so load_checkpoint can grow a
+            # freshly built engine to match before restoring.
+            meta_path = os.path.abspath(path) + ".meta.json"
+            meta = {}
+            if self.pod_window is not None:
+                meta["pod_window"] = int(self.pod_window)
+            if self.state.telemetry is not None:
+                # The telemetry ring is part of the state pytree; a
+                # restore template must carry a matching ring, so record
+                # its capacity for load_checkpoint's loud guard.
+                meta["telemetry_ring"] = int(self._telemetry_ring_size)
+            if meta:
+                import json
 
-            with open(meta_path, "w") as fh:
-                json.dump({"pod_window": int(self.pod_window)}, fh)
-        elif os.path.exists(meta_path):
-            # A full-resident save over a previously windowed checkpoint
-            # must not leave the stale meta to mislead a later windowed
-            # load (same shadowing rule as the gauges sidecar below).
-            os.remove(meta_path)
-        sidecar = os.path.abspath(path) + ".gauges.npz"
-        if self._gauge_windows:
-            np.savez(
-                sidecar,
-                windows=np.concatenate(self._gauge_windows).astype(np.int32),
-                samples=np.concatenate(self._gauge_samples, axis=0).astype(
-                    np.float32
-                ),
-            )
-        elif os.path.exists(sidecar):
-            # Never let a previous save's gauge series shadow this run's
-            # (gauge-less) state on restore.
-            os.remove(sidecar)
+                with open(meta_path, "w") as fh:
+                    json.dump(meta, fh)
+            elif os.path.exists(meta_path):
+                # A plain save over a previously windowed/telemetry
+                # checkpoint must not leave the stale meta to mislead a
+                # later load (same shadowing rule as the gauges sidecar
+                # below).
+                os.remove(meta_path)
+            # Gauge series sidecar (run-length-dependent shape, unlike the
+            # state pytree); an empty series removes a stale file so a
+            # previous save's gauges never shadow this run's on restore.
+            self._gauges.save_sidecar(os.path.abspath(path) + ".gauges.npz")
 
     def load_checkpoint(self, path: str) -> None:  # ktpu: sync-ok(checkpoint restore: cold path)
         """Restore state saved by save_checkpoint into this simulation (which
@@ -2500,65 +2730,78 @@ class BatchedSimulation:
         from kubernetriks_tpu.checkpoint import ckpt_restore
 
         meta_path = os.path.abspath(path) + ".meta.json"
+        meta = {}
         if os.path.exists(meta_path):
             import json
 
             with open(meta_path) as fh:
-                saved_window = json.load(fh).get("pod_window")
-            if saved_window is not None and self.pod_window is not None:
-                while self.pod_window < saved_window:
-                    if not self._grow_pod_window():
-                        break
-                if self.pod_window != saved_window:
-                    # Not an assert: under python -O the mismatch would
-                    # surface later as an opaque ckpt_restore shape error.
-                    raise ValueError(
-                        f"checkpoint was saved at pod_window={saved_window}; "
-                        f"this engine is at {self.pod_window} and cannot match"
-                    )
-        restored = ckpt_restore(path, self._ckpt_payload())
-        self.state = restored["state"]
-        self.next_window_idx = int(restored["next_window_idx"])
-        self._pod_base = int(np.asarray(self.state.pod_base)[0])
-        self._refresh_name_ranks()
-        sidecar = os.path.abspath(path) + ".gauges.npz"
-        if os.path.exists(sidecar):
-            data = np.load(sidecar)
-            self._gauge_windows = [data["windows"]]
-            self._gauge_samples = [data["samples"]]
-        else:
-            self._gauge_windows = []
-            self._gauge_samples = []
+                meta = json.load(fh)
+        # Telemetry mismatch guard: the ring is part of the state pytree,
+        # so a template without a matching ring would fail deep inside
+        # ckpt_restore as an opaque structure error — raise the
+        # actionable message here instead (the same treatment pod_window
+        # gets below). Runs with meta absent too: a plain save writes no
+        # meta at all, and restoring it into a telemetry-armed engine is
+        # exactly the mismatch.
+        saved_ring = meta.get("telemetry_ring")
+        have_ring = (
+            self._telemetry_ring_size
+            if self.state.telemetry is not None
+            else None
+        )
+        if saved_ring != have_ring:
+            raise ValueError(
+                f"checkpoint telemetry ring mismatch: saved "
+                f"telemetry_ring={saved_ring}, this engine has "
+                f"{have_ring} — build with telemetry="
+                f"{saved_ring is not None} and telemetry_ring="
+                f"{saved_ring} (or KTPU_TRACE) to restore it"
+            )
+        saved_window = meta.get("pod_window")
+        if saved_window is not None and self.pod_window is not None:
+            while self.pod_window < saved_window:
+                if not self._grow_pod_window():
+                    break
+            if self.pod_window != saved_window:
+                # Not an assert: under python -O the mismatch would
+                # surface later as an opaque ckpt_restore shape error.
+                raise ValueError(
+                    f"checkpoint was saved at pod_window={saved_window}; "
+                    f"this engine is at {self.pod_window} and cannot match"
+                )
+        with self.tracer.span(PH_CKPT_RESTORE):
+            restored = ckpt_restore(path, self._ckpt_payload())
+            self.state = restored["state"]
+            self.next_window_idx = int(restored["next_window_idx"])
+            self._pod_base = int(np.asarray(self.state.pod_base)[0])
+            self._refresh_name_ranks()
+            self._gauges = GaugeSeries.load_sidecar(
+                os.path.abspath(path) + ".gauges.npz"
+            )
+            # Ring rows drained before the restore described the
+            # pre-restore trajectory; the restored ring carries its own.
+            self._ring_seen = {}
+            self._ring_windows_recorded = 0
+            self._ring_drained_at = 0
 
     def gauge_series(self):
         """(times (W,), samples (W, C, 7)) accumulated gauge time-series;
-        columns follow the scalar GAUGE_CSV_COLUMNS after the timestamp."""
-        if not self._gauge_samples:
-            return np.zeros((0,)), np.zeros((0, self.n_clusters, 7))
-        times = (
-            np.concatenate(self._gauge_windows).astype(np.float64)
-            * self.config.scheduling_cycle_interval
+        columns follow the scalar GAUGE_CSV_COLUMNS after the timestamp
+        (series buffer: telemetry/gauges.py)."""
+        return self._gauges.series(
+            self.n_clusters, self.config.scheduling_cycle_interval
         )
-        return times, np.concatenate(self._gauge_samples, axis=0)
 
     def write_gauge_csv(self, path: str, cluster: int = 0) -> None:
         """Dump one cluster's gauge series in the scalar collector's 8-column
         schema (reference: src/metrics/collector.rs:216-228), so the offline
         plotting tooling consumes either backend's output unchanged."""
-        import csv
-
-        from kubernetriks_tpu.metrics.collector import GAUGE_CSV_COLUMNS
-
-        times, samples = self.gauge_series()
-        with open(path, "w", newline="") as f:
-            writer = csv.writer(f)
-            writer.writerow(GAUGE_CSV_COLUMNS)
-            for i, t in enumerate(times):
-                row = samples[i, cluster]
-                writer.writerow(
-                    [t, int(row[0]), int(row[1]), int(row[2]),
-                     float(row[3]), float(row[4]), float(row[5]), float(row[6])]
-                )
+        self._gauges.write_csv(
+            path,
+            cluster,
+            self.n_clusters,
+            self.config.scheduling_cycle_interval,
+        )
 
     def pod_view(self, cluster: int) -> Dict[str, Dict]:  # ktpu: sync-ok(readout: name-keyed pod states for equivalence tests)
         """Name-keyed pod states for equivalence tests against the scalar

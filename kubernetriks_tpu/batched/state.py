@@ -210,6 +210,52 @@ class ClusterBatchState(NamedTuple):
     metrics: MetricArrays
     # Dynamic autoscaler state (AutoscaleState) or None when autoscaling is off.
     auto: Optional[NamedTuple] = None
+    # Device-side per-window telemetry ring (TelemetryRing) or None when
+    # telemetry is off — None compiles programs identical to the
+    # pre-telemetry build, the same structural-static trick `auto` and
+    # `fault_params` use.
+    telemetry: Optional[TelemetryRing] = None
+
+
+# Column layout of the device-side telemetry ring (TelemetryRing.buf).
+# All int32: per-window aggregates cheap to fold from state the window body
+# already holds — no new reductions over the trace slab, no float state.
+TELEM_WINDOW = 0  # window index this record describes
+TELEM_DECISIONS = 1  # scheduling decisions committed this window
+TELEM_QUEUED = 2  # active-queue depth after the cycle
+TELEM_UNSCHED = 3  # unschedulable-queue depth (failed fits parked)
+TELEM_HPA_PODS = 4  # HPA pod actions this window (scale-ups + scale-downs)
+TELEM_CA_NODES = 5  # CA node actions this window (scale-ups + scale-downs)
+TELEM_FAULTS = 6  # chaos events this window (crashes/recoveries/retries/fails)
+TELEM_ALIVE_NODES = 7  # alive node count after the window
+TELEMETRY_COLS = 8
+
+
+class TelemetryRing(NamedTuple):
+    """(C, R, TELEMETRY_COLS) device-side per-window metrics ring.
+
+    Carried inside ClusterBatchState like `auto`: None (telemetry off)
+    compiles programs identical to the pre-telemetry build; when present,
+    every executed window scatters ONE record row per cluster at
+    `cursor % R` and bumps the cursor — the ring accumulates on device and
+    is drained host-side only at boundaries where the host already blocks
+    (engine step_until_time exit / readout), never inside the dispatch
+    loop, so telemetry-on adds zero new host syncs (the dispatch-count
+    regression gate in tests/test_telemetry.py pins this).
+
+    Unwritten rows carry window = -1 (the drain filters on it); a cursor
+    past R means early windows wrapped out — the engine's pressure-based
+    drain keeps long runs lossless by snapshotting before the wrap."""
+
+    buf: jnp.ndarray  # (C, R, TELEMETRY_COLS) int32
+    cursor: jnp.ndarray  # (C,) int32 total windows recorded (slot = cursor % R)
+
+
+def strip_telemetry(state: "ClusterBatchState") -> "ClusterBatchState":
+    """The state minus its telemetry ring — the comparison view for the
+    telemetry-on vs telemetry-off bit-identity gate (the ring is the ONE
+    leaf allowed to differ: it only exists on one side)."""
+    return state._replace(telemetry=None)
 
 
 class RefillStage(NamedTuple):

@@ -1484,6 +1484,56 @@ def _run_scheduling_cycle(
     )
 
 
+def _telemetry_record(state: ClusterBatchState, m0, W: jnp.ndarray):
+    """Fold one per-window record row into the device telemetry ring:
+    metric-counter deltas vs the window's incoming metrics `m0` plus queue
+    depths / alive-node counts read straight off the post-window state.
+    Pure bookkeeping — reads simulation state, writes only the ring — so
+    telemetry-on runs are bit-identical to telemetry-off on every other
+    leaf (tests/test_telemetry.py pins this). Cost: two (C, P) phase
+    reductions, one (C, N) reduction and one (C, 1, K) scatter per window,
+    only compiled in when the ring exists (state.telemetry is a
+    structural static, like `auto`)."""
+    from kubernetriks_tpu.batched.state import TelemetryRing
+
+    ring = state.telemetry
+    m1 = state.metrics
+    pods, nodes = state.pods, state.nodes
+    queued = (pods.phase == PHASE_QUEUED).sum(axis=1, dtype=jnp.int32)
+    unsched = (pods.phase == PHASE_UNSCHEDULABLE).sum(axis=1, dtype=jnp.int32)
+    alive = nodes.alive.sum(axis=1, dtype=jnp.int32)
+    hpa = (m1.scaled_up_pods - m0.scaled_up_pods) + (
+        m1.scaled_down_pods - m0.scaled_down_pods
+    )
+    ca = (m1.scaled_up_nodes - m0.scaled_up_nodes) + (
+        m1.scaled_down_nodes - m0.scaled_down_nodes
+    )
+    faults = (
+        (m1.node_crashes - m0.node_crashes)
+        + (m1.node_recoveries - m0.node_recoveries)
+        + (m1.pod_interruptions - m0.pod_interruptions)
+        + (m1.pod_restarts - m0.pod_restarts)
+        + (m1.pods_failed - m0.pods_failed)
+    )
+    row = jnp.stack(
+        [
+            W,
+            m1.scheduling_decisions - m0.scheduling_decisions,
+            queued,
+            unsched,
+            hpa,
+            ca,
+            faults,
+            alive,
+        ],
+        axis=-1,
+    ).astype(jnp.int32)
+    C, R = ring.buf.shape[0], ring.buf.shape[1]
+    rows = jnp.arange(C, dtype=jnp.int32)
+    buf = ring.buf.at[rows, jnp.mod(ring.cursor, R)].set(row)
+    return TelemetryRing(buf=buf, cursor=ring.cursor + 1)
+
+
 def _window_body(
     state: ClusterBatchState,
     slab: TraceSlab,
@@ -1506,6 +1556,9 @@ def _window_body(
     name_ranks=None,
 ) -> ClusterBatchState:
     W = jnp.broadcast_to(jnp.asarray(W, jnp.int32), state.time.shape)
+    # Telemetry ring (flight recorder): the window's incoming metric
+    # counters, diffed at the end of the body into one per-window record.
+    m0 = state.metrics
     # Same-time reschedule/retry ordering needs lexicographic name ranks to
     # match the scalar's sorted-name walks; they come from the autoscale
     # statics when autoscalers are on, else from the engine's standalone
@@ -1588,6 +1641,8 @@ def _window_body(
             pallas_axis=pallas_axis,
         )
         state = state._replace(auto=auto)
+    if state.telemetry is not None:
+        state = state._replace(telemetry=_telemetry_record(state, m0, W))
     return state
 
 

@@ -175,7 +175,6 @@ def build_batched_simulation(
 
 
 def run_batched(config: SimulationConfig, args) -> int:
-    import json
     import time
 
     sim = build_batched_simulation(
@@ -197,7 +196,20 @@ def run_batched(config: SimulationConfig, args) -> int:
         "Processed %d scheduling decisions in %.2fs (%.0f decisions/s)",
         decisions, elapsed, decisions / max(elapsed, 1e-9),
     )
-    print(json.dumps(summary, indent=2, default=float))
+    from kubernetriks_tpu.metrics.render import render_metrics, render_telemetry
+
+    print(render_metrics(summary, args.report or "json"))
+    if sim._telemetry:
+        # Flight recorder was armed (KTPU_TRACE=1): emit the telemetry
+        # report in the same format and write the Perfetto trace.
+        print(render_telemetry(sim.telemetry_report(), args.report or "json"))
+        from kubernetriks_tpu.flags import flag_str
+
+        trace_path = (flag_str("KTPU_TRACE_PATH") or "ktpu_trace") + ".json"
+        sim.write_chrome_trace(trace_path)
+        logging.getLogger(__name__).info(
+            "wrote Chrome trace (Perfetto-loadable) to %s", trace_path
+        )
     return 0
 
 
@@ -234,10 +246,25 @@ def main(argv=None) -> int:
         default=None,
         help="Path for the 5s gauge-metrics CSV (off by default)",
     )
+    parser.add_argument(
+        "--report",
+        choices=("json", "table"),
+        default=None,
+        help="End-of-run report format for BOTH backends (one rendering "
+        "path, metrics/render.py). Default: the legacy behavior — JSON, "
+        "or the config's metrics_printer format on the scalar backend.",
+    )
     args = parser.parse_args(argv)
 
     config = SimulationConfig.from_file(args.config_file)
     setup_logging(config)
+    if args.report is not None:
+        # --report supersedes the config's metrics_printer block; nulling
+        # it here keeps the run-loop callbacks from ALSO printing the
+        # configured report (one report, in the CLI-chosen format).
+        import dataclasses
+
+        config = dataclasses.replace(config, metrics_printer=None)
 
     if args.backend == "batched":
         return run_batched(config, args)
@@ -246,7 +273,15 @@ def main(argv=None) -> int:
     sim = KubernetriksSimulation(config, gauge_csv_path=args.gauge_csv)
     sim.initialize(cluster_trace, workload_trace)
     sim.run_with_callbacks(RunUntilAllPodsAreFinishedCallbacks())
-    if config.metrics_printer is None:
+    if args.report is not None:
+        # Explicit format: render through the shared path regardless of
+        # the config's metrics_printer block (batched runs honor the same
+        # flag, so both backends emit the same schema both ways).
+        from kubernetriks_tpu.metrics.printer import metrics_as_dict
+        from kubernetriks_tpu.metrics.render import render_metrics
+
+        print(render_metrics(metrics_as_dict(sim.metrics_collector), args.report))
+    elif config.metrics_printer is None:
         print_metrics(sim.metrics_collector, None)
     sim.metrics_collector.close()
     return 0

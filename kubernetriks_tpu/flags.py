@@ -91,6 +91,25 @@ _FLAGS = [
         "KTPU_DEBUG_FINITE state sweep runs at every dispatch boundary.",
     ),
     Flag(
+        "KTPU_TRACE",
+        "bool",
+        False,
+        "Flight recorder: host-side span tracer over every engine dispatch "
+        "phase plus the device-side per-window metrics ring carried in "
+        "ClusterBatchState. Read out via engine.telemetry_report() / "
+        "write_chrome_trace(); bench.py --trace embeds the summary in the "
+        "BENCH JSON. Off by default (telemetry-on is bit-identical and "
+        "gated <3% overhead, but the ring costs device memory).",
+    ),
+    Flag(
+        "KTPU_TRACE_PATH",
+        "str",
+        None,
+        "Output path stem for Chrome trace-event JSON written by "
+        "bench.py --trace (Perfetto-loadable). Unset: ktpu_trace under the "
+        "working directory.",
+    ),
+    Flag(
         "KUBERNETRIKS_PALLAS",
         "tristate",
         None,

@@ -42,53 +42,14 @@ def metrics_as_dict(collector: MetricsCollector) -> Dict[str, Any]:
     }
 
 
-def _format_table(rows: list, header: list) -> str:
-    widths = [
-        max(len(str(row[i])) for row in [header] + rows) for i in range(len(header))
-    ]
-
-    def fmt_row(row):
-        return "| " + " | ".join(str(v).ljust(w) for v, w in zip(row, widths)) + " |"
-
-    sep = "+" + "+".join("-" * (w + 2) for w in widths) + "+"
-    lines = [sep, fmt_row(header), sep]
-    lines += [fmt_row(row) for row in rows]
-    lines.append(sep)
-    return "\n".join(lines)
-
-
 def metrics_as_pretty_table(collector: MetricsCollector) -> str:
-    d = metrics_as_dict(collector)
-    counter_rows = [
-        ["Total nodes in trace", d["counters"]["total_nodes_in_trace"]],
-        ["Total pods in trace", d["counters"]["total_pods_in_trace"]],
-        ["Pods succeeded", d["counters"]["pods_succeeded"]],
-        ["Pods unschedulable", d["counters"]["pods_unschedulable"]],
-        ["Pods failed", d["counters"]["pods_failed"]],
-        ["Pods removed", d["counters"]["pods_removed"]],
-        ["Total scaled up nodes", d["counters"]["total_scaled_up_nodes"]],
-        ["Total scaled down nodes", d["counters"]["total_scaled_down_nodes"]],
-        ["Total scaled up pods", d["counters"]["total_scaled_up_pods"]],
-        ["Total scaled down pods", d["counters"]["total_scaled_down_pods"]],
-        ["Node crashes", d["counters"]["node_crashes"]],
-        ["Node recoveries", d["counters"]["node_recoveries"]],
-        ["Node downtime (s)", d["counters"]["node_downtime_s"]],
-        ["Pod interruptions", d["counters"]["pod_interruptions"]],
-        ["Pod restarts", d["counters"]["pod_restarts"]],
-    ]
-    timing_rows = [
-        [name, *(stats[k] for k in ("min", "max", "mean", "variance"))]
-        for name, stats in [
-            ("Pod duration", d["timings"]["pod_duration"]),
-            ("Pod schedule time", d["timings"]["pod_schedule_time"]),
-            ("Pod queue time", d["timings"]["pod_queue_time"]),
-        ]
-    ]
-    return (
-        _format_table(counter_rows, ["Metric", "Count"])
-        + "\n"
-        + _format_table(timing_rows, ["Metric", "Min", "Max", "Mean", "Variance"])
-    )
+    """Aligned-table rendering, through the SAME generic path the batched
+    engine's metrics_summary and the telemetry report use
+    (metrics/render.py) — scalar and batched runs emit the same report
+    schema in the same two formats."""
+    from kubernetriks_tpu.metrics.render import render_metrics
+
+    return render_metrics(metrics_as_dict(collector), "table")
 
 
 def print_metrics(

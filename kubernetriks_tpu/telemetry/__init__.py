@@ -1,0 +1,38 @@
+"""kubernetriks_tpu.telemetry — the composed hot path's flight recorder.
+
+Two synchronized halves (docs/DESIGN.md §"Telemetry"):
+
+- **Host span tracer** (tracer.py): a preallocated ring of
+  perf_counter_ns begin/end records over every engine phase — window
+  chunks, the fused chunk+slide megastep, superspan dispatches, stage
+  prefetch/assembly/upload, slides, window growth, checkpoint I/O — with
+  the async shift/progress readbacks modeled as flow events, exported as
+  Chrome trace-event JSON (Perfetto) and an aggregated per-phase report.
+- **Device metrics ring** (ring.py): per-window scheduling/autoscaler/
+  fault aggregates accumulated inside ClusterBatchState and drained only
+  at existing host sync boundaries, so telemetry-on adds zero new host
+  syncs and stays bit-identical to telemetry-off on every simulation
+  leaf.
+
+Enable with `KTPU_TRACE=1` (or `BatchedSimulation(telemetry=True)`);
+`engine.telemetry_report()` / `engine.write_chrome_trace()` read it out,
+and `bench.py --trace` embeds the summary in the BENCH JSON.
+"""
+
+from kubernetriks_tpu.telemetry.gauges import GaugeSeries
+from kubernetriks_tpu.telemetry.tracer import (
+    NULL_TRACER,
+    NullTracer,
+    PHASE_NAMES,
+    SpanTracer,
+    log_chunk_throughput,
+)
+
+__all__ = [
+    "GaugeSeries",
+    "NULL_TRACER",
+    "NullTracer",
+    "PHASE_NAMES",
+    "SpanTracer",
+    "log_chunk_throughput",
+]

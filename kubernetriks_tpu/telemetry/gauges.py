@@ -1,0 +1,86 @@
+"""Gauge time-series buffer: the per-window gauge instrumentation path,
+ported out of the engine onto the telemetry package (PR 8 satellite).
+
+The engine used to hold two parallel lists (`_gauge_windows` /
+`_gauge_samples`) and repeat the concat/CSV/npz-sidecar logic across
+four methods; this class is the one owner of that series. The engine
+still performs the device fetches at its (waived, instrumented-path)
+sync sites and hands HOST arrays in — this module never touches device
+values."""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import List
+
+import numpy as np
+
+
+class GaugeSeries:
+    """Accumulated (window-idx, (Wn, C, 7) sample) gauge chunks; columns
+    follow the scalar GAUGE_CSV_COLUMNS after the timestamp."""
+
+    def __init__(self) -> None:
+        self._windows: List[np.ndarray] = []
+        self._samples: List[np.ndarray] = []
+
+    def __bool__(self) -> bool:
+        return bool(self._windows)
+
+    def append(self, windows: np.ndarray, samples: np.ndarray) -> None:
+        """One chunk: windows (Wn,) int array, samples (Wn, C, 7) host
+        array (already fetched by the caller)."""
+        self._windows.append(np.asarray(windows))
+        self._samples.append(np.asarray(samples))
+
+    def series(self, n_clusters: int, interval: float):
+        """(times (W,), samples (W, C, 7)); empty arrays when no gauges
+        were collected."""
+        if not self._samples:
+            return np.zeros((0,)), np.zeros((0, n_clusters, 7))
+        times = np.concatenate(self._windows).astype(np.float64) * interval
+        return times, np.concatenate(self._samples, axis=0)
+
+    def write_csv(
+        self, path: str, cluster: int, n_clusters: int, interval: float
+    ) -> None:
+        """One cluster's series in the scalar collector's 8-column schema
+        (reference: src/metrics/collector.rs:216-228), so offline tooling
+        consumes either backend's output unchanged."""
+        from kubernetriks_tpu.metrics.collector import GAUGE_CSV_COLUMNS
+
+        times, samples = self.series(n_clusters, interval)
+        with open(path, "w", newline="") as f:
+            writer = csv.writer(f)
+            writer.writerow(GAUGE_CSV_COLUMNS)
+            for i, t in enumerate(times):
+                row = samples[i, cluster]
+                writer.writerow(
+                    [t, int(row[0]), int(row[1]), int(row[2]),
+                     float(row[3]), float(row[4]), float(row[5]),
+                     float(row[6])]
+                )
+
+    def save_sidecar(self, path: str) -> None:
+        """Persist next to a checkpoint; an empty series REMOVES a stale
+        sidecar so a previous save's gauges never shadow this run's on
+        restore."""
+        if self._windows:
+            np.savez(
+                path,
+                windows=np.concatenate(self._windows).astype(np.int32),
+                samples=np.concatenate(self._samples, axis=0).astype(
+                    np.float32
+                ),
+            )
+        elif os.path.exists(path):
+            os.remove(path)
+
+    @classmethod
+    def load_sidecar(cls, path: str) -> "GaugeSeries":
+        out = cls()
+        if os.path.exists(path):
+            data = np.load(path)
+            out.append(data["windows"], data["samples"])
+        return out

@@ -25,7 +25,9 @@ def _smoke_records(capsys, args):
     ]
     records = [json.loads(ln) for ln in lines]
     for rec in records:
-        assert set(rec) - {"spans"} == {"metric", "value", "unit", "vs_baseline"}
+        assert set(rec) - {"spans", "telemetry"} == {
+            "metric", "value", "unit", "vs_baseline",
+        }
         assert rec["unit"] == "decisions/s"
         assert rec["value"] > 0
         # Smoke values are toy-shape numbers; the rounded-to-3-decimals
@@ -34,8 +36,12 @@ def _smoke_records(capsys, args):
     return records
 
 
-def test_bench_smoke_emits_four_parseable_lines(capsys):
-    records = _smoke_records(capsys, ["--smoke"])
+def test_bench_smoke_emits_four_parseable_lines(capsys, tmp_path, monkeypatch):
+    # --trace rides along (the CI smoke job runs it this way): the
+    # composed lines must carry the flight-recorder summary AND write a
+    # Perfetto-loadable Chrome trace per traced line.
+    monkeypatch.setenv("KTPU_TRACE_PATH", str(tmp_path / "ktpu_trace"))
+    records = _smoke_records(capsys, ["--smoke", "--trace"])
     assert len(records) == 4, records
     # Line order is part of the contract: continuity, composed, superspan
     # machinery, north-star (the LAST line is the headline the driver
@@ -50,13 +56,43 @@ def test_bench_smoke_emits_four_parseable_lines(capsys):
         assert spans["n"] >= 5
         assert spans["min"] <= rec["value"] <= spans["max"]
     assert "spans" not in records[0] and "spans" not in records[3]
+    # Telemetry summary embedded in (exactly) the traced composed lines:
+    # per-phase wall time, the observed-vs-expected sync budget, dispatch
+    # stats with the ladder_fallbacks observable, device-ring totals.
+    for rec in (records[0], records[3]):
+        assert "telemetry" not in rec
+    for rec in records[1:3]:
+        tel = rec["telemetry"]
+        assert tel["spans_ms"]
+        assert tel["sync_budget"]["observed_slide_syncs"] >= 0
+        assert "ladder_fallbacks" in tel["dispatch_stats"]
+        assert tel["ring_totals"]["decisions"] > 0
+    # The superspan line's trace shows the scanned executor: superspan
+    # dispatches present, zero ladder chunks, sync budget exactly met.
+    tel = records[2]["telemetry"]
+    assert tel["dispatch_stats"]["superspans"] > 0
+    assert tel["dispatch_stats"]["window_chunks"] == 0
+    assert (
+        tel["sync_budget"]["observed_slide_syncs"]
+        == tel["sync_budget"]["steady_state_expected"]
+    )
+    for label in ("smoke_composed", "smoke_superspan"):
+        path = tmp_path / f"ktpu_trace_{label}.json"
+        assert path.exists(), f"missing Chrome trace {path}"
+        doc = json.loads(path.read_text())
+        assert doc["traceEvents"], "empty Chrome trace"
 
 
-def test_bench_smoke_faults_adds_chaos_line(capsys):
+def test_bench_smoke_faults_adds_chaos_line(capsys, tmp_path, monkeypatch):
     """--faults appends a fault-enabled composed smoke line (the chaos
-    engine's dispatch/throughput tracker) after the standard four."""
-    records = _smoke_records(capsys, ["--smoke", "--faults"])
+    engine's dispatch/throughput tracker) after the standard four.
+    --trace rides along so the traced composed lines are jit-cache hits
+    from the previous test (same programs); the chaos line itself is
+    untraced either way."""
+    monkeypatch.setenv("KTPU_TRACE_PATH", str(tmp_path / "ktpu_trace"))
+    records = _smoke_records(capsys, ["--smoke", "--faults", "--trace"])
     assert len(records) == 5, records
     assert "chaos" in records[4]["metric"]
     assert records[4]["value"] > 0
     assert records[4]["spans"]["n"] >= 5
+    assert "telemetry" not in records[4]

@@ -86,6 +86,42 @@ def test_batched_backend_runs_with_gauge_csv(tmp_path, capsys):
     assert len(rows) > 2
 
 
+def test_report_table_covers_both_backends(tmp_path, capsys, monkeypatch):
+    """--report table renders BOTH backends' metrics through the shared
+    path (metrics/render.py) in the same table shape; on the batched
+    backend with KTPU_TRACE=1 the telemetry report and the Chrome trace
+    ride along."""
+    cfg = _write_config(tmp_path)
+    assert main(["--config-file", cfg, "--report", "table"]) == 0
+    scalar_out = capsys.readouterr().out
+    assert "| Metric" in scalar_out and "Pod queue time" in scalar_out
+
+    monkeypatch.setenv("KTPU_TRACE", "1")
+    monkeypatch.setenv("KTPU_TRACE_PATH", str(tmp_path / "cli_trace"))
+    assert (
+        main(
+            ["--config-file", cfg, "--backend", "batched",
+             "--report", "table"]
+        )
+        == 0
+    )
+    batched_out = capsys.readouterr().out
+    assert "| Metric" in batched_out and "Pod queue time" in batched_out
+    assert "| Phase" in batched_out  # telemetry span table
+    assert (tmp_path / "cli_trace.json").exists()
+
+    # --report supersedes a configured metrics_printer: ONE report in the
+    # CLI-chosen format, not the config's PrettyTable plus the JSON.
+    monkeypatch.delenv("KTPU_TRACE")
+    cfg2 = _write_config(
+        tmp_path, extra="metrics_printer:\n  format: PrettyTable\n"
+    )
+    assert main(["--config-file", cfg2, "--report", "json"]) == 0
+    out2 = capsys.readouterr().out
+    assert out2.count('"pods_succeeded"') == 1
+    assert "| Metric" not in out2
+
+
 def test_trace_config_rejects_both_sources(tmp_path):
     """The reference asserts exactly one of alibaba/generic (main.rs:62-65)."""
     cfg = tmp_path / "bad.yaml"

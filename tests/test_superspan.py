@@ -22,7 +22,7 @@ import numpy as np
 import pytest
 
 import kubernetriks_tpu.batched.engine as engine_mod
-from kubernetriks_tpu.batched.state import compare_states
+from kubernetriks_tpu.batched.state import compare_states, strip_telemetry
 from kubernetriks_tpu.test_util import default_test_simulation_config
 
 from test_pod_window_growth import _build as _build_growth
@@ -59,7 +59,9 @@ def _assert_superspan_matches_ladder(ss, ladder):
 
     assert ss._pod_base == ladder._pod_base
     assert ss.next_window_idx == ladder.next_window_idx
-    assert compare_states(ss.state, ladder.state) == []
+    # strip_telemetry: a flight-recorder-armed ss engine (the fault test
+    # below) carries the device ring, the ONE leaf allowed to differ.
+    assert compare_states(strip_telemetry(ss.state), ladder.state) == []
     assert ss.metrics_summary() == ladder.metrics_summary()
     if ss.autoscale_statics is not None:
         # The carried windowed name ranks land back in the statics.
@@ -83,17 +85,27 @@ def test_superspan_composed_bit_identical():
     assert ss.dispatch_stats["slide_syncs"] == ss.dispatch_stats["superspans"]
 
 
-def test_superspan_composed_bit_identical_under_faults():
+def test_superspan_composed_bit_identical_under_faults(tmp_path):
     """Same flagship parity with the chaos engine on: node crash chains ride
     the slab, pod-attempt threefry draws happen at commit inside the scanned
     windows — the on-device slides must leave every draw slot-keyed exactly
-    as the ladder path sees it."""
+    as the ladder path sees it.
+
+    The ss engine ALSO runs with the flight recorder armed (PR 8): the
+    parity compare against the telemetry-OFF ladder is then the composed
+    HPA+CA+superspan+chaos telemetry bit-identity gate — telemetry-on,
+    across executors, changes no simulation leaf — at zero extra compile
+    cost (the ring variant replaces the program this test compiled
+    anyway). The composed-scale ring/report/budget gates ride here too;
+    tests/test_telemetry.py covers the mechanics on cheap engines."""
     ss = _run(
         _build_composed(
             config_suffix=FAULT_SUFFIX,
             superspan=True,
             superspan_k=4,
             superspan_chunk=4,
+            telemetry=True,
+            telemetry_ring=32,  # < executed windows: drains + wrap exercised
         )
     )
     assert ss.fault_params is not None
@@ -107,6 +119,42 @@ def test_superspan_composed_bit_identical_under_faults():
         "fault run produced no faults; parity under faults is vacuous"
     )
     _assert_superspan_matches_ladder(ss, ladder)
+
+    # --- composed-scale flight-recorder gates (PR 8) ---------------------
+    from kubernetriks_tpu.telemetry.ring import RING_COLUMNS
+
+    # No new syncs: the steady-state budget (1 progress readback per
+    # superspan, zero ladder chunks) is untouched by telemetry.
+    assert ss.dispatch_stats["slide_syncs"] == ss.dispatch_stats["superspans"]
+    assert ss.dispatch_stats["ladder_fallbacks"] == 0
+    # Ring lossless despite wrapping (capacity 32 < executed windows):
+    # every executed window has exactly one record, and the per-window
+    # decision deltas sum to the run's total decision counter.
+    executed = ss.next_window_idx
+    assert executed > 32
+    wins, data = ss.telemetry_window_series()
+    np.testing.assert_array_equal(wins, np.arange(executed, dtype=np.int32))
+    assert (
+        int(data[:, :, RING_COLUMNS.index("decisions")].sum())
+        == counters["scheduling_decisions"]
+    )
+    # The composed scenario's activity is visible in the ring columns.
+    for col in ("hpa_pod_actions", "ca_node_actions", "fault_events"):
+        assert int(data[:, :, RING_COLUMNS.index(col)].sum()) > 0, col
+    rep = ss.telemetry_report()
+    assert rep["spans"]["superspan"]["count"] == ss.dispatch_stats["superspans"]
+    assert rep["spans"]["progress_wait"]["count"] == ss.dispatch_stats["slide_syncs"]
+    assert (
+        rep["sync_budget"]["observed_slide_syncs"]
+        == rep["sync_budget"]["steady_state_expected"]
+    )
+    assert rep["ring"]["windows_kept"] == executed
+    # The emitted Chrome trace carries the async progress readbacks as
+    # matched flow pairs (the overlap arrows a Perfetto view shows).
+    from test_telemetry import validate_chrome_trace
+
+    path = ss.write_chrome_trace(str(tmp_path / "trace.json"))
+    validate_chrome_trace(path, expect_flows=True)
 
 
 def test_superspan_bounded_stage_and_exhaustion_exit(monkeypatch):

@@ -1,0 +1,344 @@
+"""Flight recorder (kubernetriks_tpu/telemetry) — PR 8 mechanics gates.
+
+Two-tier coverage, split to keep tier-1 inside its wall-clock budget:
+
+- The COMPOSED-SCALE gates (HPA + CA + superspan + chaos: telemetry-on
+  bit-identical across executors, composed ring columns live, steady-state
+  sync budget) ride the existing engines of
+  test_superspan.py::test_superspan_composed_bit_identical_under_faults —
+  arming the flight recorder there costs zero extra compiles.
+- THIS module pins the recorder's mechanics on cheap engines (small
+  programs, fast compiles — full-resident for the pair, one sliding
+  superspan for the staging pipeline): strict dispatch-stats equality
+  telemetry-on vs -off (the no-new-syncs gate),
+  ring wrap + pressure-drain losslessness, Chrome trace-event schema
+  (spans, flow pairs, counter tracks), checkpoint roundtrip of the ring,
+  the <3% overhead gate, the ladder-fallback observable, the tracer
+  per-span microbenchmark, and the shared JSON/table render path.
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from kubernetriks_tpu.batched.engine import build_batched_from_traces
+from kubernetriks_tpu.batched.state import compare_states, strip_telemetry
+from kubernetriks_tpu.telemetry.ring import RING_COLUMNS
+from kubernetriks_tpu.telemetry.tracer import PH_WINDOW_CHUNK, SpanTracer
+from kubernetriks_tpu.test_util import default_test_simulation_config
+from kubernetriks_tpu.trace.generator import (
+    PoissonWorkloadTrace,
+    UniformClusterTrace,
+)
+
+from test_window_donation_dispatch import _build_dense_sliding
+
+ENDS = (150.0, 300.0, 450.0)
+
+
+def _build_plain(**kwargs):
+    """Cheapest real engine: full-resident, no autoscalers, one small
+    run_windows program — the module's workhorse (tier-1 wall-clock:
+    the composed/superspan-scale telemetry gates ride test_superspan's
+    existing engines instead of recompiling composed programs here)."""
+    config = default_test_simulation_config()
+    cluster = UniformClusterTrace(8, cpu=64000, ram=128 * 1024**3)
+    workload = PoissonWorkloadTrace(
+        rate_per_second=1.0,
+        horizon=400.0,
+        seed=5,
+        cpu=4000,
+        ram=4 * 1024**3,
+        duration_range=(20.0, 40.0),
+    )
+    return build_batched_from_traces(
+        config,
+        cluster.convert_to_simulator_events(),
+        workload.convert_to_simulator_events(),
+        n_clusters=2,
+        max_pods_per_cycle=16,
+        fast_forward=False,
+        **kwargs,
+    )
+
+
+@pytest.fixture(scope="module")
+def cheap_pair():
+    """Telemetry-ON vs telemetry-OFF plain runs. telemetry_ring=16 is
+    deliberately SMALLER than the executed window count, so the
+    pressure-based drain at step_until_time exits must fire mid-run for
+    the series to stay lossless."""
+    on = _build_plain(telemetry=True, telemetry_ring=16)
+    off = _build_plain()
+    for end in ENDS:
+        on.step_until_time(end)
+        off.step_until_time(end)
+    return on, off
+
+
+def test_telemetry_on_is_bit_identical(cheap_pair):
+    on, off = cheap_pair
+    assert on.dispatch_stats["window_chunks"] > 0
+    assert compare_states(strip_telemetry(on.state), off.state) == []
+    assert on.metrics_summary() == off.metrics_summary()
+    assert on.next_window_idx == off.next_window_idx
+
+
+def test_telemetry_adds_no_new_syncs(cheap_pair):
+    """The dispatch-count regression gate: telemetry must not add a
+    single dispatch or blocking readback to the steady-state loop —
+    slide_syncs is the budget the lint sync-ok waivers document."""
+    on, off = cheap_pair
+    assert on.dispatch_stats == off.dispatch_stats
+
+
+def test_ring_series_is_lossless_and_matches_metrics(cheap_pair):
+    """Every executed window has exactly one ring record (the ring
+    wrapped several times — capacity 16 < executed windows — so this also
+    proves the pressure drain fired at existing boundaries), and the
+    per-window decision deltas sum to the run's total decision counter."""
+    on, _ = cheap_pair
+    executed = on.next_window_idx
+    assert executed > on._telemetry_ring_size  # the ring really wrapped
+    wins, data = on.telemetry_window_series()
+    np.testing.assert_array_equal(wins, np.arange(executed, dtype=np.int32))
+    assert on._ring_windows_recorded == executed
+    total = on.metrics_summary()["counters"]["scheduling_decisions"]
+    assert total > 0
+    assert int(data[:, :, RING_COLUMNS.index("decisions")].sum()) == total
+    assert int(data[:, :, RING_COLUMNS.index("alive_nodes")].max()) > 0
+
+
+def test_telemetry_report_shape(cheap_pair):
+    on, _ = cheap_pair
+    rep = on.telemetry_report()
+    assert rep["enabled"]
+    assert (
+        rep["spans"]["window_chunk"]["count"]
+        == on.dispatch_stats["window_chunks"]
+    )
+    # Full-resident run: zero slides, zero syncs — budget trivially met
+    # (the composed-scale budget gate lives in test_superspan.py).
+    assert rep["sync_budget"]["observed_slide_syncs"] == (
+        rep["sync_budget"]["steady_state_expected"]
+    ) == 0
+    assert rep["dispatch_stats"]["ladder_fallbacks"] == 0
+    assert rep["ring"]["windows_kept"] == on.next_window_idx
+
+
+def validate_chrome_trace(path, expect_flows):
+    """Chrome trace-event JSON schema check, shared with the superspan
+    fault test (which validates a trace WITH async-readback flow pairs):
+    X spans with nonnegative durations, process metadata, the device
+    ring's sim-time counter track, s/f flows in matched id pairs, and
+    every span name drawn from the known phase taxonomy."""
+    with open(path) as fh:
+        doc = json.load(fh)
+    events = doc["traceEvents"]
+    assert isinstance(events, list) and events
+    phases = {"M": 0, "X": 0, "s": 0, "f": 0, "C": 0}
+    flow_ids = {"s": set(), "f": set()}
+    for ev in events:
+        assert {"ph", "name", "pid"} <= set(ev)
+        phases[ev["ph"]] = phases.get(ev["ph"], 0) + 1
+        if ev["ph"] == "X":
+            assert ev["dur"] >= 0 and ev["ts"] >= 0
+        if ev["ph"] in ("s", "f"):
+            flow_ids[ev["ph"]].add(ev["id"])
+        if ev["ph"] == "C":
+            assert ev["args"], "counter event without a value"
+    assert phases["X"] > 0, "no host spans"
+    assert phases["C"] > 0, "no device-ring counter track"
+    assert flow_ids["s"] == flow_ids["f"], (
+        "async readback flows must come in matched start/finish pairs"
+    )
+    if expect_flows:
+        assert phases["s"] > 0, "no async-readback flow events"
+    # Every span name is a known phase (schema, not free text).
+    from kubernetriks_tpu.telemetry import PHASE_NAMES
+
+    for ev in events:
+        if ev["ph"] == "X":
+            assert ev["name"] in PHASE_NAMES
+
+
+def test_chrome_trace_schema(cheap_pair, tmp_path):
+    """The emitted trace validates (a full-resident run has no async
+    readbacks, hence no flow pairs — the superspan fault test validates
+    the flow-carrying trace)."""
+    on, _ = cheap_pair
+    path = on.write_chrome_trace(str(tmp_path / "trace.json"))
+    validate_chrome_trace(path, expect_flows=False)
+
+
+def test_checkpoint_roundtrip_with_telemetry(cheap_pair, tmp_path):
+    """The ring is ordinary state: a save→restore roundtrip on a
+    telemetry-on engine reproduces it (and the drained series)."""
+    pytest.importorskip("orbax.checkpoint")
+    on, off = cheap_pair
+    path = str(tmp_path / "ckpt")
+    on.save_checkpoint(path)
+    fresh = _build_plain(telemetry=True, telemetry_ring=16)
+    fresh.load_checkpoint(path)
+    assert compare_states(fresh.state, on.state) == []
+    wins_a, data_a = on.telemetry_window_series()
+    wins_b, data_b = fresh.telemetry_window_series()
+    # The restored engine re-drains only what the restored ring still
+    # holds (capacity 16): the tail of the original series, bit-equal.
+    assert len(wins_b) > 0 and set(wins_b) <= set(wins_a)
+    np.testing.assert_array_equal(data_b, data_a[-len(wins_b):])
+    # Mismatch guard: restoring onto a telemetry-off engine (different
+    # state pytree) raises the actionable message, not an opaque orbax
+    # structure error — and before touching the engine's state.
+    plain = _build_plain()
+    with pytest.raises(ValueError, match="telemetry ring mismatch"):
+        plain.load_checkpoint(path)
+    # The reverse mismatch too: a plain save writes NO meta file at all
+    # (full-resident, no ring), and restoring it into an armed engine
+    # must raise the same actionable message, not an orbax structure
+    # error — the guard runs even with the meta absent.
+    plain_path = str(tmp_path / "ckpt_plain")
+    off.save_checkpoint(plain_path)
+    import os
+
+    assert not os.path.exists(plain_path + ".meta.json")
+    with pytest.raises(ValueError, match="telemetry ring mismatch"):
+        fresh.load_checkpoint(plain_path)
+
+
+def test_ring_drain_handles_uneven_spans():
+    """Wrap-loss regression: a short call that leaves undrained rows
+    under the exit-drain threshold, followed by a call long enough to
+    wrap past them, must still produce a lossless series (the entry-side
+    guard drains before dispatching the wrapping span)."""
+    sim = _build_plain(telemetry=True, telemetry_ring=16)
+    sim.step_until_time(60.0)  # 7 windows: below the exit-drain threshold
+    sim.step_until_time(180.0)  # 12 more: would overwrite rows 0-2 unguarded
+    wins, _ = sim.telemetry_window_series()
+    np.testing.assert_array_equal(
+        wins, np.arange(sim.next_window_idx, dtype=np.int32)
+    )
+    assert sim._ring_windows_recorded == sim.next_window_idx
+
+
+def test_staged_superspan_records_prefetch_spans(monkeypatch):
+    """Over-budget (bounded RefillStage) superspan runs surface the
+    staging pipeline in the trace: stage_assemble/stage_put spans for
+    every install, stage_prefetch spans for the double-buffered
+    successor, and the hit/miss counters feeding
+    stage_prefetch_hit_rate — the overlap the flight recorder exists to
+    make visible."""
+    import kubernetriks_tpu.batched.engine as engine_mod
+
+    monkeypatch.setattr(engine_mod, "_DEVICE_SLIDE_BUDGET_BYTES", 0)
+    sim = _build_dense_sliding(
+        telemetry=True, telemetry_ring=16,
+        superspan=True, superspan_k=4, superspan_chunk=4,
+    )
+    assert sim._device_slide is None, "budget monkeypatch did not take"
+    for end in ENDS:
+        sim.step_until_time(end)
+    rep = sim.telemetry_report()
+    assert rep["spans"]["stage_assemble"]["count"] >= 1
+    assert rep["spans"]["stage_put"]["count"] >= 1
+    assert rep["spans"]["stage_prefetch"]["count"] >= 1
+    hits = rep["counters"].get("stage_prefetch_hit", 0)
+    misses = rep["counters"].get("stage_prefetch_miss", 0)
+    assert hits + misses >= 1  # at least the initial install counted
+    assert rep.get("stage_prefetch_hit_rate", 0) == hits / (hits + misses)
+
+
+def test_ladder_fallback_counter():
+    """A superspan-selected engine forced onto the ladder (log_throughput
+    wants per-chunk timings) counts the fallback — observable outside
+    bench.py --smoke. One short span keeps the compile bill at two small
+    ladder shapes."""
+    sim = _build_dense_sliding(superspan=True)
+    sim.log_throughput = True
+    sim.step_until_time(80.0)
+    assert sim.dispatch_stats["superspans"] == 0
+    assert sim.dispatch_stats["ladder_fallbacks"] > 0
+    assert sim.dispatch_stats["window_chunks"] > 0
+
+
+def test_tracer_span_cost_microbench():
+    """Design bound: begin/end is well under a microsecond each on real
+    hardware; the CI gate allows generous container noise but still
+    catches an accidental allocation or string format on the record
+    path."""
+    tr = SpanTracer(capacity=1 << 12)
+    n = 20_000
+    t_start = time.perf_counter_ns()
+    for _ in range(n):
+        t0 = tr.begin()
+        tr.end(PH_WINDOW_CHUNK, t0)
+    per_span_us = (time.perf_counter_ns() - t_start) / n / 1e3
+    assert per_span_us < 10.0, f"{per_span_us:.2f} µs per span"
+    rep = tr.report()
+    assert rep["spans"]["window_chunk"]["count"] == n
+    assert rep["span_events"]["kept"] == 1 << 12  # ring wrapped, report exact
+
+
+def test_overhead_gate_smoke_scenario():
+    """<3% wall-clock overhead, telemetry-on vs -off, on the smoke-scale
+    scenario: both engines advance through the SAME sim regions in
+    alternating timed spans (each pair hits identical windows), and the
+    medians must stay inside the gate (small absolute slack absorbs
+    container scheduling noise on sub-second spans). Engine configs match
+    the module fixture's exactly, so the programs are jit-cache hits —
+    the test times execution, not compilation."""
+    on = _build_plain(telemetry=True, telemetry_ring=16)
+    off = _build_plain()
+    # Warm both: any residual compile + first slides out of the timed
+    # region.
+    on.step_until_time(120.0)
+    off.step_until_time(120.0)
+    pairs = []
+    end = 120.0
+    for _ in range(3):
+        end += 100.0
+        t0 = time.perf_counter()
+        off.step_until_time(end)
+        t_off = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        on.step_until_time(end)
+        t_on = time.perf_counter() - t0
+        pairs.append((t_on, t_off))
+    t_on_med = float(np.median([a for a, _ in pairs]))
+    t_off_med = float(np.median([b for _, b in pairs]))
+    assert t_on_med <= t_off_med * 1.03 + 0.10, (
+        f"telemetry overhead gate: on={t_on_med:.3f}s off={t_off_med:.3f}s "
+        f"(pairs={pairs})"
+    )
+
+
+def test_shared_render_path_covers_scalar_batched_and_telemetry(cheap_pair):
+    """metrics/render.py is the ONE JSON/table path: the scalar printer's
+    table, the batched summary and the telemetry report all render
+    through it, and scalar/batched reports share the {"counters",
+    "timings"} schema with identical timing keys."""
+    from kubernetriks_tpu.metrics.collector import MetricsCollector
+    from kubernetriks_tpu.metrics.printer import metrics_as_dict
+    from kubernetriks_tpu.metrics.render import (
+        render_metrics,
+        render_telemetry,
+    )
+
+    on, _ = cheap_pair
+    batched = on.metrics_summary()
+    scalar = metrics_as_dict(MetricsCollector())
+
+    assert set(scalar) == set(batched) == {"counters", "timings"}
+    assert set(scalar["timings"]) == set(batched["timings"])
+    for d in (scalar, batched):
+        table = render_metrics(d, "table")
+        assert "Metric" in table and "Pod queue time" in table and "|" in table
+        parsed = json.loads(render_metrics(d, "json"))
+        assert parsed["counters"] == json.loads(
+            json.dumps(d["counters"], default=float)
+        )
+    rep_table = render_telemetry(on.telemetry_report(), "table")
+    assert "window_chunk" in rep_table and "Ring windows kept" in rep_table
+    json.loads(render_telemetry(on.telemetry_report(), "json"))
