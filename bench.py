@@ -173,6 +173,12 @@ def run_composed(
     fast_forward=None,
     trace: bool = False,  # --trace: flight recorder + telemetry in the JSON
     trace_path: str = None,  # Chrome trace output (Perfetto-loadable)
+    # PR 9 window-cost switches (None = engine/platform default) — exposed
+    # so the A/B capture protocol can isolate each front against the same
+    # bench scenario (see BENCH_r07.json).
+    lane_major=None,
+    window_razor=None,
+    ca_descatter=None,
 ) -> dict:
     """The COMPOSED flagship configuration as a tracked line (VERDICT r3
     item 4): HPA pod groups + cluster autoscaler + sliding pod window +
@@ -247,6 +253,9 @@ cluster_autoscaler:
         use_pallas=use_pallas,
         superspan=superspan,
         fast_forward=fast_forward,
+        lane_major=lane_major,
+        window_razor=window_razor,
+        ca_descatter=ca_descatter,
         # --trace arms the flight recorder: host span tracer + device
         # metrics ring. Bit-identical to telemetry-off and inside the <3%
         # overhead gate (tests/test_telemetry.py), so the traced line IS
@@ -273,16 +282,37 @@ cluster_autoscaler:
         sim.precompile_chunks()
     # >= 5 repeated timed spans; each span's decision fetch is a real sync,
     # so no device work leaks across span clocks.
-    rates = []
+    #
+    # Span VALIDITY (r7 protocol fix): a timed span that committed ZERO
+    # decisions ran past trace exhaustion (or landed wholly inside an HPA
+    # load-curve trough) — its rate is 0 by construction and poisons the
+    # min/median (BENCH_r06.json recorded spans.min = 0 exactly this way).
+    # Zero-decision spans are DROPPED from the protocol; if fewer than 5
+    # valid spans remain by t_end, the bench re-arms extra spans (the HPA
+    # churn cycles indefinitely, so decisions resume) up to a hard cap and
+    # fails loudly rather than reporting a median over dead air.
+    rates, span_decisions = [], []
     end = warm_until + step
-    while end <= t_end:
+    max_end = t_end + 5 * step  # re-arm bound
+
+    def n_valid() -> int:
+        return sum(1 for d in span_decisions if d > 0)
+
+    while end <= t_end or (n_valid() < 5 and end <= max_end):
         decisions_before = decisions_now()
         t0 = time.perf_counter()
         sim.step_until_time(end)
         decisions = decisions_now() - decisions_before
+        span_decisions.append(decisions)
         rates.append(decisions / (time.perf_counter() - t0))
         end += step
-    assert len(rates) >= 5, "composed bench: need >= 5 timed spans"
+    valid = [r for r, d in zip(rates, span_decisions) if d > 0]
+    dropped = len(rates) - len(valid)
+    assert len(valid) >= 5, (
+        f"composed bench: only {len(valid)} valid timed spans "
+        f"({dropped} dropped as zero-decision/trace-exhausted, re-arm cap "
+        f"{max_end}s reached) — extend horizon or shrink step"
+    )
     assert sim._pod_base > 0, "composed bench: pod window never slid"
     c = sim.metrics_summary()["counters"]
     assert c["total_scaled_up_pods"] > 0, "composed bench: HPA idle"
@@ -297,11 +327,12 @@ cluster_autoscaler:
             "composed bench: superspan engine dispatched ladder chunks"
         )
     out = {
-        "value": float(np.median(rates)),
+        "value": float(np.median(valid)),
         "spans": {
-            "n": len(rates),
-            "min": round(min(rates)),
-            "max": round(max(rates)),
+            "n": len(valid),
+            "min": round(min(valid)),
+            "max": round(max(valid)),
+            "dropped": dropped,
         },
     }
     if trace:
@@ -309,7 +340,8 @@ cluster_autoscaler:
         # host wall time, the observed sync count vs the documented
         # steady-state budget (1 progress readback per superspan + 1 shift
         # readback per fused slide), dispatch stats incl. ladder_fallbacks,
-        # and the device ring's per-window totals.
+        # the device ring's per-window totals, and the per-window
+        # window-program cost (the lane-major/razor/de-scatter observable).
         rep = sim.telemetry_report()
         out["telemetry"] = {
             "spans_ms": {
@@ -319,6 +351,19 @@ cluster_autoscaler:
             "sync_budget": rep["sync_budget"],
             "dispatch_stats": rep["dispatch_stats"],
             "ring_totals": rep.get("ring", {}).get("totals", {}),
+        }
+        # Per-window device-cost line: must exist and be positive on every
+        # traced run — CPU CI runs --smoke --trace, so a change that stops
+        # windows (or their cost accounting) from being recorded fails
+        # loudly there, and layout regressions move a number CI can diff.
+        pw = rep.get("per_window")
+        assert pw and pw["ms_per_window"] > 0, (
+            "composed bench --trace: telemetry report carries no "
+            "per-window cost line (no windows recorded?)"
+        )
+        out["telemetry"]["per_window"] = {
+            "windows": pw["windows"],
+            "ms_per_window": round(pw["ms_per_window"], 4),
         }
         if trace_path:
             sim.write_chrome_trace(trace_path)

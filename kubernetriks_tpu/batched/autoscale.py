@@ -77,6 +77,7 @@ from kubernetriks_tpu.batched.state import (
     PHASE_SUCCEEDED,
     PHASE_UNSCHEDULABLE,
     StepConstants,
+    swap_node_layout,
 )
 from kubernetriks_tpu.batched.timerep import (
     TPair,
@@ -718,6 +719,7 @@ def _ca_scale_down(
     pallas_interpret: bool = False,
     pallas_mesh=None,
     pallas_axis: str = "clusters",
+    descatter: bool = True,
 ):
     """Threshold + simulated-re-placement scale-down
     (reference: kube_cluster_autoscaler.rs:242-290). Returns
@@ -728,7 +730,22 @@ def _ca_scale_down(
     knows at the snapshot time `snap`: a running pod whose finish became
     visible by snap counts as gone (its resources freed), and a
     just-succeeded pod whose finish is NOT yet visible still counts as
-    running (its resources held, and it still needs re-placement)."""
+    running (its resources held, and it still needs re-placement).
+
+    descatter (KTPU_CA_DESCATTER, r9 — round 3 of the de-scatter
+    campaign): the correction segment-sum and the node-grouping sort above
+    were the down-cond's two remaining expensive blocks after r5 (each a
+    (C, P) sort + a pair of (C, P, N) rank-count reductions — DESIGN.md
+    names them as the ~2.5 ms residue). They share a node key, so ONE
+    combined 2-key sort (node, on_any-last... see below) and ONE pair of
+    boundary reductions now serve both: the secondary key puts each node's
+    storage-RUNNING pods first in its segment (so the grouping tables
+    slice the same prefix the old single-key sort produced), the
+    correction deltas ride the same sort as values (untouched rows carry
+    0, so the full-segment integer sums equal the old touched-only sums
+    exactly), and the per-node running count folds from a sorted
+    indicator cumsum. Bit-exact by integer-additivity + stable-sort
+    prefix order; descatter=False keeps the r5 two-sort path for A/B."""
     pods, nodes = state.pods, state.nodes
     C, P = pods.phase.shape
     N = nodes.alive.shape[1]
@@ -770,49 +787,93 @@ def _ca_scale_down(
         vis_back, pods.req_ram, 0
     )
     touched = vis_gone | vis_back
-    tkey = jnp.where(touched, node_c, jnp.int32(N))
-    tkey_s, dc_s, dr_s = jax.lax.sort(
-        (tkey, d_cpu, d_ram), dimension=1, num_keys=1, is_stable=True
-    )
-    zero_col = jnp.zeros((C, 1), jnp.int32)
-    ecs_c = jnp.concatenate([zero_col, jnp.cumsum(dc_s, axis=1)], axis=1)
-    ecs_r = jnp.concatenate([zero_col, jnp.cumsum(dr_s, axis=1)], axis=1)
-    tstart = (tkey_s[:, :, None] < col_n[:, None, :]).sum(
-        axis=1, dtype=jnp.int32
-    )
-    tend = tstart + (tkey_s[:, :, None] == col_n[:, None, :]).sum(
-        axis=1, dtype=jnp.int32
-    )
-    alloc_cpu_v = alloc_cpu_v + ecs_c[rows, tend] - ecs_c[rows, tstart]
-    alloc_ram_v = alloc_ram_v + ecs_r[rows, tend] - ecs_r[rows, tstart]
-
-    # Group storage-visible running pods by assigned node ONCE (a per-slot
-    # (C, P) mask + argsort made the pass O(S * P log P) per window — fatal
-    # at trace scale); each node's pods become a contiguous segment of
-    # `porder`. The pod requests ride the sort as VALUES, so the per-
-    # candidate tables below slice sorted arrays instead of gathering
-    # through pod_order (one fewer (C, S*K_sd) gather). Segment starts and
-    # counts come from rank-count reductions over the sorted keys — a
-    # fused (C, P, N) compare+sum — instead of the serial per-index
-    # scatter-min/scatter-add pair (~2.3 ms/window at the composed shape).
     on_any = ((phase_v == PHASE_RUNNING) & ~vis_gone) | vis_back
-    key_node = jnp.where(on_any, pods.node, jnp.int32(N))
-    key_sorted, rc_sorted, rr_sorted = jax.lax.sort(
-        (key_node, pods.req_cpu, pods.req_ram),
-        dimension=1,
-        num_keys=1,
-        is_stable=True,
-    )
-    # seg_start[n] = #pods on nodes < n = first sorted position of node n's
-    # segment (for a pod-less node this lands on the next segment instead
-    # of the old scatter-min's P sentinel — all consumers mask by
-    # seg_count == 0 first, so the value is never read).
-    seg_start = (key_sorted[:, :, None] < col_n[:, None, :]).sum(
-        axis=1, dtype=jnp.int32
-    )
-    seg_count = (key_sorted[:, :, None] == col_n[:, None, :]).sum(
-        axis=1, dtype=jnp.int32
-    )
+    zero_col = jnp.zeros((C, 1), jnp.int32)
+    if descatter:
+        # Combined de-scatter (see docstring): one 2-key sort — node slot,
+        # then storage-running FIRST — serves the correction AND the
+        # grouping. on_any pods have node >= 0 and touched pods are
+        # RUNNING-phase, so node_c == the old sorts' key values.
+        in_seg = touched | on_any
+        key_node = jnp.where(in_seg, node_c, jnp.int32(N))
+        key2 = jnp.where(on_any, 0, 1).astype(jnp.int32)
+        key_s, _, dc_s, dr_s, ind_s, rc_sorted, rr_sorted = jax.lax.sort(
+            (
+                key_node,
+                key2,
+                d_cpu,
+                d_ram,
+                on_any.astype(jnp.int32),
+                pods.req_cpu,
+                pods.req_ram,
+            ),
+            dimension=1,
+            num_keys=2,
+            is_stable=True,
+        )
+        # ONE pair of (C, P, N) rank-count boundary reductions shared by
+        # the correction and the grouping (was two pairs).
+        tstart = (key_s[:, :, None] < col_n[:, None, :]).sum(
+            axis=1, dtype=jnp.int32
+        )
+        tend = tstart + (key_s[:, :, None] == col_n[:, None, :]).sum(
+            axis=1, dtype=jnp.int32
+        )
+        ecs_c = jnp.concatenate([zero_col, jnp.cumsum(dc_s, axis=1)], axis=1)
+        ecs_r = jnp.concatenate([zero_col, jnp.cumsum(dr_s, axis=1)], axis=1)
+        ecs_n = jnp.concatenate([zero_col, jnp.cumsum(ind_s, axis=1)], axis=1)
+        alloc_cpu_v = alloc_cpu_v + ecs_c[rows, tend] - ecs_c[rows, tstart]
+        alloc_ram_v = alloc_ram_v + ecs_r[rows, tend] - ecs_r[rows, tstart]
+        # Node n's segment LEADS with its on_any pods in slot order (stable
+        # sort, key2), so the grouping tables slice the same prefix the old
+        # single-key sort produced; the running count folds from the
+        # indicator cumsum over the same boundaries.
+        seg_start = tstart
+        seg_count = ecs_n[rows, tend] - ecs_n[rows, tstart]
+    else:
+        # r5 two-sort path, kept for A/B (KTPU_CA_DESCATTER=0).
+        tkey = jnp.where(touched, node_c, jnp.int32(N))
+        tkey_s, dc_s, dr_s = jax.lax.sort(
+            (tkey, d_cpu, d_ram), dimension=1, num_keys=1, is_stable=True
+        )
+        ecs_c = jnp.concatenate([zero_col, jnp.cumsum(dc_s, axis=1)], axis=1)
+        ecs_r = jnp.concatenate([zero_col, jnp.cumsum(dr_s, axis=1)], axis=1)
+        tstart = (tkey_s[:, :, None] < col_n[:, None, :]).sum(
+            axis=1, dtype=jnp.int32
+        )
+        tend = tstart + (tkey_s[:, :, None] == col_n[:, None, :]).sum(
+            axis=1, dtype=jnp.int32
+        )
+        alloc_cpu_v = alloc_cpu_v + ecs_c[rows, tend] - ecs_c[rows, tstart]
+        alloc_ram_v = alloc_ram_v + ecs_r[rows, tend] - ecs_r[rows, tstart]
+
+        # Group storage-visible running pods by assigned node ONCE (a
+        # per-slot (C, P) mask + argsort made the pass O(S * P log P) per
+        # window — fatal at trace scale); each node's pods become a
+        # contiguous segment of `porder`. The pod requests ride the sort
+        # as VALUES, so the per-candidate tables below slice sorted arrays
+        # instead of gathering through pod_order (one fewer (C, S*K_sd)
+        # gather). Segment starts and counts come from rank-count
+        # reductions over the sorted keys — a fused (C, P, N) compare+sum
+        # — instead of the serial per-index scatter-min/scatter-add pair
+        # (~2.3 ms/window at the composed shape).
+        key_node = jnp.where(on_any, pods.node, jnp.int32(N))
+        key_sorted, rc_sorted, rr_sorted = jax.lax.sort(
+            (key_node, pods.req_cpu, pods.req_ram),
+            dimension=1,
+            num_keys=1,
+            is_stable=True,
+        )
+        # seg_start[n] = #pods on nodes < n = first sorted position of node
+        # n's segment (for a pod-less node this lands on the next segment
+        # instead of the old scatter-min's P sentinel — all consumers mask
+        # by seg_count == 0 first, so the value is never read).
+        seg_start = (key_sorted[:, :, None] < col_n[:, None, :]).sum(
+            axis=1, dtype=jnp.int32
+        )
+        seg_count = (key_sorted[:, :, None] == col_n[:, None, :]).sum(
+            axis=1, dtype=jnp.int32
+        )
     col_k = jnp.arange(K_sd, dtype=jnp.int32)[None, :]
 
     # Candidate walk order and liveness, shared by both paths: CA slots in
@@ -1020,10 +1081,21 @@ def ca_pass(
     pallas_interpret: bool = False,
     pallas_mesh=None,
     pallas_axis: str = "clusters",
+    nodes_lane_major: bool = False,
+    descatter: bool = True,
 ) -> Tuple[ClusterBatchState, AutoscaleState]:
     """One masked cluster-autoscaler cycle (scalar equivalent:
     cluster_autoscaler.py cycle; AUTO info policy: scale up iff the
     unscheduled cache is non-empty, reference: persistent_storage.rs:381-412).
+
+    nodes_lane_major (KTPU_LANE_MAJOR): the hot node leaves arrive (N, C);
+    the CA glue is (C, N)-oriented (name-order gathers, grouping sorts), so
+    it normalizes to row-major VIEWS here — a handful of transposes per
+    window against the ~20 kernel-boundary transposes the mode removes in
+    the base window (docs/DESIGN.md §"window-cost anatomy"). The pass only
+    WRITES the pending pairs (create_time / remove_time — row-major
+    always), so nothing converts back. descatter (KTPU_CA_DESCATTER):
+    see _ca_scale_down.
 
     Exact cadence + snapshot semantics (r4): `auto.ca_next` is the TRUE
     cycle-fire time c_k (the scalar re-arms scan_interval after the info
@@ -1041,6 +1113,12 @@ def ca_pass(
       relative to the window boundary the arrays reflect.
     """
     pods, nodes, metrics = state.pods, state.nodes, state.metrics
+    # ONE owner of the hot-leaf transpose set (state.swap_node_layout);
+    # the pass reads through the row-major view and writes the pending
+    # pairs back through the ORIGINAL `nodes`, so the hot leaves keep
+    # their incoming layout.
+    state_row = swap_node_layout(state) if nodes_lane_major else state
+    nodes_row = state_row.nodes
     C = pods.phase.shape[0]
     interval = jnp.float32(consts.scheduling_interval)
     T = TPair(win=W, off=jnp.zeros((C,), jnp.float32))
@@ -1054,17 +1132,20 @@ def ca_pass(
     early_snap = due & t_lt(snap, commit_vis)
     if pre is not None:
         pre_phase, pre_attempts, pre_alloc_cpu, pre_alloc_ram = pre
+        if nodes_lane_major:
+            pre_alloc_cpu = pre_alloc_cpu.T
+            pre_alloc_ram = pre_alloc_ram.T
         phase_v = jnp.where(early_snap[:, None], pre_phase, pods.phase)
         attempts_v = jnp.where(early_snap[:, None], pre_attempts, pods.attempts)
         alloc_cpu_v = jnp.where(
-            early_snap[:, None], pre_alloc_cpu, nodes.alloc_cpu
+            early_snap[:, None], pre_alloc_cpu, nodes_row.alloc_cpu
         )
         alloc_ram_v = jnp.where(
-            early_snap[:, None], pre_alloc_ram, nodes.alloc_ram
+            early_snap[:, None], pre_alloc_ram, nodes_row.alloc_ram
         )
     else:
         phase_v, attempts_v = pods.phase, pods.attempts
-        alloc_cpu_v, alloc_ram_v = nodes.alloc_cpu, nodes.alloc_ram
+        alloc_cpu_v, alloc_ram_v = nodes_row.alloc_cpu, nodes_row.alloc_ram
 
     in_cache = (phase_v == PHASE_UNSCHEDULABLE) | (
         (phase_v == PHASE_QUEUED) & (attempts_v >= 2)
@@ -1082,7 +1163,7 @@ def ca_pass(
     planned, planned_per_group, up_starved = jax.lax.cond(
         up_branch.any(),
         lambda: _ca_scale_up(
-            state, auto, st, up_branch, K_up, phase_v, attempts_v,
+            state_row, auto, st, up_branch, K_up, phase_v, attempts_v,
             use_pallas=use_pallas,
             pallas_interpret=pallas_interpret,
             pallas_mesh=pallas_mesh,
@@ -1099,12 +1180,13 @@ def ca_pass(
         # once everything scaled back down there is nothing to remove.
         down_branch.any() & (auto.ca_count.sum() > 0),
         lambda: _ca_scale_down(
-            state, auto, st, down_branch, K_sd,
+            state_row, auto, st, down_branch, K_sd,
             phase_v, alloc_cpu_v, alloc_ram_v, snap, interval,
             use_pallas=use_pallas,
             pallas_interpret=pallas_interpret,
             pallas_mesh=pallas_mesh,
             pallas_axis=pallas_axis,
+            descatter=descatter,
         ),
         lambda: (jnp.zeros((C, S), bool), jnp.zeros((C, Gn), jnp.int32)),
     )
@@ -1113,7 +1195,7 @@ def ca_pass(
     # effect-time value is one (C,) pair — scatter a boolean touch mask (fast
     # 32-bit path) and merge the pair elementwise.
     _, S = planned.shape
-    N = nodes.alive.shape[1]
+    N = nodes_row.alive.shape[1]
     rows = jnp.arange(C, dtype=jnp.int32)[:, None]
     tgt_create = jnp.where(planned, st.ca_slots, N)
     touch_create = (
@@ -1176,7 +1258,7 @@ def hpa_pass_donated(
     jax.jit,
     static_argnames=(
         "K_up", "K_sd", "use_pallas", "pallas_interpret", "pallas_mesh",
-        "pallas_axis",
+        "pallas_axis", "descatter",
     ),
     donate_argnums=(0,),
 )
@@ -1192,10 +1274,12 @@ def ca_pass_donated(
     pallas_interpret: bool = False,
     pallas_mesh=None,
     pallas_axis: str = "clusters",
+    descatter: bool = True,
 ) -> ClusterBatchState:
     state2, auto2 = ca_pass(
         state, state.auto, st, W, consts, K_up, K_sd, pre=pre,
         use_pallas=use_pallas, pallas_interpret=pallas_interpret,
         pallas_mesh=pallas_mesh, pallas_axis=pallas_axis,
+        descatter=descatter,
     )
     return state2._replace(auto=auto2)

@@ -40,6 +40,7 @@ from kubernetriks_tpu.batched.state import (
     TraceSlab,
     init_state,
     make_step_constants,
+    swap_node_layout,
     tree_copy,
 )
 from kubernetriks_tpu.batched.timerep import TPair, from_f64_np, to_f64
@@ -133,6 +134,9 @@ def _fused_chunk_slide_impl(
     hpa_seg=None,
     fault_params=None,
     name_ranks=None,
+    lane_major: bool = False,
+    window_razor: bool = True,
+    ca_descatter: bool = True,
     W: int = 0,
 ):
     """The composed path's steady-state MEGASTEP: one device program runs a
@@ -145,6 +149,12 @@ def _fused_chunk_slide_impl(
     possible; grow the window). Returns (state, new_pod_name_rank | None,
     shift)."""
     from kubernetriks_tpu.batched.step import _window_body
+
+    if lane_major:
+        # Hot node leaves flip to the kernels' (N, C) layout for the whole
+        # chunk+slide program; state at rest stays row-major
+        # (state.swap_node_layout). The slide itself is pod-side only.
+        state = swap_node_layout(state)
 
     def body(carry, w):
         new = _window_body(
@@ -167,10 +177,15 @@ def _fused_chunk_slide_impl(
             hpa_seg=hpa_seg,
             fault_params=fault_params,
             name_ranks=name_ranks,
+            lane_major=lane_major,
+            window_razor=window_razor,
+            ca_descatter=ca_descatter,
         )
         return new, None
 
     state, _ = jax.lax.scan(body, state, jnp.asarray(window_idxs, jnp.int32))
+    if lane_major:
+        state = swap_node_layout(state)
     base = jnp.asarray(base, jnp.int32)
     s0 = _slide_shift_core(state.pods.phase[:, :W], payload["create_win"], base)
     s = _quantize_shift_device(s0, W)
@@ -537,6 +552,9 @@ class BatchedSimulation:
         sanitize_mode: Optional[bool] = None,
         telemetry: Optional[bool] = None,
         telemetry_ring: int = 1024,
+        lane_major: Optional[bool] = None,
+        window_razor: Optional[bool] = None,
+        ca_descatter: Optional[bool] = None,
     ) -> None:
         self.config = config
         # Flight recorder (KTPU_TRACE / telemetry arg): host-side span
@@ -624,6 +642,46 @@ class BatchedSimulation:
         self._superspan_k = max(1, int(superspan_k))
         self._superspan_chunk = max(1, int(superspan_chunk))
         self._superspan_stage_cols = superspan_stage_cols
+        # Lane-major hot node state (KTPU_LANE_MAJOR / lane_major arg): the
+        # window programs carry state.NODE_HOT_LEAVES transposed (N, C) —
+        # the Pallas kernels' layout — killing the per-kernel-boundary
+        # transposes; state at rest stays row-major (conversion lives at
+        # the jit entries). Bit-identical either way
+        # (tests/test_layout_razor.py); default on for accelerator
+        # backends — on CPU XLA pays the layout copies anyway and the
+        # extra program variants would only double compile time, so tests
+        # opt in explicitly. Under a mesh the shard_map in_specs pin the
+        # row-major (C, ...) convention, so the mode is forced off.
+        if lane_major is not None:
+            self.lane_major = bool(lane_major)
+        else:
+            env = flag_tristate("KTPU_LANE_MAJOR")
+            self.lane_major = bool(
+                env if env is not None else jax.default_backend() != "cpu"
+            )
+        if mesh is not None:
+            self.lane_major = False
+        # Window-cost razor (KTPU_WINDOW_RAZOR / window_razor arg): gate
+        # the per-window resolution soup behind a cheap due-ness predicate
+        # (step._window_work_due) so empty windows in dense traces stop
+        # paying it. Tristate like lane_major: on for accelerator backends,
+        # off on CPU hosts (the cond adds compile to every window program
+        # there against a marginal measured win — BENCH_r07 A/B). CA
+        # de-scatter round 3 (KTPU_CA_DESCATTER / ca_descatter arg):
+        # combined segment-sum + grouping sort in the scale-down cond body
+        # — same program size, so default-on everywhere. All bit-exact.
+        if window_razor is not None:
+            self.window_razor = bool(window_razor)
+        else:
+            env = flag_tristate("KTPU_WINDOW_RAZOR")
+            self.window_razor = bool(
+                env if env is not None else jax.default_backend() != "cpu"
+            )
+        self.ca_descatter = (
+            bool(ca_descatter)
+            if ca_descatter is not None
+            else flag_bool("KTPU_CA_DESCATTER")
+        )
         # (lo, RefillStage) staging buffers for the superspan executor when
         # the whole-trace payload exceeds the device budget: the stage the
         # next dispatch reads, and the double-buffered successor assembled
@@ -1302,6 +1360,9 @@ class BatchedSimulation:
             hpa_seg=self._hpa_seg,
             fault_params=self.fault_params,
             name_ranks=self._fault_name_ranks,
+            lane_major=self.lane_major,
+            window_razor=self.window_razor,
+            ca_descatter=self.ca_descatter,
         )
 
     def _dispatch_windows(self, idxs: np.ndarray, fuse_slide: bool = False) -> None:
@@ -2388,6 +2449,9 @@ class BatchedSimulation:
             hpa_seg=self._hpa_seg,
             fault_params=self.fault_params,
             name_ranks=self._fault_name_ranks,
+            lane_major=self.lane_major,
+            window_razor=self.window_razor,
+            ca_descatter=self.ca_descatter,
         )
         if self.collect_gauges:
             from kubernetriks_tpu.batched.step import gauge_snapshot
@@ -2640,6 +2704,30 @@ class BatchedSimulation:
         misses = rep["counters"].get("stage_prefetch_miss", 0)
         if hits + misses:
             rep["stage_prefetch_hit_rate"] = hits / (hits + misses)
+        # Per-window cost line: the window-program DISPATCH phases plus the
+        # blocking readback WAITS (progress_wait / shift_wait), divided by
+        # the windows the device ring recorded. Dispatch is asynchronous,
+        # so execution time surfaces in the waits — dispatch + wait
+        # together bound compile + device time per window (on a warm jit
+        # cache the wait share IS the device-execution proxy). THE
+        # observable the lane-major / razor / de-scatter A/Bs are sized
+        # with — bench.py --smoke --trace asserts it, so a layout
+        # regression moves a number CPU CI sees.
+        from kubernetriks_tpu.telemetry.tracer import PHASE_NAMES as _PN
+
+        window_phases = (
+            _PN[PH_WINDOW_CHUNK],
+            _PN[PH_FUSED_CHUNK_SLIDE],
+            _PN[PH_SUPERSPAN],
+            _PN[PH_PROGRESS_WAIT],
+            _PN[PH_SHIFT_WAIT],
+            "chunk_fenced",
+        )
+        win_ms = sum(
+            rep["spans"][p]["total_ms"]
+            for p in window_phases
+            if p in rep.get("spans", {})
+        )
         if self.state.telemetry is not None:
             from kubernetriks_tpu.telemetry import ring as dring
 
@@ -2654,6 +2742,13 @@ class BatchedSimulation:
                     if col > 0
                 },
             }
+            windows = int(self._ring_windows_recorded)
+            if windows > 0:
+                rep["per_window"] = {
+                    "windows": windows,
+                    "window_program_ms_total": win_ms,
+                    "ms_per_window": win_ms / windows,
+                }
         return rep
 
     def write_chrome_trace(self, path: str) -> str:

@@ -463,6 +463,48 @@ def init_state(
     )
 
 
+# --- lane-major hot node state -----------------------------------------------
+# The Pallas kernels all consume node-shaped operands TRANSPOSED (clusters on
+# the 128-wide lane axis, node slots on sublanes — ops/scheduler_kernel.py's
+# one-layout rule), while the XLA glue historically worked row-major (C, N):
+# every kernel boundary then materializes a transposed copy (pallas_call pins
+# default layouts on operands — measured ~1.2 ms/window of marshalling at the
+# composed shape, docs/DESIGN.md window-cost anatomy). Lane-major mode
+# (KTPU_LANE_MAJOR / engine lane_major=) carries the HOT node leaves below
+# transposed (N, C) across the whole window program: the wrappers skip their
+# node-side transposes, the elementwise soup runs layout-agnostic on the
+# kernel layout, and conversion happens ONCE per dispatch at the jit entry /
+# exit (step.run_windows & friends), not per kernel boundary.
+#
+# Scope: exactly these NodeArrays leaves. The pending-effect pairs
+# (create_time / remove_time) stay row-major — they are written by the CA
+# pass's (C, N)-oriented scatters and read a handful of times per window —
+# and the pod axis stays row-major everywhere (its sorts / rank builders /
+# candidate gathers are row-major-shaped throughout step.py; see ROADMAP).
+# At rest (engine.state between dispatches, checkpoints, readout) state is
+# ALWAYS row-major; lane-major layout exists only inside compiled programs.
+NODE_HOT_LEAVES = (
+    "alive",
+    "cap_cpu",
+    "cap_ram",
+    "alloc_cpu",
+    "alloc_ram",
+    "crash_downtime",
+)
+
+
+def swap_node_layout(state: "ClusterBatchState") -> "ClusterBatchState":
+    """Transpose the hot node leaves between row-major (C, N) and lane-major
+    (N, C). Self-inverse; everything else (pods, metrics, pending-effect
+    pairs, auto, telemetry) is untouched. Exact — a transpose moves bits."""
+    nodes = state.nodes
+    return state._replace(
+        nodes=nodes._replace(
+            **{name: getattr(nodes, name).T for name in NODE_HOT_LEAVES}
+        )
+    )
+
+
 @jax.jit
 def tree_copy(tree):
     """Fresh device buffers carrying the inputs' shardings (jit outputs

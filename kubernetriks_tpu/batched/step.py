@@ -62,6 +62,7 @@ from kubernetriks_tpu.batched.state import (
     PHASE_UNSCHEDULABLE,
     StepConstants,
     TraceSlab,
+    swap_node_layout,
 )
 from kubernetriks_tpu.batched.timerep import (
     TPair,
@@ -139,8 +140,10 @@ def _shard_rowwise(core, n_in: int, n_out: int, mesh, axis: str):
     are needed)."""
     from jax.sharding import PartitionSpec
 
+    from kubernetriks_tpu.parallel.multihost import shard_map
+
     row = PartitionSpec(axis, None)
-    return jax.shard_map(
+    return shard_map(
         core,
         mesh=mesh,
         in_specs=(row,) * n_in,
@@ -148,6 +151,52 @@ def _shard_rowwise(core, n_in: int, n_out: int, mesh, axis: str):
         out_specs=(row,) * n_out if n_out > 1 else row,
         check_vma=False,
     )
+
+
+def _window_work_due(
+    state: ClusterBatchState, slab: TraceSlab, W: jnp.ndarray
+) -> jnp.ndarray:
+    """Scalar bool: could _apply_window_events_work change ANY state leaf at
+    window W? The window-cost razor's due-ness predicate — a handful of
+    cheap compares + reductions against the ~35 masked elementwise passes
+    of the resolution soup. CONSERVATIVE by construction (true whenever any
+    trigger below could fire; running the soup needlessly is always exact):
+
+    - a due trace event (the chunk loop's own entry condition);
+    - a pending autoscaler/chaos effect due: CA node create/remove, HPA pod
+      removal (win < W exactly, the soup's own due tests minus the ~alive /
+      phase refinements — supersets, so never missed);
+    - a running pod's finish due by the window end. With none of the other
+      triggers firing, every interrupt source is +inf, so the soup's cutoff
+      is exactly the window-end pair this predicate compares against.
+
+    When false, the soup is the identity on everything except
+    time = max(time, W) (metric folds add masked zeros, estimator min/max
+    merge against +/-inf identities, requeue_signal ors False) — the skip
+    branch replicates exactly that. Layout-agnostic: only row-major leaves
+    (pending pairs, pod arrays) and the slab are read."""
+    C = state.time.shape[0]
+    E_total = slab.packed.shape[1]
+    rows1 = jnp.arange(C, dtype=jnp.int32)
+    cursor = jnp.clip(state.event_cursor, 0, E_total - 1)
+    ev_due = (
+        (state.event_cursor < E_total) & (slab.packed[rows1, cursor, 0] < W)
+    ).any()
+    pend_due = (
+        (state.nodes.create_time.win < W[:, None]).any()
+        | (state.nodes.remove_time.win < W[:, None]).any()
+        | (state.pods.removal_time.win < W[:, None]).any()
+    )
+    P = state.pods.phase.shape[1]
+    window_end = TPair(
+        win=jnp.broadcast_to(W[:, None], (C, P)),
+        off=jnp.zeros((C, P), jnp.float32),
+    )
+    fin_due = (
+        (state.pods.phase == PHASE_RUNNING)
+        & t_le(state.pods.finish_time, window_end)
+    ).any()
+    return ev_due | pend_due | fin_due
 
 
 def _apply_window_events(
@@ -165,9 +214,88 @@ def _apply_window_events(
     node_name_rank=None,
     pod_name_rank=None,
     fault_params=None,
+    lane_major: bool = False,
+    window_razor: bool = True,
+):
+    """Event application + finish resolution, behind the window-cost razor
+    (KTPU_WINDOW_RAZOR): when the due-ness predicate proves the window has
+    no resolution work, the whole soup is skipped via lax.cond — empty and
+    near-empty windows in dense traces stop paying the ~35 masked
+    elementwise passes (fast-forward only helps when WHOLE spans are empty;
+    this gates per window inside dense spans). Bit-exact: the skip branch
+    fires only when the soup is provably the identity (see
+    _window_work_due). window_razor=False keeps the always-run path for
+    A/B measurement."""
+    args = (
+        consts,
+        max_events_per_window,
+        conditional_move,
+        use_pallas,
+        pallas_interpret,
+        pallas_mesh,
+        pallas_axis,
+        use_pallas_select,
+        node_name_rank,
+        pod_name_rank,
+        fault_params,
+        lane_major,
+    )
+    if not window_razor:
+        return _apply_window_events_work(state, slab, W, *args)
+
+    def run(st):
+        return _apply_window_events_work(st, slab, W, *args)
+
+    def skip(st):
+        if conditional_move:
+            C, P = st.pods.phase.shape
+            N = (
+                st.nodes.cap_cpu.shape[0]
+                if lane_major
+                else st.nodes.cap_cpu.shape[1]
+            )
+            f32inf = jnp.float32(INF)
+            wake = WakeEvents(
+                node_mask=jnp.zeros((C, N), bool),
+                node_rel=jnp.full((C, N), f32inf, jnp.float32),
+                freed_mask=jnp.zeros((C, P), bool),
+                freed_rel=jnp.full((C, P), f32inf, jnp.float32),
+            )
+        else:
+            wake = None
+        return st._replace(time=jnp.maximum(st.time, W)), wake
+
+    return jax.lax.cond(_window_work_due(state, slab, W), run, skip, state)
+
+
+def _apply_window_events_work(
+    state: ClusterBatchState,
+    slab: TraceSlab,
+    W: jnp.ndarray,
+    consts: StepConstants,
+    max_events_per_window: int,
+    conditional_move: bool = False,
+    use_pallas: bool = False,
+    pallas_interpret: bool = False,
+    pallas_mesh=None,
+    pallas_axis: str = "clusters",
+    use_pallas_select: bool = False,
+    node_name_rank=None,
+    pod_name_rank=None,
+    fault_params=None,
+    lane_major: bool = False,
 ) -> ClusterBatchState:
     """Apply every trace event with effect time STRICTLY before the cycle time
     W * interval, and resolve all pod finishes due in the window.
+
+    lane_major (KTPU_LANE_MAJOR): the hot node leaves
+    (state.NODE_HOT_LEAVES) and every node-shaped accumulator in this
+    function are carried TRANSPOSED (N, C) — the Pallas kernels' layout —
+    so the event/free kernel boundaries stop materializing transposed
+    copies. Pod arrays, the pending-effect pairs and WakeEvents keep the
+    row-major convention (their producers/consumers are row-major-shaped
+    sorts/gathers); the handful of row-major pending-effect masks that
+    merge into lane-major accumulators transpose exactly once below.
 
     fault_params (chaos.FaultParams, static): with node_faults, the slab may
     carry EV_NODE_CRASH (remove semantics + crash/downtime accounting; a
@@ -189,7 +317,11 @@ def _apply_window_events(
     """
     pods, nodes, metrics = state.pods, state.nodes, state.metrics
     C, P = pods.phase.shape
-    N = nodes.alive.shape[1]
+    N = nodes.alive.shape[0] if lane_major else nodes.alive.shape[1]
+    # Node-shaped accumulators follow the hot leaves' layout: (N, C) lane
+    # major, (C, N) row major. n_sum_ax reduces them to (C,).
+    n_shape = (N, C) if lane_major else (C, N)
+    n_sum_ax = 0 if lane_major else 1
     E_total = slab.packed.shape[1]
     E = max_events_per_window
     interval = jnp.float32(consts.scheduling_interval)
@@ -219,7 +351,11 @@ def _apply_window_events(
         and not node_faults
     )
     if use_event_kernel:
-        event_core = partial(fused_event_scatter, interpret=pallas_interpret)
+        event_core = partial(
+            fused_event_scatter,
+            interpret=pallas_interpret,
+            nodes_lane_major=lane_major,
+        )
         if pallas_mesh is not None:
             event_core = _shard_rowwise(event_core, 10, 5, pallas_mesh, pallas_axis)
 
@@ -308,13 +444,27 @@ def _apply_window_events(
                 )
             )
         else:
-            # Scatter helpers: out-of-range slot drops the write.
+            # Scatter helpers: out-of-range slot drops the write. Node
+            # accumulators are lane-major under lane_major — the scatter
+            # indices swap axes ((slot, cluster) pairs), same index count.
             def drop_slot(mask, width):
                 return jnp.where(mask, ev_s, width)
 
-            created = created.at[rows, drop_slot(is_cn, N)].set(True, mode="drop")
-            node_removal = node_removal.at[rows, drop_slot(is_rn, N)].min(
-                jnp.where(is_rn, ev_rel, f32inf), mode="drop"
+            def n_scatter(acc, mask, op, values=None):
+                idx = (
+                    (drop_slot(mask, N), rows)
+                    if lane_major
+                    else (rows, drop_slot(mask, N))
+                )
+                ref = acc.at[idx[0], idx[1]]
+                if values is None:
+                    return ref.set(True, mode="drop")
+                return getattr(ref, op)(values, mode="drop")
+
+            created = n_scatter(created, is_cn, "set")
+            node_removal = n_scatter(
+                node_removal, is_rn, "min",
+                jnp.where(is_rn, ev_rel, f32inf),
             )
             pod_create = pod_create.at[rows, drop_slot(is_cp, P)].min(
                 jnp.where(is_cp, ev_rel, f32inf), mode="drop"
@@ -339,13 +489,15 @@ def _apply_window_events(
             # on_add_node_to_cache runs once PER node at its visibility
             # time; _conditional_wake_exact). Only built on the
             # conditional-move path — an extra (C, N) scatter otherwise.
-            node_create_rel = node_create_rel.at[
-                rows, jnp.where(is_cn, ev_s, N)
-            ].min(jnp.where(is_cn, ev_rel, f32inf), mode="drop")
+            node_create_rel = n_scatter_min(
+                node_create_rel, is_cn, ev_s,
+                jnp.where(is_cn, ev_rel, f32inf),
+            )
             out = out + (node_create_rel,)
         if node_faults:
-            crash_rm = crash_rm.at[rows, jnp.where(is_crash, ev_s, N)].min(
-                jnp.where(is_crash, ev_rel, f32inf), mode="drop"
+            crash_rm = n_scatter_min(
+                crash_rm, is_crash, ev_s,
+                jnp.where(is_crash, ev_rel, f32inf),
             )
             out = out + (
                 crash_rm,
@@ -353,20 +505,26 @@ def _apply_window_events(
             )
         return out
 
+    def n_scatter_min(acc, mask, ev_s, values):
+        tgt = jnp.where(mask, ev_s, N)
+        if lane_major:
+            return acc.at[tgt, rows].min(values, mode="drop")
+        return acc.at[rows, tgt].min(values, mode="drop")
+
     carry0 = (
         state.event_cursor,
-        jnp.zeros((C, N), bool),
-        jnp.full((C, N), INF, jnp.float32),
+        jnp.zeros(n_shape, bool),
+        jnp.full(n_shape, INF, jnp.float32),
         jnp.full((C, P), INF, jnp.float32),
         jnp.zeros((C, P), jnp.int32),
         jnp.full((C, P), INF, jnp.float32),
         jnp.zeros((C,), jnp.int32),
     )
     if conditional_move:
-        carry0 = carry0 + (jnp.full((C, N), INF, jnp.float32),)
+        carry0 = carry0 + (jnp.full(n_shape, INF, jnp.float32),)
     if node_faults:
         carry0 = carry0 + (
-            jnp.full((C, N), INF, jnp.float32),
+            jnp.full(n_shape, INF, jnp.float32),
             jnp.zeros((C,), jnp.int32),
         )
     carry_out = jax.lax.while_loop(chunk_cond, chunk_body, carry0)
@@ -382,34 +540,50 @@ def _apply_window_events(
         crashed_now = crash_rm < f32inf
         metrics = metrics._replace(
             node_crashes=metrics.node_crashes
-            + crashed_now.sum(axis=1, dtype=jnp.int32),
+            + crashed_now.sum(axis=n_sum_ax, dtype=jnp.int32),
             node_recoveries=metrics.node_recoveries + n_recover,
             # Downtime = the crash's pre-sampled repair span (each slot
             # crashes at most once; recovery opens a fresh slot).
+            # crash_downtime is a hot leaf, so it shares crashed_now's
+            # layout either way.
             node_downtime_s=metrics.node_downtime_s
-            + jnp.where(crashed_now, nodes.crash_downtime, 0.0).sum(axis=1),
+            + jnp.where(crashed_now, nodes.crash_downtime, 0.0).sum(
+                axis=n_sum_ax
+            ),
         )
         node_removal = jnp.minimum(node_removal, crash_rm)
 
+    def to_nmaj(x):
+        """Row-major (C, N) mask/value -> the node accumulators' layout."""
+        return x.T if lane_major else x
+
     # Pending autoscaler creations due this window (CA scale-up effects).
-    pend_create = (nodes.create_time.win < W[:, None]) & ~nodes.alive
-    created = created | pend_create
+    # The pending pairs stay row-major (see state.NODE_HOT_LEAVES): their
+    # masks/values compute row-major — where the t_where writebacks need
+    # them — and transpose once to merge with the lane-major accumulators.
+    alive_row = nodes.alive.T if lane_major else nodes.alive
+    pend_create_row = (nodes.create_time.win < W[:, None]) & ~alive_row
+    created = created | to_nmaj(pend_create_row)
     if conditional_move:
         node_create_rel = jnp.minimum(
             node_create_rel,
-            jnp.where(
-                pend_create,
-                _rel_seconds(nodes.create_time, base[:, None], interval),
-                f32inf,
+            to_nmaj(
+                jnp.where(
+                    pend_create_row,
+                    _rel_seconds(nodes.create_time, base[:, None], interval),
+                    f32inf,
+                )
             ),
         )
-    node_create_time = t_where(pend_create, t_inf((C, N)), nodes.create_time)
+    node_create_time = t_where(
+        pend_create_row, t_inf((C, N)), nodes.create_time
+    )
     # Pending autoscaler removals due this window (CA scale-down effects).
     pend_rm_due = nodes.remove_time.win < W[:, None]
     pend_remove = jnp.where(
         pend_rm_due, _rel_seconds(nodes.remove_time, base[:, None], interval), f32inf
     )
-    node_removal = jnp.minimum(node_removal, pend_remove)
+    node_removal = jnp.minimum(node_removal, to_nmaj(pend_remove))
     node_remove_time = t_where(pend_rm_due, t_inf((C, N)), nodes.remove_time)
     # Pending HPA scale-down removals due this window.
     pend_prm_due = pods.removal_time.win < W[:, None]
@@ -442,13 +616,22 @@ def _apply_window_events(
     # --- resolve running pods: finish vs node removal vs pod removal --------
     running = phase == PHASE_RUNNING
     node_idx = jnp.clip(pods.node, 0, None)
+
+    def n_gather(acc):
+        """(C, P) per-pod gather from a node-layout accumulator: result
+        [c, p] = acc[node_idx[c, p]] of cluster c — index pairs swap axes
+        under lane-major, same index count."""
+        if lane_major:
+            return acc[node_idx, rows]
+        return acc[rows, node_idx]
+
     # The per-pod node-removal gather is a (C, P)-indexed op — one of the two
     # most expensive ops in the step — and most windows remove no node at
     # all; branch around it (the predicate reduction is replicated, so the
     # cond also holds under a C-sharded mesh).
     pod_node_removal = jax.lax.cond(
         (node_removal < f32inf).any(),
-        lambda: jnp.where(pods.node >= 0, node_removal[rows, node_idx], f32inf),
+        lambda: jnp.where(pods.node >= 0, n_gather(node_removal), f32inf),
         lambda: jnp.full((C, P), INF, jnp.float32),
     )
     # Earliest interruption of this pod in rel-seconds; +inf = none.
@@ -484,9 +667,7 @@ def _apply_window_events(
         # crash, matching the scalar chain where the crash IS the removal).
         pod_crash_rm = jax.lax.cond(
             crashed_now.any(),
-            lambda: jnp.where(
-                pods.node >= 0, crash_rm[rows, node_idx], f32inf
-            ),
+            lambda: jnp.where(pods.node >= 0, n_gather(crash_rm), f32inf),
             lambda: jnp.full((C, P), INF, jnp.float32),
         )
         crash_caused = rescheds & (pod_crash_rm <= pod_node_removal)
@@ -514,7 +695,11 @@ def _apply_window_events(
     duration_s = t_seconds_f32(pods.duration, interval)
     dur_stats = None
     if use_pallas and use_pallas_select and free_kernel_fits(N, P):
-        core = partial(fused_free_resources, interpret=pallas_interpret)
+        core = partial(
+            fused_free_resources,
+            interpret=pallas_interpret,
+            nodes_lane_major=lane_major,
+        )
         if pallas_mesh is not None:
             core = _shard_rowwise(core, 8, 3, pallas_mesh, pallas_axis)
         # The kernel also folds the finished pods' duration-estimator
@@ -535,12 +720,14 @@ def _apply_window_events(
             _, idx = jax.lax.top_k(pending.astype(jnp.int32), F)
             fv = pending[rows, idx]
             tgt = jnp.where(fv, node_idx[rows, idx], N)
-            acpu = acpu.at[rows, tgt].add(
-                jnp.where(fv, pods.req_cpu[rows, idx], 0), mode="drop"
-            )
-            aram = aram.at[rows, tgt].add(
-                jnp.where(fv, pods.req_ram[rows, idx], 0), mode="drop"
-            )
+            add_cpu = jnp.where(fv, pods.req_cpu[rows, idx], 0)
+            add_ram = jnp.where(fv, pods.req_ram[rows, idx], 0)
+            if lane_major:
+                acpu = acpu.at[tgt, rows].add(add_cpu, mode="drop")
+                aram = aram.at[tgt, rows].add(add_ram, mode="drop")
+            else:
+                acpu = acpu.at[rows, tgt].add(add_cpu, mode="drop")
+                aram = aram.at[rows, tgt].add(add_ram, mode="drop")
             pending = pending.at[rows, jnp.where(fv, idx, P)].set(False, mode="drop")
             return (pending, acpu, aram)
 
@@ -568,7 +755,8 @@ def _apply_window_events(
         pods_succeeded=metrics.pods_succeeded + n_done,
         terminated_pods=metrics.terminated_pods + n_done,
         pod_duration=pod_duration_est,
-        processed_nodes=metrics.processed_nodes + created.sum(axis=1, dtype=jnp.int32),
+        processed_nodes=metrics.processed_nodes
+        + created.sum(axis=n_sum_ax, dtype=jnp.int32),
     )
     phase = jnp.where(real_fin, PHASE_SUCCEEDED, phase)
     finish_time = t_where(finishes, t_inf((C, P)), pods.finish_time)
@@ -718,7 +906,7 @@ def _apply_window_events(
     # alive only via pods.node indices, which is removal-independent).
     alive = alive & ~(node_removal < f32inf)
 
-    any_created_node = created.any(axis=1)
+    any_created_node = created.any(axis=n_sum_ax)
     any_freed = (n_done > 0) | (n_removed_running > 0)
     if pod_faults:
         # Failing attempts free their resources too (scalar: the failure
@@ -732,9 +920,13 @@ def _apply_window_events(
     # creation, scheduler.rs:393), a finished/removed pod its freed requests
     # (scheduler.rs:366-380). Only built on the conditional-move path.
     if conditional_move:
+        node_rel = jnp.where(created, node_create_rel, f32inf)
         wake_events = WakeEvents(
-            node_mask=created,
-            node_rel=jnp.where(created, node_create_rel, f32inf),
+            # WakeEvents is row-major by contract (its consumer concatenates
+            # the node and pod axes); transpose the lane-major accumulators
+            # once here — conditional-move runs only.
+            node_mask=created.T if lane_major else created,
+            node_rel=node_rel.T if lane_major else node_rel,
             freed_mask=freed,
             freed_rel=jnp.where(
                 finishes,
@@ -795,6 +987,7 @@ def _conditional_wake_exact(
     pods,
     stale: jnp.ndarray,
     wake: "WakeEvents",
+    lane_major: bool = False,
 ) -> jnp.ndarray:
     """Resource-aware unschedulable wakes for
     enable_unscheduled_pods_conditional_move, replicating the reference's
@@ -844,12 +1037,10 @@ def _conditional_wake_exact(
     ev_is_node = jnp.concatenate(
         [jnp.ones((C, N), bool), jnp.zeros((C, P), bool)], axis=1
     )
-    ev_cpu = jnp.concatenate(
-        [state.nodes.cap_cpu, pods.req_cpu], axis=1
-    )
-    ev_ram = jnp.concatenate(
-        [state.nodes.cap_ram, pods.req_ram], axis=1
-    )
+    cap_cpu = state.nodes.cap_cpu.T if lane_major else state.nodes.cap_cpu
+    cap_ram = state.nodes.cap_ram.T if lane_major else state.nodes.cap_ram
+    ev_cpu = jnp.concatenate([cap_cpu, pods.req_cpu], axis=1)
+    ev_ram = jnp.concatenate([cap_ram, pods.req_ram], axis=1)
     key = jnp.where(ev_valid, ev_rel, f32inf)
     _, s_valid, s_is_node, s_cpu, s_ram = jax.lax.sort(
         (key, ev_valid, ev_is_node, ev_cpu, ev_ram),
@@ -946,6 +1137,7 @@ def prepare_queue(
     consts: StepConstants,
     conditional_move: bool = False,
     wake=None,
+    lane_major: bool = False,
 ):
     """Queue preamble shared by every cycle path (sorted-scan, Pallas
     candidate kernel, Pallas selection kernel, RL): unschedulable wake/flush
@@ -981,7 +1173,9 @@ def prepare_queue(
             assert wake is not None, (
                 "conditional_move prepare needs this window's WakeEvents"
             )
-            moves = _conditional_wake_exact(state, pods, stale, wake)
+            moves = _conditional_wake_exact(
+                state, pods, stale, wake, lane_major=lane_major
+            )
         else:
             moves = state.requeue_signal[:, None] & (
                 pods.phase == PHASE_UNSCHEDULABLE
@@ -1043,13 +1237,14 @@ def prepare_cycle(
     K: int,
     conditional_move: bool = False,
     wake=None,
+    lane_major: bool = False,
 ) -> CycleCandidates:
     """prepare_queue + queue sort + top-K compaction. W: (C,) int32 window
     index (cycle time T = W * interval)."""
     C, P = state.pods.phase.shape
     rows = jnp.arange(C, dtype=jnp.int32)[:, None]
     pods, last_flush_win, eligible = prepare_queue(
-        state, W, consts, conditional_move, wake
+        state, W, consts, conditional_move, wake, lane_major=lane_major
     )
 
     # Queue order: (queue_ts, queue_seq).
@@ -1263,6 +1458,7 @@ def _run_scheduling_cycle(
     wake=None,
     use_megakernel: bool = True,
     fault_params=None,
+    lane_major: bool = False,
 ) -> ClusterBatchState:
     """One vectorized kube-scheduler cycle at window W for every cluster
     (scalar equivalent: reference scheduler.rs:246-333).
@@ -1271,12 +1467,22 @@ def _run_scheduling_cycle(
     lax.cond (predicate: no eligible/parked pod, no wake signal) is exact,
     but measured SLOWER end-to-end — on TPU the cond materializes the full
     state carry through both branches, costing more than the skipped sort.
+
+    lane_major: the hot node leaves are (N, C) — the Pallas wrappers
+    consume/return them without transposes (nodes_lane_major); the lax.scan
+    fallback converts at its branch boundary (CPU-parity path only).
     """
     C, P = state.pods.phase.shape
-    N = state.nodes.alive.shape[1]
+    N = (
+        state.nodes.alive.shape[0]
+        if lane_major
+        else state.nodes.alive.shape[1]
+    )
 
     alive = state.nodes.alive
-    alive_count = alive.sum(axis=1, dtype=jnp.int32).astype(jnp.float32)
+    alive_count = alive.sum(
+        axis=0 if lane_major else 1, dtype=jnp.int32
+    ).astype(jnp.float32)
     pod_sched_time = jnp.float32(consts.time_per_node) * alive_count  # (C,)
 
     if use_pallas and use_pallas_select and use_megakernel:
@@ -1292,7 +1498,7 @@ def _run_scheduling_cycle(
         )
 
         pods, last_flush_win, eligible = prepare_queue(
-            state, W, consts, conditional_move, wake
+            state, W, consts, conditional_move, wake, lane_major=lane_major
         )
         interval = jnp.float32(consts.scheduling_interval)
         K = max_pods_per_cycle
@@ -1309,6 +1515,7 @@ def _run_scheduling_cycle(
             fused_select_cycle_commit,
             k_pods=K,
             interpret=pallas_interpret,
+            nodes_lane_major=lane_major,
         )
         if pallas_mesh is not None:
             core = _shard_rowwise(core, 15, 7, pallas_mesh, pallas_axis)
@@ -1371,12 +1578,13 @@ def _run_scheduling_cycle(
         )
 
         pods, last_flush_win, eligible = prepare_queue(
-            state, W, consts, conditional_move, wake
+            state, W, consts, conditional_move, wake, lane_major=lane_major
         )
         core = partial(
             fused_select_schedule_cycle,
             k_pods=max_pods_per_cycle,
             interpret=pallas_interpret,
+            nodes_lane_major=lane_major,
         )
         if pallas_mesh is not None:
             core = _shard_rowwise(core, 9, 7, pallas_mesh, pallas_axis)
@@ -1396,14 +1604,21 @@ def _run_scheduling_cycle(
         )
         park_k = cand_valid & ~fitany_k
     elif use_pallas:
-        cc = prepare_cycle(state, W, consts, max_pods_per_cycle, conditional_move, wake)
+        cc = prepare_cycle(
+            state, W, consts, max_pods_per_cycle, conditional_move, wake,
+            lane_major=lane_major,
+        )
         cand_valid, cand_req_cpu, cand_req_ram = cc.valid, cc.req_cpu, cc.req_ram
         # The (C, N)-heavy core runs as a fused VMEM kernel; the (C,)-shaped
         # timing/metric mechanics below replicate the scan path's float-op
         # ordering exactly (see ops/scheduler_kernel.py).
         from kubernetriks_tpu.ops.scheduler_kernel import fused_schedule_cycle
 
-        core = partial(fused_schedule_cycle, interpret=pallas_interpret)
+        core = partial(
+            fused_schedule_cycle,
+            interpret=pallas_interpret,
+            nodes_lane_major=lane_major,
+        )
         if pallas_mesh is not None:
             core = _shard_rowwise(core, 6, 5, pallas_mesh, pallas_axis)
         assign_k, fitany_k, best_k, alloc_cpu, alloc_ram = core(
@@ -1416,8 +1631,18 @@ def _run_scheduling_cycle(
         )
         park_k = cand_valid & ~fitany_k
     else:
-        cc = prepare_cycle(state, W, consts, max_pods_per_cycle, conditional_move, wake)
+        cc = prepare_cycle(
+            state, W, consts, max_pods_per_cycle, conditional_move, wake,
+            lane_major=lane_major,
+        )
         cand_valid, cand_req_cpu, cand_req_ram = cc.valid, cc.req_cpu, cc.req_ram
+        # The scan fallback's body is (C, N)-row-major-shaped (per-row
+        # scatter-adds, axis-1 argmax); under lane-major state it converts
+        # at this branch boundary — the CPU-parity path, where XLA pays
+        # layout copies either way.
+        alive_x = alive.T if lane_major else alive
+        acpu0 = state.nodes.alloc_cpu.T if lane_major else state.nodes.alloc_cpu
+        aram0 = state.nodes.alloc_ram.T if lane_major else state.nodes.alloc_ram
 
         def body(carry, xs):
             alloc_cpu, alloc_ram = carry
@@ -1429,7 +1654,7 @@ def _run_scheduling_cycle(
             # argmax tie-breaks between near-equal node scores, which the
             # cross-path equivalence tests cover.
             fit = (
-                alive
+                alive_x
                 & (req_cpu[:, None] <= alloc_cpu)
                 & (req_ram[:, None] <= alloc_ram)
             )
@@ -1460,10 +1685,10 @@ def _run_scheduling_cycle(
             return (alloc_cpu, alloc_ram), (assign, park, best)
 
         xs = (cand_valid.T, cand_req_cpu.T, cand_req_ram.T)
-        (alloc_cpu, alloc_ram), outs = jax.lax.scan(
-            body, (state.nodes.alloc_cpu, state.nodes.alloc_ram), xs
-        )
+        (alloc_cpu, alloc_ram), outs = jax.lax.scan(body, (acpu0, aram0), xs)
         assign_k, park_k, best_k = (o.T for o in outs)
+        if lane_major:
+            alloc_cpu, alloc_ram = alloc_cpu.T, alloc_ram.T
 
     # Timing/metric mechanics: vectorized and shared by ALL THREE paths above
     # (and the RL path), so the decision cores stay the only divergence.
@@ -1484,7 +1709,9 @@ def _run_scheduling_cycle(
     )
 
 
-def _telemetry_record(state: ClusterBatchState, m0, W: jnp.ndarray):
+def _telemetry_record(
+    state: ClusterBatchState, m0, W: jnp.ndarray, lane_major: bool = False
+):
     """Fold one per-window record row into the device telemetry ring:
     metric-counter deltas vs the window's incoming metrics `m0` plus queue
     depths / alive-node counts read straight off the post-window state.
@@ -1501,7 +1728,7 @@ def _telemetry_record(state: ClusterBatchState, m0, W: jnp.ndarray):
     pods, nodes = state.pods, state.nodes
     queued = (pods.phase == PHASE_QUEUED).sum(axis=1, dtype=jnp.int32)
     unsched = (pods.phase == PHASE_UNSCHEDULABLE).sum(axis=1, dtype=jnp.int32)
-    alive = nodes.alive.sum(axis=1, dtype=jnp.int32)
+    alive = nodes.alive.sum(axis=0 if lane_major else 1, dtype=jnp.int32)
     hpa = (m1.scaled_up_pods - m0.scaled_up_pods) + (
         m1.scaled_down_pods - m0.scaled_down_pods
     )
@@ -1554,6 +1781,9 @@ def _window_body(
     hpa_seg=None,
     fault_params=None,
     name_ranks=None,
+    lane_major: bool = False,
+    window_razor: bool = True,
+    ca_descatter: bool = True,
 ) -> ClusterBatchState:
     W = jnp.broadcast_to(jnp.asarray(W, jnp.int32), state.time.shape)
     # Telemetry ring (flight recorder): the window's incoming metric
@@ -1586,6 +1816,8 @@ def _window_body(
         node_name_rank=node_name_rank,
         pod_name_rank=pod_name_rank,
         fault_params=fault_params,
+        lane_major=lane_major,
+        window_razor=window_razor,
     )
     # Pre-cycle shadows for the CA's early-snapshot case (a CA storage
     # snapshot landing before this window's commit-visibility time must not
@@ -1610,6 +1842,7 @@ def _window_body(
         wake=wake,
         use_megakernel=use_megakernel,
         fault_params=fault_params,
+        lane_major=lane_major,
     )
     if autoscale_statics is not None:
         # Autoscaler ticks due by this window run after the scheduling cycle
@@ -1639,19 +1872,27 @@ def _window_body(
             pallas_interpret=pallas_interpret,
             pallas_mesh=pallas_mesh,
             pallas_axis=pallas_axis,
+            nodes_lane_major=lane_major,
+            descatter=ca_descatter,
         )
         state = state._replace(auto=auto)
     if state.telemetry is not None:
-        state = state._replace(telemetry=_telemetry_record(state, m0, W))
+        state = state._replace(
+            telemetry=_telemetry_record(state, m0, W, lane_major=lane_major)
+        )
     return state
 
 
-def gauge_snapshot(state: ClusterBatchState) -> jnp.ndarray:
+def gauge_snapshot(
+    state: ClusterBatchState, lane_major: bool = False
+) -> jnp.ndarray:
     """(C, 7) on-device gauge readings after a window: current nodes/pods,
     scheduling-queue length, node-average and cluster-total cpu/ram
     utilization (scalar equivalents: GaugeMetrics fields fed from
     collect_utilizations, reference: src/metrics/collector.rs:166-192,
     352-390). Utilization = requests / capacity over alive nodes."""
+    if lane_major:
+        state = swap_node_layout(state)
     nodes, pods = state.nodes, state.pods
     alive = nodes.alive
     alive_f = alive.astype(jnp.float32)
@@ -1705,6 +1946,13 @@ _STEP_STATICS = (
     # chaos.FaultParams (hashable NamedTuple of scalars) or None; None
     # compiles programs textually identical to the pre-chaos build.
     "fault_params",
+    # PR 9 perf statics, each with a flags.py A/B switch: lane-major hot
+    # node state (KTPU_LANE_MAJOR), the empty-window resolution razor
+    # (KTPU_WINDOW_RAZOR), and the CA scale-down combined segment-sum
+    # (KTPU_CA_DESCATTER). All three are bit-exact either way.
+    "lane_major",
+    "window_razor",
+    "ca_descatter",
 )
 
 
@@ -1729,9 +1977,18 @@ def window_step(
     hpa_seg=None,
     fault_params=None,
     name_ranks=None,
+    lane_major: bool = False,
+    window_razor: bool = True,
+    ca_descatter: bool = True,
 ) -> ClusterBatchState:
-    """Advance every cluster through scheduling-cycle window index W."""
-    return _window_body(
+    """Advance every cluster through scheduling-cycle window index W.
+
+    Lane-major conversion happens at the jit boundary (state at rest is
+    ALWAYS row-major — see state.swap_node_layout): two transposes per
+    dispatch instead of two per kernel boundary."""
+    if lane_major:
+        state = swap_node_layout(state)
+    state = _window_body(
         state,
         slab,
         W,
@@ -1751,7 +2008,13 @@ def window_step(
         hpa_seg=hpa_seg,
         fault_params=fault_params,
         name_ranks=name_ranks,
+        lane_major=lane_major,
+        window_razor=window_razor,
+        ca_descatter=ca_descatter,
     )
+    if lane_major:
+        state = swap_node_layout(state)
+    return state
 
 
 def _next_interesting_window(
@@ -1922,6 +2185,9 @@ def _run_windows_skip_impl(
     hpa_seg=None,
     fault_params=None,
     name_ranks=None,
+    lane_major: bool = False,
+    window_razor: bool = True,
+    ca_descatter: bool = True,
 ):
     """run_windows with FAST-FORWARD over provably no-op windows: a dynamic
     while_loop executes only interesting windows (see
@@ -1930,6 +2196,11 @@ def _run_windows_skip_impl(
     every index in [first, last]. One compiled program serves any span
     (first/last are traced scalars). No per-window gauge collection — the
     engine falls back to run_windows when gauges are on."""
+    if lane_major:
+        # _next_interesting_window / _catch_up_bookkeeping read only
+        # row-major leaves (pending pairs, pods), so the lane-major carry
+        # flows through the whole skip loop untouched.
+        state = swap_node_layout(state)
 
     def cond(carry):
         _, W = carry
@@ -1957,6 +2228,9 @@ def _run_windows_skip_impl(
             hpa_seg=hpa_seg,
             fault_params=fault_params,
             name_ranks=name_ranks,
+            lane_major=lane_major,
+            window_razor=window_razor,
+            ca_descatter=ca_descatter,
         )
         W_next = jnp.minimum(
             _next_interesting_window(
@@ -1972,6 +2246,8 @@ def _run_windows_skip_impl(
     state, _ = jax.lax.while_loop(
         cond, body, (state, jnp.asarray(first, jnp.int32))
     )
+    if lane_major:
+        state = swap_node_layout(state)
     return state
 
 
@@ -2015,6 +2291,9 @@ def _run_windows_impl(
     hpa_seg=None,
     fault_params=None,
     name_ranks=None,
+    lane_major: bool = False,
+    window_razor: bool = True,
+    ca_descatter: bool = True,
 ):
     """Scan a whole sequence of scheduling-cycle windows on-device (the hot
     benchmark loop: no host round-trips between cycles). window_idxs: (Wn,)
@@ -2023,6 +2302,8 @@ def _run_windows_impl(
     With collect_gauges, returns (state, (Wn, C, 7) gauge time-series) — the
     batched analog of the scalar 5 s gauge CSV cycle (one sample per window,
     since batched state only changes at window boundaries)."""
+    if lane_major:
+        state = swap_node_layout(state)
 
     def body(carry, w):
         new = _window_body(
@@ -2045,10 +2326,19 @@ def _run_windows_impl(
             hpa_seg=hpa_seg,
             fault_params=fault_params,
             name_ranks=name_ranks,
+            lane_major=lane_major,
+            window_razor=window_razor,
+            ca_descatter=ca_descatter,
         )
-        return new, (gauge_snapshot(new) if collect_gauges else None)
+        return new, (
+            gauge_snapshot(new, lane_major=lane_major)
+            if collect_gauges
+            else None
+        )
 
     state, gauges = jax.lax.scan(body, state, jnp.asarray(window_idxs, jnp.int32))
+    if lane_major:
+        state = swap_node_layout(state)
     if collect_gauges:
         return state, gauges
     return state
@@ -2202,6 +2492,9 @@ def _run_superspan_impl(
     hpa_seg=None,
     fault_params=None,
     name_ranks=None,
+    lane_major: bool = False,
+    window_razor: bool = True,
+    ca_descatter: bool = True,
     W: int = 0,
     K: int = 16,
     chunk: int = 8,
@@ -2251,6 +2544,12 @@ def _run_superspan_impl(
     big = jnp.int32(np.iinfo(np.int32).max)
     from kubernetriks_tpu.batched.autoscale import statics_with_pod_rank
 
+    if lane_major:
+        # One conversion per superspan dispatch (covers up to K slide-spans
+        # of windows); everything the loop touches outside _window_body —
+        # pod_base, phases, the stage — is row-major / pod-side.
+        state = swap_node_layout(state)
+
     L = stage.req_cpu.shape[1]
     stage_lo = jnp.asarray(stage_lo, jnp.int32)
     last = jnp.asarray(last, jnp.int32)
@@ -2288,6 +2587,9 @@ def _run_superspan_impl(
                 hpa_seg=hpa_seg,
                 fault_params=fault_params,
                 name_ranks=name_ranks,
+                lane_major=lane_major,
+                window_razor=window_razor,
+                ca_descatter=ca_descatter,
             )
             return new, None
 
@@ -2403,6 +2705,8 @@ def _run_superspan_impl(
         body,
         (state, rank, progress[0], jnp.int32(0), progress[3]),
     )
+    if lane_major:
+        state = swap_node_layout(state)
     progress_out = jnp.stack(
         [w, jnp.min(state.pod_base), spans, code]
     ).astype(jnp.int32)

@@ -57,6 +57,46 @@ _FLAGS = [
         "backends, off on CPU hosts.",
     ),
     Flag(
+        "KTPU_LANE_MAJOR",
+        "tristate",
+        None,
+        "Lane-major hot node state: inside every window program the hot "
+        "(C, N) node leaves (alive, caps, allocatables, crash payload) are "
+        "carried TRANSPOSED (N, C) — the layout the Pallas kernels consume "
+        "— so the event/free/cycle kernel wrappers skip their per-boundary "
+        "transposes and the XLA glue runs elementwise on the kernel "
+        "layout. Bit-identical to the row-major path (float metric sums "
+        "within the documented docs/PARITY.md tolerance). Unset: on for "
+        "accelerator backends, off on CPU hosts (where XLA pays the "
+        "transposes anyway and the row-major path avoids the extra "
+        "program variants). Unsupported (ignored) under a device mesh.",
+    ),
+    Flag(
+        "KTPU_WINDOW_RAZOR",
+        "tristate",
+        None,
+        "Window-cost razor: gate the per-window event-resolution soup "
+        "(event application, pending-effect merge, finish/interrupt "
+        "resolution, free/reschedule bookkeeping) behind a cheap due-ness "
+        "predicate, so empty and near-empty windows in dense traces skip "
+        "the masked elementwise passes entirely. Bit-exact: the skip "
+        "branch fires only when the soup is provably the identity. Unset: "
+        "on for accelerator backends; off on CPU hosts, where the cond "
+        "adds compile time to every window program and the measured win "
+        "is marginal (BENCH_r07 A/B). 0/1 force for A/B measurement.",
+    ),
+    Flag(
+        "KTPU_CA_DESCATTER",
+        "bool",
+        True,
+        "CA scale-down de-scatter (round 3 of the campaign): the "
+        "finish-visibility allocatable correction and the node-grouping "
+        "sort share ONE combined 2-key (C, P) sort and one set of "
+        "segment-boundary reductions instead of two sorts + four "
+        "(C, P, N) rank-count passes. Integer segment sums — bit-exact. "
+        "0 selects the r5 two-sort path for A/B measurement.",
+    ),
+    Flag(
         "KTPU_ALIGN_PODS",
         "bool",
         True,

@@ -73,6 +73,28 @@ def default_enabled() -> bool:
 _VMEM_BUDGET_BYTES = 12 * 1024 * 1024
 
 
+# Lane-major hot state (KTPU_LANE_MAJOR; state.NODE_HOT_LEAVES): every
+# wrapper below historically transposed its node-shaped operands into the
+# kernels' one true layout (clusters on lanes) and transposed the node
+# outputs back — pallas_call pins default layouts, so XLA materializes each
+# of those transposes as a copy (~1.2 ms/window of marshalling at the
+# composed shape). With nodes_lane_major=True the caller already carries the
+# hot node leaves as (N, C): the wrapper pads WITHOUT transposing (a no-op
+# copy at tile-aligned shapes) and returns node outputs lane-major. Pod-,
+# candidate- and event-shaped operands keep the row-major convention — their
+# producers/consumers in step.py are row-major-shaped sorts and gathers.
+def _prep_node(x, lane_major: bool, n_sub: int, n_lane: int, fill):
+    x = x.astype(jnp.int32)
+    if not lane_major:
+        x = x.T
+    return _pad_axis(_pad_axis(x, 0, n_sub, fill), 1, n_lane, fill)
+
+
+def _unprep_node(x, lane_major: bool, n: int, c: int):
+    out = x[:n, :c]
+    return out if lane_major else out.T
+
+
 def kernel_fits(n_nodes: int, k_pods: int) -> bool:
     """Whether one grid program's VMEM blocks (5 node blocks of (Np, 128) +
     6 candidate blocks of (Kp, 128), all int32) fit the budget; callers fall
@@ -300,11 +322,13 @@ def _select_cycle_kernel(
     jax.lax.while_loop(lambda k: k < k_bound, loop_body, jnp.int32(0))
 
 
-@functools.partial(jax.jit, static_argnames=("k_pods", "interpret"))
+@functools.partial(
+    jax.jit, static_argnames=("k_pods", "interpret", "nodes_lane_major")
+)
 def fused_select_schedule_cycle(
-    alive: jnp.ndarray,      # (C, N) bool
-    alloc_cpu: jnp.ndarray,  # (C, N) int32
-    alloc_ram: jnp.ndarray,  # (C, N) int32
+    alive: jnp.ndarray,      # (C, N) bool — (N, C) when nodes_lane_major
+    alloc_cpu: jnp.ndarray,  # (C, N) int32 — (N, C) when nodes_lane_major
+    alloc_ram: jnp.ndarray,  # (C, N) int32 — (N, C) when nodes_lane_major
     eligible: jnp.ndarray,   # (C, P) bool
     qwin: jnp.ndarray,       # (C, P) int32
     qoff: jnp.ndarray,       # (C, P) float32 (non-negative)
@@ -313,6 +337,7 @@ def fused_select_schedule_cycle(
     pod_req_ram: jnp.ndarray,  # (C, P) int32
     k_pods: int,
     interpret: bool = False,
+    nodes_lane_major: bool = False,
 ):
     """Fused selection + scheduling loop in VMEM.
 
@@ -320,9 +345,11 @@ def fused_select_schedule_cycle(
     fit_any (C,K) bool, best (C,K) int32, new_alloc_cpu, new_alloc_ram) —
     valid rows identical to prepare_cycle's sorted top-K compaction followed
     by the lax.scan/_cycle_kernel loop (invalid rows are zeroed; every
-    consumer gates on valid)."""
-    C, N = alloc_cpu.shape
-    P = eligible.shape[1]
+    consumer gates on valid). With nodes_lane_major the node operands arrive
+    and the allocatables return in (N, C) lane-major layout (no transposes
+    at this boundary — see _prep_node)."""
+    C, P = eligible.shape
+    N = alloc_cpu.shape[0] if nodes_lane_major else alloc_cpu.shape[1]
     K = k_pods
     Cp = -(-C // _LANE) * _LANE
     Np = -(-N // _SUB) * _SUB
@@ -332,9 +359,9 @@ def fused_select_schedule_cycle(
     def prep(x, n_sub, fill):
         return _pad_axis(_pad_axis(x.astype(jnp.int32).T, 0, n_sub, fill), 1, Cp, fill)
 
-    alive_p = prep(alive, Np, 0)
-    cpu_p = prep(alloc_cpu, Np, 0)
-    ram_p = prep(alloc_ram, Np, 0)
+    alive_p = _prep_node(alive, nodes_lane_major, Np, Cp, 0)
+    cpu_p = _prep_node(alloc_cpu, nodes_lane_major, Np, Cp, 0)
+    ram_p = _prep_node(alloc_ram, nodes_lane_major, Np, Cp, 0)
     elig_p = prep(eligible, Pp, 0)
     qwin_p = prep(qwin, Pp, 0)
     # Non-negative f32 bit patterns sort like the floats; move them through
@@ -377,8 +404,8 @@ def fused_select_schedule_cycle(
         assign_o[:K, :C].T != 0,
         fitany_o[:K, :C].T != 0,
         best_o[:K, :C].T,
-        cpu_o[:N, :C].T,
-        ram_o[:N, :C].T,
+        _unprep_node(cpu_o, nodes_lane_major, N, C),
+        _unprep_node(ram_o, nodes_lane_major, N, C),
     )
 
 
@@ -469,7 +496,9 @@ def _free_kernel(
     jax.lax.while_loop(lambda k: k < k_bound, loop_body, jnp.int32(0))
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(
+    jax.jit, static_argnames=("interpret", "nodes_lane_major")
+)
 def fused_free_resources(
     freed: jnp.ndarray,      # (C, P) bool
     node: jnp.ndarray,       # (C, P) int32 (>= 0 for freed pods)
@@ -477,16 +506,18 @@ def fused_free_resources(
     req_ram: jnp.ndarray,    # (C, P) int32
     finishes: jnp.ndarray,   # (C, P) bool (the estimator subset of freed)
     value: jnp.ndarray,      # (C, P) float32 estimator sample per pod
-    alloc_cpu: jnp.ndarray,  # (C, N) int32
-    alloc_ram: jnp.ndarray,  # (C, N) int32
+    alloc_cpu: jnp.ndarray,  # (C, N) int32 — (N, C) when nodes_lane_major
+    alloc_ram: jnp.ndarray,  # (C, N) int32 — (N, C) when nodes_lane_major
     interpret: bool = False,
+    nodes_lane_major: bool = False,
 ):
     """(new_alloc_cpu, new_alloc_ram, stats (C, 5)) — the allocatables with
     every freed pod's requests added back (bit-identical to the
     top_k-compaction loop) and the finished pods' estimator fold
-    (count/total/total_sq/min/max of `value`)."""
-    C, N = alloc_cpu.shape
-    P = freed.shape[1]
+    (count/total/total_sq/min/max of `value`). With nodes_lane_major the
+    allocatables arrive and return (N, C) lane-major (no transposes)."""
+    C, P = freed.shape
+    N = alloc_cpu.shape[0] if nodes_lane_major else alloc_cpu.shape[1]
     Cp = -(-C // _LANE) * _LANE
     Np = -(-N // _SUB) * _SUB
     Pp = -(-P // _SUB) * _SUB
@@ -500,8 +531,8 @@ def fused_free_resources(
     reqr_p = prep(req_ram.astype(jnp.int32), Pp, 0)
     fin_p = prep(finishes.astype(jnp.int32), Pp, 0)
     val_p = prep(value.astype(jnp.float32), Pp, 0.0)
-    acpu_p = prep(alloc_cpu.astype(jnp.int32), Np, 0)
-    aram_p = prep(alloc_ram.astype(jnp.int32), Np, 0)
+    acpu_p = _prep_node(alloc_cpu, nodes_lane_major, Np, Cp, 0)
+    aram_p = _prep_node(alloc_ram, nodes_lane_major, Np, Cp, 0)
 
     node_spec = pl.BlockSpec((Np, _LANE), lambda i: (0, i), memory_space=pltpu.VMEM)
     pod_spec = pl.BlockSpec((Pp, _LANE), lambda i: (0, i), memory_space=pltpu.VMEM)
@@ -525,7 +556,11 @@ def fused_free_resources(
             interpret=interpret,
         )(freed_p, node_p, reqc_p, reqr_p, fin_p, val_p, acpu_p, aram_p)
 
-    return acpu_o[:N, :C].T, aram_o[:N, :C].T, stats_o[:5, :C].T
+    return (
+        _unprep_node(acpu_o, nodes_lane_major, N, C),
+        _unprep_node(aram_o, nodes_lane_major, N, C),
+        stats_o[:5, :C].T,
+    )
 
 
 def event_kernel_fits(n_nodes: int, n_pods: int, n_events: int) -> bool:
@@ -619,25 +654,32 @@ def _event_kernel(
     jax.lax.while_loop(lambda k: k < k_bound, loop_body, jnp.int32(0))
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(
+    jax.jit, static_argnames=("interpret", "nodes_lane_major")
+)
 def fused_event_scatter(
     ev_kind: jnp.ndarray,   # (C, E) int32
     ev_slot: jnp.ndarray,   # (C, E) int32 device coords
     ev_rel: jnp.ndarray,    # (C, E) float32
     ev_seq: jnp.ndarray,    # (C, E) int32
     ev_valid: jnp.ndarray,  # (C, E) bool (per-lane prefix)
-    created: jnp.ndarray,       # (C, N) bool
-    node_removal: jnp.ndarray,  # (C, N) float32
+    created: jnp.ndarray,       # (C, N) bool — (N, C) when nodes_lane_major
+    node_removal: jnp.ndarray,  # (C, N) float32 — (N, C) when nodes_lane_major
     pod_create: jnp.ndarray,    # (C, P) float32
     pod_create_seq: jnp.ndarray,  # (C, P) int32
     pod_removal: jnp.ndarray,   # (C, P) float32
     interpret: bool = False,
+    nodes_lane_major: bool = False,
 ):
     """Returns the five accumulators with this chunk's events applied,
-    bit-identical to the XLA scatter formulation."""
-    C, N = created.shape
+    bit-identical to the XLA scatter formulation. With nodes_lane_major the
+    two NODE accumulators arrive and return (N, C) lane-major — the event
+    chunk loop carries them in the kernel layout across iterations, so the
+    per-iteration transposes vanish (the event columns are per-chunk data
+    and keep the row-major convention)."""
+    C, E = ev_kind.shape
+    N = created.shape[0] if nodes_lane_major else created.shape[1]
     P = pod_create.shape[1]
-    E = ev_kind.shape[1]
     Cp = -(-C // _LANE) * _LANE
     Np = -(-N // _SUB) * _SUB
     Pp = -(-P // _SUB) * _SUB
@@ -646,6 +688,10 @@ def fused_event_scatter(
     def prep(x, n_sub, fill):
         return _pad_axis(_pad_axis(x.T, 0, n_sub, fill), 1, Cp, fill)
 
+    def prep_n(x, fill):
+        x2 = x if nodes_lane_major else x.T
+        return _pad_axis(_pad_axis(x2, 0, Np, fill), 1, Cp, fill)
+
     f32inf = jnp.float32(np.inf)
     args = (
         prep(ev_kind.astype(jnp.int32), Ep, 0),
@@ -653,8 +699,8 @@ def fused_event_scatter(
         prep(ev_rel.astype(jnp.float32), Ep, 0.0),
         prep(ev_seq.astype(jnp.int32), Ep, 0),
         prep(ev_valid.astype(jnp.int32), Ep, 0),
-        prep(created.astype(jnp.int32), Np, 0),
-        prep(node_removal.astype(jnp.float32), Np, f32inf),
+        prep_n(created.astype(jnp.int32), 0),
+        prep_n(node_removal.astype(jnp.float32), f32inf),
         prep(pod_create.astype(jnp.float32), Pp, f32inf),
         prep(pod_create_seq.astype(jnp.int32), Pp, 0),
         prep(pod_removal.astype(jnp.float32), Pp, f32inf),
@@ -684,8 +730,8 @@ def fused_event_scatter(
         )(*args)
 
     return (
-        created_o[:N, :C].T != 0,
-        nrm_o[:N, :C].T,
+        _unprep_node(created_o, nodes_lane_major, N, C) != 0,
+        _unprep_node(nrm_o, nodes_lane_major, N, C),
         pcr_o[:P, :C].T,
         pseq_o[:P, :C].T,
         prm_o[:P, :C].T,
@@ -840,24 +886,28 @@ def _pad_axis(x: jnp.ndarray, axis: int, to: int, value) -> jnp.ndarray:
     return jnp.pad(x, widths, constant_values=value)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(
+    jax.jit, static_argnames=("interpret", "nodes_lane_major")
+)
 def fused_schedule_cycle(
-    alive: jnp.ndarray,      # (C, N) bool
-    alloc_cpu: jnp.ndarray,  # (C, N) int32
-    alloc_ram: jnp.ndarray,  # (C, N) int32
+    alive: jnp.ndarray,      # (C, N) bool — (N, C) when nodes_lane_major
+    alloc_cpu: jnp.ndarray,  # (C, N) int32 — (N, C) when nodes_lane_major
+    alloc_ram: jnp.ndarray,  # (C, N) int32 — (N, C) when nodes_lane_major
     valid: jnp.ndarray,      # (C, K) bool
     req_cpu: jnp.ndarray,    # (C, K) int32
     req_ram: jnp.ndarray,    # (C, K) int32
     interpret: bool = False,
+    nodes_lane_major: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Run the K-pod scheduling loop in VMEM.
 
     Returns (assign (C,K) bool, fit_any (C,K) bool, best (C,K) int32,
-    new_alloc_cpu (C,N) int32, new_alloc_ram (C,N) int32), identical to the
-    lax.scan formulation in batched/step.py.
+    new_alloc_cpu, new_alloc_ram), identical to the lax.scan formulation in
+    batched/step.py. With nodes_lane_major the node operands arrive and the
+    allocatables return (N, C) lane-major (no transposes).
     """
-    C, N = alloc_cpu.shape
-    K = valid.shape[1]
+    C, K = valid.shape
+    N = alloc_cpu.shape[0] if nodes_lane_major else alloc_cpu.shape[1]
     Cp = -(-C // _LANE) * _LANE
     Np = -(-N // _SUB) * _SUB
     Kp = -(-K // _SUB) * _SUB
@@ -866,9 +916,9 @@ def fused_schedule_cycle(
         # (C, n) -> padded transposed (n_sub, Cp) with clusters on lanes.
         return _pad_axis(_pad_axis(x.astype(jnp.int32).T, 0, n_sub, fill), 1, Cp, fill)
 
-    alive_p = prep(alive, Np, 0)
-    cpu_p = prep(alloc_cpu, Np, 0)
-    ram_p = prep(alloc_ram, Np, 0)
+    alive_p = _prep_node(alive, nodes_lane_major, Np, Cp, 0)
+    cpu_p = _prep_node(alloc_cpu, nodes_lane_major, Np, Cp, 0)
+    ram_p = _prep_node(alloc_ram, nodes_lane_major, Np, Cp, 0)
     valid_p = prep(valid, Kp, 0)
     reqc_p = prep(req_cpu, Kp, 0)
     reqr_p = prep(req_ram, Kp, 0)
@@ -903,8 +953,8 @@ def fused_schedule_cycle(
         assign_o[:K, :C].T != 0,
         fitany_o[:K, :C].T != 0,
         best_o[:K, :C].T,
-        cpu_o[:N, :C].T,
-        ram_o[:N, :C].T,
+        _unprep_node(cpu_o, nodes_lane_major, N, C),
+        _unprep_node(ram_o, nodes_lane_major, N, C),
     )
 
 
@@ -1068,11 +1118,13 @@ def _select_cycle_commit_kernel(
     jax.lax.while_loop(lambda k: k < k_bound, loop_body, jnp.int32(0))
 
 
-@functools.partial(jax.jit, static_argnames=("k_pods", "interpret"))
+@functools.partial(
+    jax.jit, static_argnames=("k_pods", "interpret", "nodes_lane_major")
+)
 def fused_select_cycle_commit(
-    alive: jnp.ndarray,      # (C, N) bool
-    alloc_cpu: jnp.ndarray,  # (C, N) int32
-    alloc_ram: jnp.ndarray,  # (C, N) int32
+    alive: jnp.ndarray,      # (C, N) bool — (N, C) when nodes_lane_major
+    alloc_cpu: jnp.ndarray,  # (C, N) int32 — (N, C) when nodes_lane_major
+    alloc_ram: jnp.ndarray,  # (C, N) int32 — (N, C) when nodes_lane_major
     eligible: jnp.ndarray,   # (C, P) bool
     qwin: jnp.ndarray,       # (C, P) int32
     qoff: jnp.ndarray,       # (C, P) float32 (non-negative)
@@ -1087,11 +1139,14 @@ def fused_select_cycle_commit(
     park_t: jnp.ndarray,     # (C, K) float32 positional park offsets
     k_pods: int,
     interpret: bool = False,
+    nodes_lane_major: bool = False,
 ):
     """Megakernel wrapper. Returns (alloc_cpu, alloc_ram, phase, node,
-    start_tmp (+inf untouched), park_tmp, qstats (C, 5))."""
-    C, N = alloc_cpu.shape
-    P = eligible.shape[1]
+    start_tmp (+inf untouched), park_tmp, qstats (C, 5)). With
+    nodes_lane_major the node operands arrive and the allocatables return
+    (N, C) lane-major (no transposes at this boundary)."""
+    C, P = eligible.shape
+    N = alloc_cpu.shape[0] if nodes_lane_major else alloc_cpu.shape[1]
     K = k_pods
     Cp = -(-C // _LANE) * _LANE
     Np = -(-N // _SUB) * _SUB
@@ -1106,9 +1161,9 @@ def fused_select_cycle_commit(
             _pad_axis(x.astype(jnp.float32).T, 0, n_sub, fill), 1, Cp, fill
         )
 
-    alive_p = prep(alive, Np, 0)
-    cpu_p = prep(alloc_cpu, Np, 0)
-    ram_p = prep(alloc_ram, Np, 0)
+    alive_p = _prep_node(alive, nodes_lane_major, Np, Cp, 0)
+    cpu_p = _prep_node(alloc_cpu, nodes_lane_major, Np, Cp, 0)
+    ram_p = _prep_node(alloc_ram, nodes_lane_major, Np, Cp, 0)
     elig_p = prep(eligible, Pp, 0)
     qwin_p = prep(qwin, Pp, 0)
     qoff_p = prep(jax.lax.bitcast_convert_type(qoff, jnp.int32), Pp, 0)
@@ -1155,8 +1210,8 @@ def fused_select_cycle_commit(
         )
 
     return (
-        cpu_o[:N, :C].T,
-        ram_o[:N, :C].T,
+        _unprep_node(cpu_o, nodes_lane_major, N, C),
+        _unprep_node(ram_o, nodes_lane_major, N, C),
         phase_o[:P, :C].T,
         node_o[:P, :C].T,
         start_o[:P, :C].T,

@@ -55,6 +55,11 @@ def test_bench_smoke_emits_four_parseable_lines(capsys, tmp_path, monkeypatch):
         spans = rec["spans"]
         assert spans["n"] >= 5
         assert spans["min"] <= rec["value"] <= spans["max"]
+        # r7 span-validity protocol: zero-decision (trace-exhausted) spans
+        # are dropped and DISCLOSED, and every span that made the median
+        # committed decisions — spans.min == 0 can no longer happen.
+        assert spans["dropped"] >= 0
+        assert spans["min"] > 0
     assert "spans" not in records[0] and "spans" not in records[3]
     # Telemetry summary embedded in (exactly) the traced composed lines:
     # per-phase wall time, the observed-vs-expected sync budget, dispatch
@@ -67,6 +72,12 @@ def test_bench_smoke_emits_four_parseable_lines(capsys, tmp_path, monkeypatch):
         assert tel["sync_budget"]["observed_slide_syncs"] >= 0
         assert "ladder_fallbacks" in tel["dispatch_stats"]
         assert tel["ring_totals"]["decisions"] > 0
+        # Per-window window-program cost (the lane-major / window-razor /
+        # CA-de-scatter observable): present and positive on every traced
+        # composed line, so layout regressions surface on CPU CI.
+        pw = tel["per_window"]
+        assert pw["windows"] > 0
+        assert pw["ms_per_window"] > 0
     # The superspan line's trace shows the scanned executor: superspan
     # dispatches present, zero ladder chunks, sync budget exactly met.
     tel = records[2]["telemetry"]

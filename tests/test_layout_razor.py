@@ -1,0 +1,373 @@
+"""PR 9 exactness gates: lane-major hot state (KTPU_LANE_MAJOR), the
+empty-window resolution razor (KTPU_WINDOW_RAZOR) and the CA scale-down
+de-scatter (KTPU_CA_DESCATTER) are all bit-identical to the paths they
+replace.
+
+- Layout-equivalence sweep: lane-major vs row-major final state across the
+  ladder, fused chunk+slide and superspan executors on one composed
+  HPA+CA+sliding-window engine WITH chaos faults on — the full flagship
+  feature set — with razor+de-scatter also flipped on against an all-off
+  reference, and dispatch_stats EQUAL (the modes are device-side layout /
+  program changes; zero new host syncs).
+- Empty-window razor gate: a gappy dense-stepped trace (bursts separated by
+  provably-empty windows, fast-forward OFF so the razor — not the span
+  skipper — is what fires) produces identical state with the razor on/off.
+- Kernel-wrapper lane-major unit gates: each wrapper that accepts
+  nodes_lane_major returns bit-identical results for transposed node
+  operands (interpret mode, so this holds on CPU CI).
+
+State comparison uses state.compare_states — the documented parity policy
+(exact everywhere; float32 metric accumulators to 1e-6, which covers the
+axis-flipped node_downtime_s reduction order).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from kubernetriks_tpu.batched.engine import build_batched_from_traces
+from kubernetriks_tpu.batched.state import compare_states, swap_node_layout
+from kubernetriks_tpu.config import SimulationConfig
+from kubernetriks_tpu.trace.generator import (
+    PoissonWorkloadTrace,
+    UniformClusterTrace,
+)
+from kubernetriks_tpu.trace.generic import GenericWorkloadTrace
+
+COMPOSED_YAML = """
+sim_name: layout_razor
+seed: 1
+scheduling_cycle_interval: 10.0
+horizontal_pod_autoscaler:
+  enabled: true
+cluster_autoscaler:
+  enabled: true
+  scan_interval: 10.0
+  max_node_count: 8
+  node_groups:
+  - node_template:
+      metadata: {name: ca_node}
+      status: {capacity: {cpu: 64000, ram: 137438953472}}
+fault_injection:
+  enabled: true
+  node:
+    mttf: 300.0
+    mttr: 60.0
+  pod:
+    fail_prob: 0.1
+    restart_limit: 2
+"""
+
+GROUP_YAML = """
+events:
+- timestamp: 49.5
+  event_type:
+    !CreatePodGroup
+      pod_group:
+        name: grp
+        initial_pod_count: 4
+        max_pod_count: 8
+        pod_template:
+          metadata: {name: grp}
+          spec:
+            resources:
+              requests: {cpu: 8000, ram: 17179869184}
+              limits: {cpu: 8000, ram: 17179869184}
+        target_resources_usage: {cpu_utilization: 0.5}
+        resources_usage_model_config:
+          cpu_config:
+            model_name: pod_group
+            config: |
+              - duration: 60.0
+                total_load: 2.0
+              - duration: 90.0
+                total_load: 12.0
+              - duration: 150.0
+                total_load: 1.0
+"""
+
+
+@pytest.fixture(scope="module")
+def composed_traces():
+    config = SimulationConfig.from_yaml(COMPOSED_YAML)
+    cluster = UniformClusterTrace(8, cpu=64000, ram=128 * 1024**3)
+    plain = PoissonWorkloadTrace(
+        rate_per_second=0.375,
+        horizon=300.0,
+        seed=3,
+        cpu=16000,
+        ram=32 * 1024**3,
+        duration_range=(30.0, 120.0),
+        name_prefix="plain",
+    )
+    group = GenericWorkloadTrace.from_yaml(GROUP_YAML)
+    workload = sorted(
+        plain.convert_to_simulator_events()
+        + group.convert_to_simulator_events(),
+        key=lambda e: e[0],
+    )
+    return config, cluster.convert_to_simulator_events(), workload
+
+
+def _run_composed(composed_traces, **kwargs):
+    config, cev, wev = composed_traces
+    sim = build_batched_from_traces(
+        config,
+        cev,
+        wev,
+        n_clusters=4,
+        max_pods_per_cycle=16,
+        pod_window=64,
+        use_pallas=False,
+        fast_forward=False,
+        **kwargs,
+    )
+    sim.step_until_time(350.0)
+    return sim
+
+
+@pytest.fixture(scope="module")
+def composed_reference(composed_traces):
+    """Row-major, razor off, de-scatter off, ladder executor — the r8
+    path every new mode must reproduce bit for bit."""
+    return _run_composed(
+        composed_traces,
+        superspan=False,
+        lane_major=False,
+        window_razor=False,
+        ca_descatter=False,
+    )
+
+
+@pytest.mark.parametrize(
+    "executor",
+    ["ladder", "fused", "superspan"],
+)
+def test_lane_major_bit_identity_across_executors(
+    composed_traces, composed_reference, executor
+):
+    """Lane-major + razor + de-scatter ON vs the all-off row-major
+    reference: final composed chaos state identical under the parity
+    policy, on every steady-state executor."""
+    kwargs = dict(superspan=False)
+    if executor == "fused":
+        # Undonated on purpose: the plain chunk programs are then jit-cache
+        # hits from the ladder case, so this case compiles only the fused
+        # chunk+slide program (tier-1 wall-clock budget).
+        kwargs = dict(superspan=False, fuse_slide=True)
+    elif executor == "superspan":
+        kwargs = dict(superspan=True)
+    sim = _run_composed(
+        composed_traces,
+        lane_major=True,
+        window_razor=True,
+        ca_descatter=True,
+        **kwargs,
+    )
+    bad = compare_states(composed_reference.state, sim.state)
+    assert not bad, f"{executor}: lane-major state diverged: {bad}"
+    if executor == "fused":
+        assert sim.dispatch_stats["fused_slides"] > 0
+    if executor == "superspan":
+        assert sim.dispatch_stats["superspans"] > 0
+        assert sim.dispatch_stats["window_chunks"] == 0
+    else:
+        # The new modes are device-side program changes: the host dispatch
+        # loop — chunk counts, slides, syncs — is IDENTICAL with them on
+        # (the no-new-host-syncs half of the acceptance criteria). The
+        # ladder/fused executors share the reference's dispatch pattern
+        # modulo the fused-slide split, which fused engines disclose in
+        # their own counters checked above.
+        if executor == "ladder":
+            assert sim.dispatch_stats == composed_reference.dispatch_stats
+    # State AT REST is row-major regardless of the program layout: readout,
+    # checkpointing and sharding never see transposed leaves (conversion
+    # lives at the jit entries), and the swap helper is self-inverse on a
+    # real post-run state. Asserted on the sweep engines (zero extra
+    # builds — tier-1 wall-clock budget).
+    C, N = sim.n_clusters, sim.n_nodes
+    assert sim.state.nodes.alive.shape == (C, N)
+    assert sim.state.nodes.alloc_cpu.shape == (C, N)
+    twice = swap_node_layout(swap_node_layout(sim.state))
+    assert not compare_states(sim.state, twice)
+
+
+def _gappy_plain_traces():
+    """A plain engine shape with real empty windows: two pod bursts
+    separated by a long quiet stretch, durations short enough that the
+    stretch has no finishes due either."""
+    config = SimulationConfig.from_yaml(
+        "sim_name: razor\nseed: 1\nscheduling_cycle_interval: 10.0"
+    )
+    cluster = UniformClusterTrace(8, cpu=64000, ram=128 * 1024**3)
+    bursts = []
+    for burst_t0 in (0.0, 600.0):
+        w = PoissonWorkloadTrace(
+            rate_per_second=1.0,
+            horizon=60.0,
+            seed=int(burst_t0) + 5,
+            cpu=4000,
+            ram=8 * 1024**3,
+            duration_range=(20.0, 40.0),
+            name_prefix=f"b{int(burst_t0)}",
+        )
+        bursts += [(t + burst_t0, ev) for t, ev in w.convert_to_simulator_events()]
+    return config, cluster.convert_to_simulator_events(), sorted(
+        bursts, key=lambda e: e[0]
+    )
+
+
+def test_window_razor_empty_window_identity():
+    """Razor on vs off over a gappy trace stepped WITHOUT fast-forward:
+    the gated resolution path must produce identical state even though
+    most windows take the skip branch (the correctness half of the
+    empty-window-cost claim)."""
+    config, cev, wev = _gappy_plain_traces()
+
+    def run(razor):
+        sim = build_batched_from_traces(
+            config,
+            cev,
+            wev,
+            n_clusters=2,
+            max_pods_per_cycle=16,
+            fast_forward=False,
+            window_razor=razor,
+        )
+        sim.step_until_time(800.0)
+        return sim
+
+    on, off = run(True), run(False)
+    bad = compare_states(off.state, on.state)
+    assert not bad, f"razor diverged: {bad}"
+    assert on.dispatch_stats == off.dispatch_stats
+    assert (
+        on.metrics_summary()["counters"]["scheduling_decisions"]
+        == off.metrics_summary()["counters"]["scheduling_decisions"]
+        > 0
+    )
+
+
+# --- kernel-wrapper lane-major unit gates (interpret mode) -------------------
+
+
+def _node_ops(rng, C, N):
+    alive = rng.random((C, N)) < 0.8
+    cap = rng.integers(1000, 64000, (C, N)).astype(np.int32)
+    alloc = (cap * rng.random((C, N))).astype(np.int32)
+    return alive, alloc, alloc // 2
+
+
+def test_free_kernel_lane_major_identity():
+    from kubernetriks_tpu.ops.scheduler_kernel import fused_free_resources
+
+    rng = np.random.default_rng(0)
+    C, N, P = 3, 5, 9
+    alive, acpu, aram = _node_ops(rng, C, N)
+    freed = rng.random((C, P)) < 0.4
+    node = rng.integers(-1, N, (C, P)).astype(np.int32)
+    node = np.where(freed, np.clip(node, 0, N - 1), node)
+    reqc = rng.integers(0, 500, (C, P)).astype(np.int32)
+    reqr = rng.integers(0, 500, (C, P)).astype(np.int32)
+    fin = freed & (rng.random((C, P)) < 0.5)
+    val = rng.random((C, P)).astype(np.float32)
+    row = fused_free_resources(
+        freed, node, reqc, reqr, fin, val, acpu, aram, interpret=True
+    )
+    lane = fused_free_resources(
+        freed, node, reqc, reqr, fin, val, acpu.T, aram.T,
+        interpret=True, nodes_lane_major=True,
+    )
+    np.testing.assert_array_equal(np.asarray(row[0]), np.asarray(lane[0]).T)
+    np.testing.assert_array_equal(np.asarray(row[1]), np.asarray(lane[1]).T)
+    np.testing.assert_array_equal(np.asarray(row[2]), np.asarray(lane[2]))
+
+
+def test_cycle_kernel_lane_major_identity():
+    from kubernetriks_tpu.ops.scheduler_kernel import fused_schedule_cycle
+
+    rng = np.random.default_rng(1)
+    C, N, K = 3, 6, 4
+    alive, acpu, aram = _node_ops(rng, C, N)
+    valid = rng.random((C, K)) < 0.7
+    reqc = rng.integers(0, 4000, (C, K)).astype(np.int32)
+    reqr = rng.integers(0, 4000, (C, K)).astype(np.int32)
+    row = fused_schedule_cycle(
+        alive, acpu, aram, valid, reqc, reqr, interpret=True
+    )
+    lane = fused_schedule_cycle(
+        alive.T, acpu.T, aram.T, valid, reqc, reqr,
+        interpret=True, nodes_lane_major=True,
+    )
+    for i in range(3):  # candidate-shaped outputs
+        np.testing.assert_array_equal(np.asarray(row[i]), np.asarray(lane[i]))
+    for i in (3, 4):  # node-shaped outputs come back lane-major
+        np.testing.assert_array_equal(
+            np.asarray(row[i]), np.asarray(lane[i]).T
+        )
+
+
+def test_event_kernel_lane_major_identity():
+    from kubernetriks_tpu.ops.scheduler_kernel import fused_event_scatter
+
+    rng = np.random.default_rng(2)
+    C, N, P, E = 3, 5, 7, 6
+    kind = rng.integers(1, 5, (C, E)).astype(np.int32)
+    slot = rng.integers(0, max(N, P), (C, E)).astype(np.int32)
+    rel = rng.random((C, E)).astype(np.float32)
+    seq = rng.integers(0, 100, (C, E)).astype(np.int32)
+    valid = (np.cumsum(rng.random((C, E)) < 0.8, axis=1) == np.arange(1, E + 1))
+    created = rng.random((C, N)) < 0.2
+    nrm = np.where(rng.random((C, N)) < 0.2, rng.random((C, N)), np.inf).astype(
+        np.float32
+    )
+    pcr = np.full((C, P), np.inf, np.float32)
+    pseq = np.zeros((C, P), np.int32)
+    prm = np.full((C, P), np.inf, np.float32)
+    row = fused_event_scatter(
+        kind, slot, rel, seq, valid, created, nrm, pcr, pseq, prm,
+        interpret=True,
+    )
+    lane = fused_event_scatter(
+        kind, slot, rel, seq, valid, created.T, nrm.T, pcr, pseq, prm,
+        interpret=True, nodes_lane_major=True,
+    )
+    np.testing.assert_array_equal(np.asarray(row[0]), np.asarray(lane[0]).T)
+    np.testing.assert_array_equal(np.asarray(row[1]), np.asarray(lane[1]).T)
+    for i in (2, 3, 4):
+        np.testing.assert_array_equal(np.asarray(row[i]), np.asarray(lane[i]))
+
+
+def test_megakernel_lane_major_identity():
+    from kubernetriks_tpu.ops.scheduler_kernel import fused_select_cycle_commit
+
+    rng = np.random.default_rng(3)
+    C, N, P, K = 3, 5, 9, 4
+    alive, acpu, aram = _node_ops(rng, C, N)
+    elig = rng.random((C, P)) < 0.5
+    qwin = rng.integers(0, 10, (C, P)).astype(np.int32)
+    qoff = rng.random((C, P)).astype(np.float32)
+    qseq = rng.permutation(C * P).reshape(C, P).astype(np.int32)
+    reqc = rng.integers(0, 4000, (C, P)).astype(np.int32)
+    reqr = rng.integers(0, 4000, (C, P)).astype(np.int32)
+    waited = rng.random((C, P)).astype(np.float32)
+    phase = rng.integers(0, 4, (C, P)).astype(np.int32)
+    node = rng.integers(-1, N, (C, P)).astype(np.int32)
+    qpre = np.cumsum(rng.random((C, K)), axis=1).astype(np.float32)
+    start = (qpre + 0.5).astype(np.float32)
+    park = qpre.copy()
+    args = (elig, qwin, qoff, qseq, reqc, reqr, waited, phase, node,
+            qpre, start, park)
+    row = fused_select_cycle_commit(
+        alive, acpu, aram, *args, k_pods=K, interpret=True
+    )
+    lane = fused_select_cycle_commit(
+        alive.T, acpu.T, aram.T, *args, k_pods=K, interpret=True,
+        nodes_lane_major=True,
+    )
+    for i in (0, 1):  # allocatables come back lane-major
+        np.testing.assert_array_equal(
+            np.asarray(row[i]), np.asarray(lane[i]).T
+        )
+    for i in range(2, 7):
+        np.testing.assert_array_equal(np.asarray(row[i]), np.asarray(lane[i]))

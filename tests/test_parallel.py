@@ -15,6 +15,7 @@ from kubernetriks_tpu.rl.attention_policy import (
     make_sharded_apply,
 )
 from kubernetriks_tpu.rl.policy import NODE_FEATURES
+from kubernetriks_tpu.parallel.multihost import shard_map
 
 
 def _seq_mesh(n):
@@ -36,7 +37,7 @@ def test_ring_attention_matches_full_attention():
 
     mesh = _seq_mesh(8)
     ring = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda q, k, v, m: ring_attention(q, k, v, m, "seq"),
             mesh=mesh,
             in_specs=(
@@ -60,7 +61,7 @@ def test_ring_attention_fully_masked_rows_are_zero():
 
     mesh = _seq_mesh(8)
     got = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda q, k, v, m: ring_attention(q, k, v, m, "seq"),
             mesh=mesh,
             in_specs=(
