@@ -30,8 +30,11 @@ chunks.
 short horizons, no ladder precompile) purely to prove the bench plumbing
 runs and parses end-to-end — the values are meaningless as performance
 numbers — plus a superspan-MACHINERY line (scanned executor forced on,
-in-bench asserts fail on silent fallback to the ladder).
-tests/test_bench_smoke.py pins it under JAX_PLATFORMS=cpu.
+in-bench asserts fail on silent fallback to the ladder) and a
+streaming-FEEDER line (superspan + the bounded-ring trace-ingestion
+pipeline forced on, in-bench asserts fail on silent fallback to
+whole-trace staging). tests/test_bench_smoke.py pins it under
+JAX_PLATFORMS=cpu.
 
 `--trace` arms the flight recorder (kubernetriks_tpu/telemetry) on the
 composed lines: the JSON record gains a "telemetry" summary (per-phase
@@ -170,6 +173,10 @@ def run_composed(
     use_pallas=True,  # True force-on (hardware bench), False off, None auto
     faults: bool = False,
     superspan=None,  # tri-state like use_pallas; True also asserts it engaged
+    stream=None,  # tri-state; True also asserts the feeder really staged
+    stream_segment=None,  # staging-slab width (columns); None = 4W default
+    stream_depth=None,  # feeder ring capacity K; None = registry default
+    mesh=None,  # jax.sharding.Mesh: shard the cluster batch (bench_mesh.py)
     fast_forward=None,
     trace: bool = False,  # --trace: flight recorder + telemetry in the JSON
     trace_path: str = None,  # Chrome trace output (Perfetto-loadable)
@@ -252,6 +259,10 @@ cluster_autoscaler:
         # line passes superspan=True to engage the scanned path on CPU).
         use_pallas=use_pallas,
         superspan=superspan,
+        stream=stream,
+        stream_segment=stream_segment,
+        stream_depth=stream_depth,
+        mesh=mesh,
         fast_forward=fast_forward,
         lane_major=lane_major,
         window_razor=window_razor,
@@ -326,6 +337,31 @@ cluster_autoscaler:
         assert sim.dispatch_stats["window_chunks"] == 0, (
             "composed bench: superspan engine dispatched ladder chunks"
         )
+    if stream:
+        # The streaming feeder actually staged the run — a silent fallback
+        # to the resident whole-trace payload (the bug class this line
+        # exists to catch, same pattern as the superspan fallback asserts)
+        # would leave the device slide payload materialized and the feeder
+        # idle.
+        assert sim._device_slide is None, (
+            "composed bench: streaming requested but the whole-trace "
+            "device slide payload was materialized (silent fallback to "
+            "resident staging)"
+        )
+        assert sim.dispatch_stats["feeder_slabs_produced"] > 0, (
+            "composed bench: streaming requested but the feeder produced "
+            "no slabs"
+        )
+        assert sim.dispatch_stats["stage_refills"] > 0, (
+            "composed bench: streaming requested but no feeder slab was "
+            "ever installed"
+        )
+        # Feeder work rides its own thread, not new host syncs: the
+        # steady-state budget stays one progress readback per superspan.
+        assert (
+            sim.dispatch_stats["slide_syncs"]
+            == sim.dispatch_stats["superspans"]
+        ), "composed bench: streaming added host syncs beyond the budget"
     out = {
         "value": float(np.median(valid)),
         "spans": {
@@ -352,6 +388,11 @@ cluster_autoscaler:
             "dispatch_stats": rep["dispatch_stats"],
             "ring_totals": rep.get("ring", {}).get("totals", {}),
         }
+        if "feeder" in rep:
+            # Streaming-feeder anatomy: slab production vs installs, the
+            # ring-depth gauge, and the stage-stall split (feeder-not-ready
+            # vs upload-wait) — the starved-feeder observable.
+            out["telemetry"]["feeder"] = rep["feeder"]
         # Per-window device-cost line: must exist and be positive on every
         # traced run — CPU CI runs --smoke --trace, so a change that stops
         # windows (or their cost accounting) from being recorded fails
@@ -367,6 +408,10 @@ cluster_autoscaler:
         }
         if trace_path:
             sim.write_chrome_trace(trace_path)
+    # Release the streaming feeder's producer thread (and the engine it
+    # keeps alive through its bound callbacks) — a driver looping bench
+    # configurations must not accumulate parked feeders + staged slabs.
+    sim.close()
     return out
 
 
@@ -440,6 +485,26 @@ def main(argv=None) -> None:
             run_composed(4, 8, superspan=True, fast_forward=False,
                          trace=trace,
                          trace_path=_trace_path("smoke_superspan") if trace else None,
+                         **smoke_composed),
+        )
+        _emit(
+            # The streaming-FEEDER line: same composed shape, superspan +
+            # the K-deep streaming ingestion ring forced on (CPU default
+            # is off). The in-bench asserts require the feeder really
+            # staged the run (device slide payload NOT materialized,
+            # slabs produced AND installed, sync budget unchanged), so
+            # the CPU CI job catches a silent fallback to whole-trace
+            # staging — tests/test_bench_smoke.py pins this line. The
+            # default segment width at this toy shape clamps to the whole
+            # padded payload, so the superspan program is the
+            # cache-warmed one from the previous line (zero extra
+            # compile); the staging machinery still runs end to end
+            # through the feeder ring.
+            "pod-scheduling decisions/sec (SMOKE, composed flagship + "
+            "superspan + streaming feeder)",
+            run_composed(4, 8, superspan=True, stream=True,
+                         fast_forward=False, trace=trace,
+                         trace_path=_trace_path("smoke_stream") if trace else None,
                          **smoke_composed),
         )
         _emit(

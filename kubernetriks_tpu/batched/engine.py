@@ -68,7 +68,7 @@ from kubernetriks_tpu.config import (
     SimulationConfig,
 )
 from kubernetriks_tpu import sanitize
-from kubernetriks_tpu.flags import flag_bool, flag_tristate
+from kubernetriks_tpu.flags import flag_bool, flag_int, flag_tristate
 from kubernetriks_tpu.telemetry import (
     GaugeSeries,
     NULL_TRACER,
@@ -87,6 +87,8 @@ from kubernetriks_tpu.telemetry.tracer import (
     PH_STAGE_ASSEMBLE,
     PH_STAGE_PREFETCH,
     PH_STAGE_PUT,
+    PH_STAGE_WAIT_FEEDER,
+    PH_STAGE_WAIT_UPLOAD,
     PH_SUPERSPAN,
     PH_WINDOW_CHUNK,
     PH_WINDOW_GROW,
@@ -549,6 +551,9 @@ class BatchedSimulation:
         superspan_k: int = 16,
         superspan_chunk: int = 8,
         superspan_stage_cols: Optional[int] = None,
+        stream: Optional[bool] = None,
+        stream_depth: Optional[int] = None,
+        stream_segment: Optional[int] = None,
         sanitize_mode: Optional[bool] = None,
         telemetry: Optional[bool] = None,
         telemetry_ring: int = 1024,
@@ -642,6 +647,56 @@ class BatchedSimulation:
         self._superspan_k = max(1, int(superspan_k))
         self._superspan_chunk = max(1, int(superspan_chunk))
         self._superspan_stage_cols = superspan_stage_cols
+        # Streaming trace-ingestion pipeline (KTPU_STREAM / stream arg):
+        # a feeder thread (batched/stream.py) compiles trace segments into
+        # a bounded ring of K device-resident RefillStage slabs, running
+        # AHEAD of the superspan dispatch loop — stage-exhaustion exits
+        # find the next slab already uploaded, and the whole-trace device
+        # slide payload is never materialized (host+device staging memory
+        # is O(K x segment), not O(trace)). Rides the superspan executor:
+        # tristate default mirrors KTPU_SUPERSPAN (accelerator on, CPU
+        # off), and an explicit stream=True without the superspan executor
+        # is a loud error rather than a silent whole-trace fallback.
+        if stream is not None:
+            self._stream = bool(stream)
+            if self._stream and not self._superspan:
+                raise ValueError(
+                    "stream=True requires the superspan executor "
+                    "(superspan=True / KTPU_SUPERSPAN): the streaming "
+                    "feeder stages slabs for run_superspan's bounded "
+                    "RefillStage path"
+                )
+        else:
+            env = flag_tristate("KTPU_STREAM")
+            self._stream = (
+                bool(env if env is not None else jax.default_backend() != "cpu")
+                and self._superspan
+            )
+        if mesh is not None and is_cross_process(mesh):
+            # Forced off on CROSS-PROCESS meshes (the lane_major
+            # precedent): the feeder thread's uploads go through
+            # put_global, whose collective ordering across hosts is only
+            # coordinated on the engine thread — an uncoordinated
+            # feeder-thread put could interleave with the engine's
+            # collectives. Single-process meshes (incl. a whole v5e-8)
+            # stream normally; cross-process runs keep the resident
+            # device-slide payload path.
+            self._stream = False
+        if stream_depth is None:
+            stream_depth = flag_int("KTPU_STREAM_DEPTH")
+        self._stream_depth = max(1, int(stream_depth))
+        if stream_segment is None:
+            stream_segment = flag_int("KTPU_STREAM_SEGMENT")
+        self._stream_segment = (
+            None if stream_segment is None else int(stream_segment)
+        )
+        # The live feeder (stream.StreamFeeder) — built lazily at the
+        # first staged dispatch, closed + rebuilt (re-seek) on window
+        # growth and checkpoint restore. _feeder_produced_total carries
+        # the production counter across those re-seeks so
+        # dispatch_stats["feeder_slabs_produced"] is cumulative.
+        self._feeder = None
+        self._feeder_produced_total = 0
         # Lane-major hot node state (KTPU_LANE_MAJOR / lane_major arg): the
         # window programs carry state.NODE_HOT_LEAVES transposed (N, C) —
         # the Pallas kernels' layout — killing the per-kernel-boundary
@@ -713,6 +768,13 @@ class BatchedSimulation:
         # (instrumented modes, gauge collection, fast-forward) — the
         # silent-fallback observable bench.py --smoke asserts on, now
         # visible in every telemetry_report.
+        # feeder_slabs_produced mirrors the streaming feeder's production
+        # counter (0 on non-streaming engines): stage_refills counts slabs
+        # the dispatch loop INSTALLED, feeder_slabs_produced counts slabs
+        # the producer BUILT — produced >> installed means wasted
+        # production (stride too small), produced == installed with
+        # feeder-not-ready stalls means a starved feeder (raise
+        # stream_depth / widen segments). Both land in telemetry_report.
         self.dispatch_stats = {
             "window_chunks": 0,
             "fused_slides": 0,
@@ -722,6 +784,7 @@ class BatchedSimulation:
             "superspans": 0,
             "superspan_spans": 0,
             "stage_refills": 0,
+            "feeder_slabs_produced": 0,
             "ladder_fallbacks": 0,
         }
         self._use_pallas_requested = use_pallas
@@ -1206,6 +1269,7 @@ class BatchedSimulation:
             and self.mesh is not None
             and is_cross_process(self.mesh)
             and self._device_slide is None
+            and not self._stream_on()
         ):
             raise ValueError(
                 "pod_window on a cross-process mesh requires the "
@@ -1237,6 +1301,13 @@ class BatchedSimulation:
         budget."""
         self._device_slide = None
         if self.pod_window is None or self._full_pods is None:
+            return
+        if self._stream_on():
+            # Streaming ingestion: the whole-trace payload is exactly what
+            # the feeder exists to NOT materialize — the superspan loop
+            # stages bounded slabs through the ring instead, and device
+            # staging memory stays O(stream_depth x segment) regardless of
+            # trace length.
             return
         full = self._full_pods
         T = full["req_cpu"].shape[1]
@@ -1699,28 +1770,30 @@ class BatchedSimulation:
 
     def _stage_width(self) -> int:
         """Static column count of the superspan staging slab when the
-        whole-trace payload is over budget: W windows of shift headroom
-        would starve a max (W/2) slide, so the default is 4W (3W of shift
-        headroom per stage), clamped to the whole padded payload."""
+        whole-trace payload is over budget (or streaming keeps it bounded
+        unconditionally): W windows of shift headroom would starve a max
+        (W/2) slide, so the default is 4W (3W of shift headroom per
+        stage), clamped to the whole padded payload. A streaming engine's
+        stream_segment (KTPU_STREAM_SEGMENT) overrides the default — the
+        per-slab memory knob of the feeder ring."""
         W = self.pod_window
         T = int(self.consts.trace_pod_bound)
-        want = (
-            self._superspan_stage_cols
-            if self._superspan_stage_cols is not None
-            else 4 * W
-        )
+        if self._stream_on() and self._stream_segment is not None:
+            want = self._stream_segment
+        elif self._superspan_stage_cols is not None:
+            want = self._superspan_stage_cols
+        else:
+            want = 4 * W
         return min(max(want, W + max(W // 2, 1)), T + W)
 
-    def _make_stage(self, lo: int, width: int) -> RefillStage:
-        """Assemble + upload one staging slab covering payload columns
-        [lo, lo + width) (trace_compile.stage_segment owns the layout and
-        padding rules; the device pair conversion mirrors
-        _init_device_slide)."""
-        from kubernetriks_tpu.batched.state import duration_pair_np
+    def _stage_arrays(self, lo: int, width: int) -> dict:
+        """Host half of staging-slab construction: the numpy segment
+        payload for columns [lo, lo + width)
+        (trace_compile.stage_segment owns the layout and padding rules).
+        Pure host numpy — safe to call from the feeder thread."""
         from kubernetriks_tpu.batched.trace_compile import stage_segment
 
-        t0 = self.tracer.begin()
-        seg = stage_segment(
+        return stage_segment(
             self._full_pods,
             self._pod_create_win,
             (
@@ -1731,11 +1804,17 @@ class BatchedSimulation:
             lo,
             width,
         )
+
+    def _stage_upload(self, seg: dict) -> RefillStage:
+        """Device half: pair conversion + upload + mesh placement of an
+        assembled segment (mirrors _init_device_slide). Host-to-device
+        only — safe from the feeder thread (the sanitizer's d2h transfer
+        guard is engine-thread-local and never applies here)."""
+        from kubernetriks_tpu.batched.state import duration_pair_np
+
         dur = duration_pair_np(
             seg.pop("duration"), self.config.scheduling_cycle_interval
         )
-        self.tracer.end(PH_STAGE_ASSEMBLE, t0)
-        t0 = self.tracer.begin()
         stage = RefillStage(
             req_cpu=jnp.asarray(seg["req_cpu"]),
             req_ram=jnp.asarray(seg["req_ram"]),
@@ -1759,8 +1838,61 @@ class BatchedSimulation:
                 stage,
                 jax.tree.map(lambda _: row, stage),
             )
+        return stage
+
+    def _make_stage(self, lo: int, width: int) -> RefillStage:
+        """Assemble + upload one staging slab covering payload columns
+        [lo, lo + width) ON the engine thread (the non-streaming bounded
+        path); the streaming feeder builds slabs through the same two
+        halves off-thread."""
+        t0 = self.tracer.begin()
+        seg = self._stage_arrays(lo, width)
+        self.tracer.end(PH_STAGE_ASSEMBLE, t0)
+        t0 = self.tracer.begin()
+        stage = self._stage_upload(seg)
         self.tracer.end(PH_STAGE_PUT, t0)
         return stage
+
+    # --- streaming feeder lifecycle ----------------------------------------
+
+    def _stream_on(self) -> bool:
+        """Whether the streaming pipeline stages this engine's slabs: the
+        sliding window exists and the superspan executor is selected (the
+        feeder stages for run_superspan's bounded RefillStage path; the
+        ladder/instrumented fallbacks keep their own slide machinery)."""
+        return (
+            self._stream and self._superspan and self.pod_window is not None
+        )
+
+    def _ensure_feeder(self):
+        """The live StreamFeeder, built lazily at the current base and
+        geometry (stage width is a jit static, so the feeder is re-built —
+        re-seeked — whenever geometry or base moves non-monotonically:
+        window growth, checkpoint restore)."""
+        if self._feeder is None:
+            from kubernetriks_tpu.batched.stream import StreamFeeder
+
+            W = self.pod_window
+            self._feeder = StreamFeeder(
+                self._stage_arrays,
+                self._stage_upload,
+                base=self._pod_base,
+                width=self._stage_width(),
+                window=W,
+                trace_cols=int(self.consts.trace_pod_bound) + W,
+                depth=self._stream_depth,
+            )
+        return self._feeder
+
+    def _close_feeder(self) -> None:
+        """Stop + drop the feeder (re-seek half 1): the next staged
+        dispatch rebuilds it at the then-current base and geometry. Slab
+        content is a pure function of (lo, width), so a rebuilt feeder
+        can never diverge from the one it replaces."""
+        if self._feeder is not None:
+            self._feeder_produced_total += self._feeder.produced
+            self._feeder.close()
+            self._feeder = None
 
     def _stage_covers(self, lo: int, stage: RefillStage) -> bool:
         """A stage serves a dispatch at the current pod_base iff the base
@@ -1774,10 +1906,24 @@ class BatchedSimulation:
         )
 
     def _current_stage(self):
-        """(stage, lo) for the next superspan dispatch. Whole-trace payload
-        engines wrap it directly (lo = 0, zero-copy, never restages);
-        over-budget engines install the double-buffered successor when it
-        covers the current base, else rebuild at the base."""
+        """(stage, lo) for the next superspan dispatch. Streaming engines
+        draw from the feeder ring (the producer runs ahead; a not-ready
+        slab blocks here with the stall split recorded); whole-trace
+        payload engines wrap it directly (lo = 0, zero-copy, never
+        restages); over-budget engines install the double-buffered
+        successor when it covers the current base, else rebuild at the
+        base."""
+        if self._stream_on():
+            feeder = self._ensure_feeder()
+            stage, lo, fresh = feeder.get_stage(
+                self._pod_base, tracer=self.tracer
+            )
+            if fresh:
+                self.dispatch_stats["stage_refills"] += 1
+            self.dispatch_stats["feeder_slabs_produced"] = (
+                self._feeder_produced_total + feeder.produced
+            )
+            return stage, lo
         if self._device_slide is not None:
             pay = self._device_slide
             return (
@@ -1820,7 +1966,10 @@ class BatchedSimulation:
         H2D transfer overlap device compute instead of serializing at the
         span boundary (the generalization of the ladder path's
         _prefetch_refill)."""
-        if self._device_slide is not None:
+        if self._device_slide is not None or self._stream_on():
+            # Streaming engines need no consumer-side prefetch nudge: the
+            # feeder's producer thread runs the slab schedule ahead on its
+            # own (the K-deep generalization of this 2-deep hook).
             return
         W = self.pod_window
         Lw = self._stage_width()
@@ -1925,7 +2074,13 @@ class BatchedSimulation:
                 # drop it — _current_stage then installs the prefetched
                 # successor, or rebuilds at the new base (L - W >= W/2 of
                 # fresh headroom, so the retried slide always lands and the
-                # dispatch loop can't spin on an exhausted buffer).
+                # dispatch loop can't spin on an exhausted buffer). The
+                # streaming ring RETIRES the slab instead: the feeder
+                # asserts a retired slab is never re-offered, so the
+                # spin-on-exhausted-buffer bug class is structurally
+                # pinned rather than relying on this drop.
+                if self._feeder is not None:
+                    self._feeder.retire(lo)
                 self._stage_cur = None
             # SUPERSPAN_RUN with w <= target: K-span budget hit; redispatch.
 
@@ -2217,15 +2372,23 @@ class BatchedSimulation:
             return False
         new_W = min(2 * W, T)
         insert = new_W - W
+        # Re-seek half of the streaming pipeline: the stage width is keyed
+        # to W, so the feeder's slabs are stale after growth — close it
+        # BEFORE mutating the payload tables its assemble callback reads
+        # (close joins the producer thread; the next staged dispatch
+        # rebuilds at the grown geometry).
+        self._close_feeder()
         # Cross-process meshes REQUIRE the device-resident slide payload
-        # (the host path calls to_host on non-addressable shards); check the
-        # grown payload against the budget BEFORE mutating anything, so the
+        # (the host path calls to_host on non-addressable shards) unless
+        # the streaming feeder stages slabs instead; check the grown
+        # payload against the budget BEFORE mutating anything, so the
         # raise leaves the engine consistent (same predicate as
         # _init_device_slide).
         if (
             self.mesh is not None
             and is_cross_process(self.mesh)
             and self._full_pods is not None
+            and not self._stream_on()
             and not self._slide_payload_fits(new_W)
         ):
             raise ValueError(
@@ -2285,6 +2448,7 @@ class BatchedSimulation:
             self.mesh is not None
             and is_cross_process(self.mesh)
             and self._device_slide is None
+            and not self._stream_on()
         ):
             # Not an assert: this consistency check must survive python -O —
             # silently continuing on a cross-process mesh without the
@@ -2692,9 +2856,24 @@ class BatchedSimulation:
         stage-prefetch hit/miss counts, the dispatch-chunk histogram, and
         the device ring's totals. Callable with telemetry off (dispatch
         stats only, enabled: False)."""
+        feeder_rep = None
+        if self._feeder is not None:
+            # ONE snapshot under the feeder's lock: syncing dispatch_stats
+            # from the same report keeps the cumulative counter a superset
+            # of the section even while the producer is mid-publish.
+            feeder_rep = self._feeder.report()
+            self.dispatch_stats["feeder_slabs_produced"] = (
+                self._feeder_produced_total + feeder_rep["slabs_produced"]
+            )
         stats = dict(self.dispatch_stats)
         rep = {"enabled": self._telemetry, "dispatch_stats": stats}
         rep.update(self.tracer.report())
+        if feeder_rep is not None:
+            # Streaming-feeder section: production counters, the
+            # ring-depth gauge, and the stall split (feeder-not-ready vs
+            # upload-wait — the same two numbers the stage_wait_* tracer
+            # spans carry, kept here so untraced runs still expose them).
+            rep["feeder"] = feeder_rep
         rep["sync_budget"] = {
             "steady_state_expected": stats["superspans"]
             + stats["fused_slides"],
@@ -2721,6 +2900,11 @@ class BatchedSimulation:
             _PN[PH_SUPERSPAN],
             _PN[PH_PROGRESS_WAIT],
             _PN[PH_SHIFT_WAIT],
+            # Streaming-feeder stalls block the dispatch loop exactly like
+            # the readback waits, so they belong to the per-window cost
+            # (zero on non-streaming runs — continuity with r7-r9 numbers).
+            _PN[PH_STAGE_WAIT_FEEDER],
+            _PN[PH_STAGE_WAIT_UPLOAD],
             "chunk_fenced",
         )
         win_ms = sum(
@@ -2768,6 +2952,14 @@ class BatchedSimulation:
                 wins, data, self.config.scheduling_cycle_interval
             )
         return self.tracer.write_chrome_trace(path, extra)
+
+    def close(self) -> None:
+        """Release background resources — currently the streaming
+        feeder's producer thread. Idempotent and optional: the producer
+        is a daemon that exits with the process (and on its own once the
+        final slab is published), but long-lived hosts building many
+        engines should close the ones they abandon."""
+        self._close_feeder()
 
     # --- checkpoint / resume ------------------------------------------------
     # The whole simulation state is one pytree of arrays, so checkpointing is
@@ -2869,6 +3061,16 @@ class BatchedSimulation:
             self.state = restored["state"]
             self.next_window_idx = int(restored["next_window_idx"])
             self._pod_base = int(np.asarray(self.state.pod_base)[0])
+            # Re-seek the streaming feeder (and drop engine-held staging
+            # slabs): the restored base may precede everything staged so
+            # far, and the ring's never-re-offer invariant makes serving
+            # an earlier base an assertion — the rebuilt feeder restarts
+            # its slab schedule at the restored base instead of replaying
+            # (slab content is position-keyed, so no replay divergence is
+            # possible either way).
+            self._close_feeder()
+            self._stage_cur = None
+            self._stage_next = None
             self._refresh_name_ranks()
             self._gauges = GaugeSeries.load_sidecar(
                 os.path.abspath(path) + ".gauges.npz"

@@ -26,7 +26,7 @@ from typing import Dict, NamedTuple, Optional
 
 class Flag(NamedTuple):
     name: str
-    type: str  # "bool" | "tristate" | "str"
+    type: str  # "bool" | "tristate" | "str" | "int"
     default: object
     doc: str
 
@@ -55,6 +55,36 @@ _FLAGS = [
         "Superspan executor: one jitted while_loop retires up to K "
         "consecutive slide-spans per dispatch. Unset: on for accelerator "
         "backends, off on CPU hosts.",
+    ),
+    Flag(
+        "KTPU_STREAM",
+        "tristate",
+        None,
+        "Streaming trace-ingestion pipeline (batched/stream.py): a feeder "
+        "thread compiles trace segments into a bounded ring of K "
+        "device-resident staging slabs, running ahead of the superspan "
+        "executor so stage-exhaustion exits find the next slab already "
+        "uploaded and the whole-trace device slide payload is never "
+        "materialized. Rides the superspan executor (inactive when "
+        "KTPU_SUPERSPAN is off). Unset: on for accelerator backends, off "
+        "on CPU hosts — the same platform default as KTPU_SUPERSPAN.",
+    ),
+    Flag(
+        "KTPU_STREAM_DEPTH",
+        "int",
+        3,
+        "Ring depth K of the streaming feeder: at most K staging slabs "
+        "live on device at once (the memory bound). K = 1 degenerates to "
+        "synchronous-but-off-thread staging and stays exact.",
+    ),
+    Flag(
+        "KTPU_STREAM_SEGMENT",
+        "int",
+        None,
+        "Staging-segment width (payload columns) of the streaming "
+        "feeder's slabs. Unset: the superspan stage default (4x the pod "
+        "window, clamped to [W + W/2, whole payload]). Width is a jit "
+        "static — changing it recompiles the superspan program.",
     ),
     Flag(
         "KTPU_LANE_MAJOR",
@@ -232,3 +262,19 @@ def flag_str(name: str) -> Optional[str]:
     if raw is None:
         return flag.default  # type: ignore[return-value]
     return raw
+
+
+def flag_int(name: str) -> Optional[int]:
+    """Integer flag: unset or empty -> registered default (may be None);
+    anything else must parse as a base-10 integer (a typo'd value raises
+    here, at the registry, instead of silently selecting a default)."""
+    flag = _lookup(name, "int")
+    raw = os.environ.get(name)
+    if raw is None or raw.strip() == "":
+        return flag.default  # type: ignore[return-value]
+    try:
+        return int(raw.strip(), 10)
+    except ValueError as exc:
+        raise ValueError(
+            f"environment flag {name!r} must be an integer, got {raw!r}"
+        ) from exc

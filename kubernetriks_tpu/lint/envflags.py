@@ -9,7 +9,7 @@ parser) is the single owner; this pass enforces it:
 - any `os.environ.get` / `os.getenv` / `os.environ[...]` /
   `... in os.environ` READ of a literal KTPU_* or KUBERNETRIKS_* name
   outside flags.py is a violation — call `flags.flag_bool` /
-  `flag_tristate` / `flag_str` instead;
+  `flag_tristate` / `flag_str` / `flag_int` instead;
 - a read (anywhere, flags.py included) of a name not in the registry is a
   violation — declare it first.
 
@@ -79,8 +79,8 @@ def check(ctx: LintContext) -> List[Violation]:
                         PASS_ID,
                         f"direct environment read of {key!r}: go through "
                         "kubernetriks_tpu.flags (flag_bool / flag_tristate "
-                        "/ flag_str) so the name, type, default and "
-                        "truthiness rule live in the registry",
+                        "/ flag_str / flag_int) so the name, type, default "
+                        "and truthiness rule live in the registry",
                     )
                 )
             if key not in registry:

@@ -50,6 +50,13 @@ PH_CKPT_SAVE = 11  # checkpoint save I/O
 PH_CKPT_RESTORE = 12  # checkpoint restore I/O
 PH_PRECOMPILE = 13  # AOT warm-up of dispatch program shapes
 PH_CHUNK_FENCED = 14  # instrumented dispatch + device fence (profiled runs)
+# Streaming feeder stall split (batched/stream.py): the engine thread
+# waited for a staging slab the producer had not PUBLISHED yet (assembly /
+# ring backlog bound) vs a published slab whose H2D transfer had not
+# SETTLED (transfer bound). Both recorded with explicit durations via
+# end(phase, t0, dur=...) from the feeder's consumer side.
+PH_STAGE_WAIT_FEEDER = 15
+PH_STAGE_WAIT_UPLOAD = 16
 
 PHASE_NAMES = (
     "window_chunk",
@@ -67,6 +74,8 @@ PHASE_NAMES = (
     "ckpt_restore",
     "precompile",
     "chunk_fenced",
+    "stage_wait_feeder",
+    "stage_wait_upload",
 )
 
 _N_PHASES = len(PHASE_NAMES)

@@ -94,6 +94,11 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.feeder_workload_fill.argtypes = [
             ctypes.c_void_p, f64p, i64p, i64p, f64p, i64p, i64p, i64p,
         ]
+        lib.feeder_workload_fill_range.restype = None
+        lib.feeder_workload_fill_range.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            f64p, i64p, i64p, f64p, i64p, i64p, i64p,
+        ]
         lib.feeder_machine_fill.restype = None
         lib.feeder_machine_fill.argtypes = [ctypes.c_void_p, f64p, i32p, i64p, i64p, i64p]
         lib.feeder_free.restype = None
@@ -187,6 +192,120 @@ def load_workload_arrays(
         return out
     finally:
         lib.feeder_free(ctypes.c_void_p(handle))
+
+
+class WorkloadSegmentReader:
+    """Keep-alive handle over the natively parsed workload: pulls sorted
+    rows [lo, lo + n) as bounded WorkloadArrays segments instead of
+    materializing every column Python-side at once — the TRACE half of
+    the streaming ingestion pipeline (batched/stream.py stages payload
+    segments; this is the seam that feeds them for multi-million-row
+    Alibaba replays: the compact parsed representation stays native-side,
+    and the Python working set is one segment).
+
+    Usage:
+        with WorkloadSegmentReader(bi_path, bt_path) as r:
+            for seg in r.iter_segments(rows_per_segment=1_000_000):
+                ...  # seg is a WorkloadArrays over one row range
+
+    Segment reads are pure slices of the one stable time-sort the parse
+    performed, so concatenating every segment reproduces
+    load_workload_arrays exactly (pinned in tests/test_native_feeder.py).
+    """
+
+    def __init__(self, batch_instance_path: str, batch_task_path: str):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(f"native feeder unavailable: {_build_error}")
+        self._lib = lib
+        self._handle: Optional[int] = _take_handle(
+            lib,
+            lib.feeder_parse_workload(
+                batch_instance_path.encode(), batch_task_path.encode()
+            ),
+        )
+        self._count = int(
+            lib.feeder_workload_count(ctypes.c_void_p(self._handle))
+        )
+
+    def __len__(self) -> int:
+        return self._count
+
+    def read(self, lo: int, n: int) -> WorkloadArrays:
+        """Rows [lo, lo + n) of the sorted workload (clamped to the end)."""
+        if self._handle is None:
+            raise ValueError("WorkloadSegmentReader is closed")
+        if lo < 0:
+            raise ValueError(f"segment lo must be >= 0, got {lo}")
+        n = max(0, min(n, self._count - lo))
+        out = WorkloadArrays(
+            start_ts=np.empty(n, np.float64),
+            cpu_millicores=np.empty(n, np.int64),
+            ram_bytes=np.empty(n, np.int64),
+            duration=np.empty(n, np.float64),
+            job_id=np.empty(n, np.int64),
+            task_id=np.empty(n, np.int64),
+            pod_no=np.empty(n, np.int64),
+        )
+        if n:
+            self._lib.feeder_workload_fill_range(
+                ctypes.c_void_p(self._handle), lo, n,
+                out.start_ts, out.cpu_millicores, out.ram_bytes,
+                out.duration, out.job_id, out.task_id, out.pod_no,
+            )
+        return out
+
+    def iter_segments(self, rows_per_segment: int):
+        """Yield (lo, WorkloadArrays) covering the whole workload in order."""
+        if rows_per_segment <= 0:
+            raise ValueError("rows_per_segment must be positive")
+        lo = 0
+        while lo < self._count:
+            yield lo, self.read(lo, rows_per_segment)
+            lo += rows_per_segment
+
+    def close(self) -> None:
+        if self._handle is not None:
+            self._lib.feeder_free(ctypes.c_void_p(self._handle))
+            self._handle = None
+
+    def __enter__(self) -> "WorkloadSegmentReader":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self) -> None:
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def iter_workload_segments(
+    arrays: WorkloadArrays, rows_per_segment: int
+):
+    """Python-oracle mirror of WorkloadSegmentReader.iter_segments over an
+    already-materialized WorkloadArrays (the fallback path when no native
+    toolchain exists): yields (lo, WorkloadArrays) row-range views with
+    identical semantics, so callers of either source see the same segment
+    stream."""
+    if rows_per_segment <= 0:
+        raise ValueError("rows_per_segment must be positive")
+    total = len(arrays.start_ts)
+    lo = 0
+    while lo < total:
+        hi = min(lo + rows_per_segment, total)
+        yield lo, WorkloadArrays(
+            start_ts=arrays.start_ts[lo:hi],
+            cpu_millicores=arrays.cpu_millicores[lo:hi],
+            ram_bytes=arrays.ram_bytes[lo:hi],
+            duration=arrays.duration[lo:hi],
+            job_id=arrays.job_id[lo:hi],
+            task_id=arrays.task_id[lo:hi],
+            pod_no=arrays.pod_no[lo:hi],
+        )
+        lo = hi
 
 
 def load_cluster_arrays(machine_events_path: str) -> ClusterArrays:

@@ -482,6 +482,32 @@ void feeder_workload_fill(Handle* h, double* start_ts, int64_t* cpu,
   std::memcpy(pod_no, h->pod_no.data(), n * sizeof(int64_t));
 }
 
+void feeder_workload_fill_range(Handle* h, int64_t lo, int64_t n,
+                                double* start_ts, int64_t* cpu, int64_t* ram,
+                                double* duration, int64_t* job_id,
+                                int64_t* task_id, int64_t* pod_no) {
+  // Segment-at-a-time iteration for the streaming ingestion pipeline
+  // (kubernetriks_tpu/batched/stream.py): callers pull rows [lo, lo + n)
+  // of the sorted workload without materializing the whole columns on the
+  // Python side — the compact parsed representation stays native-side and
+  // each pull copies one bounded segment. Bounds are clamped defensively;
+  // the Python binding validates them first.
+  int64_t total = static_cast<int64_t>(h->start_ts.size());
+  if (lo < 0) lo = 0;
+  if (lo > total) lo = total;
+  if (n > total - lo) n = total - lo;
+  if (n <= 0) return;
+  size_t c = static_cast<size_t>(n);
+  size_t off = static_cast<size_t>(lo);
+  std::memcpy(start_ts, h->start_ts.data() + off, c * sizeof(double));
+  std::memcpy(cpu, h->cpu_millicores.data() + off, c * sizeof(int64_t));
+  std::memcpy(ram, h->ram_bytes.data() + off, c * sizeof(int64_t));
+  std::memcpy(duration, h->duration.data() + off, c * sizeof(double));
+  std::memcpy(job_id, h->job_id.data() + off, c * sizeof(int64_t));
+  std::memcpy(task_id, h->task_id.data() + off, c * sizeof(int64_t));
+  std::memcpy(pod_no, h->pod_no.data() + off, c * sizeof(int64_t));
+}
+
 int64_t feeder_machine_count(Handle* h) {
   return static_cast<int64_t>(h->m_ts.size());
 }

@@ -10,7 +10,36 @@ sys.path.insert(
     0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts")
 )
 
+import pytest
+
 import bench_mesh
+
+
+@pytest.mark.slow
+def test_bench_mesh_composed_smoke_streams_on_virtual_mesh():
+    """--composed --smoke: the composed + chaos flagship shard_mapped
+    over the 8-device virtual mesh with the STREAMING feeder staging
+    every slab — the dry-run form of the MULTICHIP_r06 protocol (ISSUE
+    10). Slow: the sharded composed superspan program is a heavy CPU
+    compile; CI runs the same line as its own step and uploads the JSON
+    artifact."""
+    result = bench_mesh.run_mesh_composed(
+        8, clusters_per_device=2, n_nodes=8, smoke=True
+    )
+    assert result["devices"] == 8
+    assert result["platform"] == "cpu"
+    assert result["measured"] is True
+    assert result["value"] > 0
+    assert result["spans"]["n"] >= 5
+    budget = result["slide_budget"]
+    assert budget["streaming_ring_bound_bytes"] > 0
+    assert budget["budget_bytes"] == 2 << 30
+    tel = result["telemetry"]
+    assert tel["dispatch_stats"]["superspans"] > 0
+    assert tel["dispatch_stats"]["feeder_slabs_produced"] > 0
+    assert set(tel["feeder"]["stalls"]) == {
+        "feeder_not_ready", "upload_wait",
+    }
 
 
 def test_bench_mesh_smoke_runs_on_virtual_mesh():

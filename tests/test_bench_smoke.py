@@ -1,10 +1,12 @@
 """bench.py --smoke: the CPU-safe plumbing check for the tracked bench
 lines (continuity shape, composed flagship, superspan machinery,
-north-star stand-in). Asserts every line builds, RUNS its full machinery —
-the composed lines include real window slides, HPA scale-ups and CA
-provisioning, the same in-bench asserts the flagship line enforces on
-hardware; the superspan line additionally asserts the SCANNED executor
-dispatched (so CI catches a silent fallback to the ladder path) — and
+streaming feeder, north-star stand-in). Asserts every line builds, RUNS
+its full machinery — the composed lines include real window slides, HPA
+scale-ups and CA provisioning, the same in-bench asserts the flagship
+line enforces on hardware; the superspan line additionally asserts the
+SCANNED executor dispatched (so CI catches a silent fallback to the
+ladder path), and the streaming line asserts the FEEDER ring staged the
+run (so CI catches a silent fallback to whole-trace staging) — and
 emits parseable JSON with the headline fields. Composed lines time >= 5
 repeated spans and carry the median + min/max spread. Values are not
 performance numbers; tier-1 runs this under JAX_PLATFORMS=cpu (conftest
@@ -36,22 +38,23 @@ def _smoke_records(capsys, args):
     return records
 
 
-def test_bench_smoke_emits_four_parseable_lines(capsys, tmp_path, monkeypatch):
+def test_bench_smoke_emits_five_parseable_lines(capsys, tmp_path, monkeypatch):
     # --trace rides along (the CI smoke job runs it this way): the
     # composed lines must carry the flight-recorder summary AND write a
     # Perfetto-loadable Chrome trace per traced line.
     monkeypatch.setenv("KTPU_TRACE_PATH", str(tmp_path / "ktpu_trace"))
     records = _smoke_records(capsys, ["--smoke", "--trace"])
-    assert len(records) == 4, records
+    assert len(records) == 5, records
     # Line order is part of the contract: continuity, composed, superspan
-    # machinery, north-star (the LAST line is the headline the driver
-    # reads).
+    # machinery, streaming feeder, north-star (the LAST line is the
+    # headline the driver reads).
     assert "composed" in records[1]["metric"]
     assert "superspan" in records[2]["metric"]
-    assert "north-star" in records[3]["metric"]
+    assert "streaming" in records[3]["metric"]
+    assert "north-star" in records[4]["metric"]
     # Composed lines report the >= 5-span median with min/max spread; the
     # plain-shape lines keep the bare single-region value.
-    for rec in records[1:3]:
+    for rec in records[1:4]:
         spans = rec["spans"]
         assert spans["n"] >= 5
         assert spans["min"] <= rec["value"] <= spans["max"]
@@ -60,13 +63,13 @@ def test_bench_smoke_emits_four_parseable_lines(capsys, tmp_path, monkeypatch):
         # committed decisions — spans.min == 0 can no longer happen.
         assert spans["dropped"] >= 0
         assert spans["min"] > 0
-    assert "spans" not in records[0] and "spans" not in records[3]
+    assert "spans" not in records[0] and "spans" not in records[4]
     # Telemetry summary embedded in (exactly) the traced composed lines:
     # per-phase wall time, the observed-vs-expected sync budget, dispatch
     # stats with the ladder_fallbacks observable, device-ring totals.
-    for rec in (records[0], records[3]):
+    for rec in (records[0], records[4]):
         assert "telemetry" not in rec
-    for rec in records[1:3]:
+    for rec in records[1:4]:
         tel = rec["telemetry"]
         assert tel["spans_ms"]
         assert tel["sync_budget"]["observed_slide_syncs"] >= 0
@@ -87,7 +90,26 @@ def test_bench_smoke_emits_four_parseable_lines(capsys, tmp_path, monkeypatch):
         tel["sync_budget"]["observed_slide_syncs"]
         == tel["sync_budget"]["steady_state_expected"]
     )
-    for label in ("smoke_composed", "smoke_superspan"):
+    # The streaming line's trace shows the feeder pipeline: slabs
+    # produced AND installed, the whole-trace payload never materialized
+    # (dispatch stats make a starved feeder observable: production vs
+    # installs plus the stall split in the feeder section), sync budget
+    # still exactly one progress readback per superspan.
+    tel = records[3]["telemetry"]
+    assert tel["dispatch_stats"]["superspans"] > 0
+    assert tel["dispatch_stats"]["feeder_slabs_produced"] > 0
+    assert tel["dispatch_stats"]["stage_refills"] > 0
+    assert (
+        tel["sync_budget"]["observed_slide_syncs"]
+        == tel["sync_budget"]["steady_state_expected"]
+    )
+    feeder = tel["feeder"]
+    # dispatch_stats is cumulative across feeder re-seeks (window growth);
+    # the feeder section describes the LAST feeder generation.
+    assert feeder["slabs_produced"] <= tel["dispatch_stats"]["feeder_slabs_produced"]
+    assert feeder["ring_depth_high_water"] <= feeder["ring_capacity"]
+    assert set(feeder["stalls"]) == {"feeder_not_ready", "upload_wait"}
+    for label in ("smoke_composed", "smoke_superspan", "smoke_stream"):
         path = tmp_path / f"ktpu_trace_{label}.json"
         assert path.exists(), f"missing Chrome trace {path}"
         doc = json.loads(path.read_text())
@@ -96,14 +118,14 @@ def test_bench_smoke_emits_four_parseable_lines(capsys, tmp_path, monkeypatch):
 
 def test_bench_smoke_faults_adds_chaos_line(capsys, tmp_path, monkeypatch):
     """--faults appends a fault-enabled composed smoke line (the chaos
-    engine's dispatch/throughput tracker) after the standard four.
+    engine's dispatch/throughput tracker) after the standard five.
     --trace rides along so the traced composed lines are jit-cache hits
     from the previous test (same programs); the chaos line itself is
     untraced either way."""
     monkeypatch.setenv("KTPU_TRACE_PATH", str(tmp_path / "ktpu_trace"))
     records = _smoke_records(capsys, ["--smoke", "--faults", "--trace"])
-    assert len(records) == 5, records
-    assert "chaos" in records[4]["metric"]
-    assert records[4]["value"] > 0
-    assert records[4]["spans"]["n"] >= 5
-    assert "telemetry" not in records[4]
+    assert len(records) == 6, records
+    assert "chaos" in records[5]["metric"]
+    assert records[5]["value"] > 0
+    assert records[5]["spans"]["n"] >= 5
+    assert "telemetry" not in records[5]

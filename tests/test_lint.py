@@ -167,3 +167,20 @@ def test_flag_registry_truthiness(monkeypatch):
         flag_bool("KTPU_NOT_REGISTERED")
     with pytest.raises(TypeError):
         flag_bool("KUBERNETRIKS_LOG")  # registered as str, read as bool
+    # int flags (streaming pipeline knobs): unset/empty -> default, decimal
+    # parses, a typo raises AT the registry instead of selecting a default.
+    from kubernetriks_tpu.flags import flag_int
+
+    monkeypatch.delenv("KTPU_STREAM_DEPTH", raising=False)
+    assert flag_int("KTPU_STREAM_DEPTH") == 3
+    monkeypatch.setenv("KTPU_STREAM_DEPTH", " 5 ")
+    assert flag_int("KTPU_STREAM_DEPTH") == 5
+    monkeypatch.setenv("KTPU_STREAM_DEPTH", "")
+    assert flag_int("KTPU_STREAM_DEPTH") == 3
+    monkeypatch.setenv("KTPU_STREAM_DEPTH", "two")
+    with pytest.raises(ValueError):
+        flag_int("KTPU_STREAM_DEPTH")
+    monkeypatch.delenv("KTPU_STREAM_SEGMENT", raising=False)
+    assert flag_int("KTPU_STREAM_SEGMENT") is None
+    with pytest.raises(TypeError):
+        flag_int("KTPU_DEBUG_FINITE")  # registered as bool, read as int

@@ -189,6 +189,42 @@ def test_time_slab_iteration(tmp_path):
     assert covered == arrays.start_ts.tolist()
 
 
+def test_workload_segment_reader_matches_whole_fill(tmp_path):
+    """Segment-at-a-time iteration (the streaming pipeline's trace-side
+    seam): WorkloadSegmentReader pulls bounded row ranges of the natively
+    parsed + sorted workload, and concatenating every segment must
+    reproduce the whole-trace fill bit for bit — same sort, same filters,
+    only the Python-side working set changes. The pure-Python oracle
+    iterator (iter_workload_segments) must yield the identical stream."""
+    import numpy as np
+
+    inst = _write(tmp_path, "bi.csv", WORKLOAD_INSTANCES)
+    task = _write(tmp_path, "bt.csv", WORKLOAD_TASKS)
+    whole = feeder.load_workload_arrays(inst, task)
+
+    with feeder.WorkloadSegmentReader(inst, task) as reader:
+        assert len(reader) == len(whole.start_ts) == 4
+        # Odd segment size: the final segment is a ragged remainder.
+        native_segs = list(reader.iter_segments(rows_per_segment=3))
+        # Out-of-range reads clamp (never over-read the native buffers).
+        tail = reader.read(3, 100)
+        assert len(tail.start_ts) == 1
+        assert reader.read(4, 5).start_ts.size == 0
+    oracle_segs = list(feeder.iter_workload_segments(whole, 3))
+
+    assert [lo for lo, _ in native_segs] == [lo for lo, _ in oracle_segs]
+    for (_, n_seg), (_, o_seg) in zip(native_segs, oracle_segs):
+        for field in (
+            "start_ts", "cpu_millicores", "ram_bytes", "duration",
+            "job_id", "task_id", "pod_no",
+        ):
+            np.testing.assert_array_equal(
+                getattr(n_seg, field), getattr(o_seg, field), err_msg=field
+            )
+    cat = np.concatenate([s.start_ts for _, s in native_segs])
+    np.testing.assert_array_equal(cat, whole.start_ts)
+
+
 def test_compile_from_arrays_matches_event_compile(tmp_path):
     """Dense-array fast path == compile_cluster_trace over the event objects."""
     from kubernetriks_tpu.batched.trace_compile import (

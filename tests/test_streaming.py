@@ -1,0 +1,385 @@
+"""Streaming trace-ingestion pipeline (batched/stream.py + engine wiring).
+
+The feeder generalizes PR 3's double-buffered staging into a K-deep ring
+of device-resident RefillStage slabs produced by a background thread, and
+must change NOTHING about what the simulation computes:
+
+1. Bit-identity: a composed flagship run (HPA + CA + sliding window) with
+   the streaming feeder staging every slab — chaos faults ON — matches
+   the resident whole-trace ladder path on every state leaf and metric.
+   (The resident ladder == fused == resident superspan equalities are
+   pinned by test_window_donation_dispatch.py and test_superspan.py; the
+   streaming-vs-ladder compare closes the square.)
+2. No new host syncs: the steady-state budget stays ONE progress readback
+   per superspan (feeder work rides its own thread), and at identical
+   stage geometry the streaming run's dispatch/sync counts EQUAL the
+   non-streaming bounded double-buffer baseline's.
+3. Segment boundaries: minimal-width slabs force mid-run SUPERSPAN_STAGE
+   exhaustion exits and restages through the ring; run-ahead geometry
+   (stride > 0) restages through slabs produced AHEAD of demand.
+4. K = 1 degenerate ring and checkpoint save/restore mid-stream (the
+   restore re-seeks the feeder; slab content is position-keyed, so no
+   replay divergence is possible).
+5. Bounded memory: a long plain trace runs with a segment budget far
+   below the whole compiled payload and matches the scalar oracle.
+6. The ring never re-offers a spent slab (unit-level, fake slabs).
+"""
+
+import numpy as np
+import pytest
+
+import kubernetriks_tpu.batched.engine as engine_mod
+from kubernetriks_tpu.batched.state import compare_states, strip_telemetry
+from kubernetriks_tpu.batched.stream import StreamFeeder
+
+from test_superspan import FAULT_SUFFIX, _run
+from test_window_donation_dispatch import _build_composed
+
+
+def _stream_build(**kwargs):
+    kwargs.setdefault("superspan", True)
+    kwargs.setdefault("superspan_k", 4)
+    kwargs.setdefault("superspan_chunk", 4)
+    kwargs.setdefault("stream", True)
+    kwargs.setdefault("stream_segment", 96)
+    kwargs.setdefault("stream_depth", 2)
+    return _build_composed(**kwargs)
+
+
+def _assert_streamed(sim):
+    """The feeder really staged the run — no silent fallback to resident
+    whole-trace staging, no ladder dispatches, sync budget intact."""
+    assert sim._device_slide is None
+    assert sim.dispatch_stats["superspans"] > 0
+    assert sim.dispatch_stats["window_chunks"] == 0
+    assert sim.dispatch_stats["stage_refills"] > 0
+    assert (
+        sim.dispatch_stats["feeder_slabs_produced"]
+        >= sim.dispatch_stats["stage_refills"]
+    )
+    # Feeder work rides its own thread, not new host syncs.
+    assert (
+        sim.dispatch_stats["slide_syncs"] == sim.dispatch_stats["superspans"]
+    )
+
+
+@pytest.fixture(scope="module")
+def ladder_fault():
+    return _run(
+        _build_composed(
+            config_suffix=FAULT_SUFFIX, donate=False, fuse_slide=False
+        )
+    )
+
+
+@pytest.fixture(scope="module")
+def ladder_ff():
+    return _run(_build_composed(donate=False, fuse_slide=False))
+
+
+@pytest.fixture(scope="module")
+def stream_ff(ladder_ff):
+    """Fault-free streaming run at minimal stage width (96 = W + W/2):
+    demand-mode staging, several segment-boundary restages — anchored
+    against the resident ladder here, reused by the sync-equality and
+    checkpoint tests below."""
+    sim = _run(_stream_build())
+    _assert_streamed(sim)
+    assert sim.dispatch_stats["stage_refills"] >= 2, (
+        "minimal-width slabs produced no mid-run restage; boundary "
+        "coverage is vacuous"
+    )
+    assert compare_states(strip_telemetry(sim.state), ladder_ff.state) == []
+    assert sim.metrics_summary() == ladder_ff.metrics_summary()
+    return sim
+
+
+def test_streaming_composed_bit_identical_under_faults(ladder_fault):
+    """Flagship composition + chaos: every node-crash chain and
+    commit-time pod-failure draw must land identically when every refill
+    column the on-device slides consume came through the feeder ring."""
+    ss = _run(_stream_build(config_suffix=FAULT_SUFFIX))
+    assert ss.fault_params is not None
+    counters = ss.metrics_summary()["counters"]
+    assert counters["pod_interruptions"] + counters["pods_failed"] > 0, (
+        "fault run produced no faults; parity under faults is vacuous"
+    )
+    _assert_streamed(ss)
+    assert ss.dispatch_stats["stage_refills"] >= 2
+    assert ss._pod_base == ladder_fault._pod_base
+    assert ss.next_window_idx == ladder_fault.next_window_idx
+    assert (
+        compare_states(strip_telemetry(ss.state), ladder_fault.state) == []
+    )
+    assert ss.metrics_summary() == ladder_fault.metrics_summary()
+    np.testing.assert_array_equal(
+        np.asarray(ss.autoscale_statics.pod_name_rank),
+        np.asarray(ladder_fault.autoscale_statics.pod_name_rank),
+    )
+    ss.close()
+
+
+def test_streaming_syncs_equal_bounded_double_buffer(
+    stream_ff, monkeypatch
+):
+    """The no-new-syncs gate against the PR 3 baseline: at identical
+    stage geometry (same slab width, hence the same compiled superspan
+    program), the streaming run's slab schedule reproduces the
+    double-buffered engine's — equal superspan dispatches, equal
+    progress-readback syncs, equal installs — with the assembly moved off
+    the engine thread."""
+    monkeypatch.setattr(engine_mod, "_DEVICE_SLIDE_BUDGET_BYTES", 0)
+    baseline = _run(
+        _build_composed(
+            superspan=True,
+            superspan_k=4,
+            superspan_chunk=4,
+            superspan_stage_cols=96,
+            stream=False,
+            fuse_slide=False,
+        )
+    )
+    assert baseline._device_slide is None and baseline._feeder is None
+    assert compare_states(
+        strip_telemetry(stream_ff.state), baseline.state
+    ) == []
+    for key in ("superspans", "slide_syncs", "stage_refills"):
+        assert (
+            stream_ff.dispatch_stats[key] == baseline.dispatch_stats[key]
+        ), key
+
+
+def test_streaming_run_ahead_restages_through_ring(ladder_ff):
+    """Run-ahead geometry (L = 2W, stride = W/2 > 0): the producer
+    schedules slabs AHEAD of consumption, exhaustion exits install the
+    already-uploaded successor, and the result still matches the resident
+    ladder bit for bit."""
+    sim = _run(_stream_build(stream_segment=128, stream_depth=3))
+    _assert_streamed(sim)
+    rep = sim._feeder.report()
+    assert rep["stride_cols"] > 0, "geometry did not produce run-ahead"
+    assert sim.dispatch_stats["stage_refills"] >= 2
+    assert rep["ring_depth_high_water"] <= 3
+    assert compare_states(strip_telemetry(sim.state), ladder_ff.state) == []
+    assert sim.metrics_summary() == ladder_ff.metrics_summary()
+    sim.close()
+
+
+def test_streaming_k1_degenerate_ring(ladder_ff):
+    """stream_depth=1: the ring holds at most ONE slab (the producer
+    blocks until the consumer frees it) — synchronous-but-off-thread
+    staging, still exact."""
+    sim = _run(_stream_build(stream_segment=128, stream_depth=1))
+    _assert_streamed(sim)
+    rep = sim._feeder.report()
+    assert rep["ring_capacity"] == 1
+    assert rep["ring_depth_high_water"] == 1
+    assert compare_states(strip_telemetry(sim.state), ladder_ff.state) == []
+    sim.close()
+
+
+def test_streaming_checkpoint_restore_reseeks_feeder(stream_ff, tmp_path):
+    """Mid-stream checkpoint: save while the feeder holds live slabs,
+    restore into a FRESH streaming engine, continue — the restore
+    re-seeks the feeder (closed + rebuilt at the restored base, no slab
+    replay) and the continued run matches the uninterrupted one exactly."""
+    first = _stream_build()
+    first.step_until_time(150.0)
+    assert first._feeder is not None, "no slab staged before the save"
+    path = str(tmp_path / "ckpt")
+    first.save_checkpoint(path)
+    first.close()
+
+    resumed = _stream_build()
+    resumed.load_checkpoint(path)
+    assert resumed._feeder is None, "restore must re-seek (drop) the feeder"
+    assert resumed._pod_base == first._pod_base
+    for end in (300.0, 450.0):
+        resumed.step_until_time(end)
+    _assert_streamed(resumed)
+    assert resumed._pod_base == stream_ff._pod_base
+    assert (
+        compare_states(
+            strip_telemetry(resumed.state), strip_telemetry(stream_ff.state)
+        )
+        == []
+    )
+    assert resumed.metrics_summary() == stream_ff.metrics_summary()
+    resumed.close()
+
+
+def test_streaming_long_trace_bounded_memory_vs_scalar_oracle():
+    """The memory-bound acceptance gate: a long plain trace (no
+    autoscalers) streams through slabs whose width is far below the whole
+    compiled payload — the whole-trace device payload is never built, the
+    ring never exceeds its depth, restages happen throughout — and the
+    readout matches the float64 scalar oracle."""
+    from kubernetriks_tpu.batched.engine import build_batched_from_traces
+    from kubernetriks_tpu.sim.simulator import KubernetriksSimulation
+    from kubernetriks_tpu.test_util import default_test_simulation_config
+    from kubernetriks_tpu.trace.generator import UniformClusterTrace
+    from kubernetriks_tpu.trace.generic import GenericWorkloadTrace
+
+    N_PODS, END = 400, 900.0
+
+    def workload_yaml():
+        return GenericWorkloadTrace.from_yaml(
+            "events:"
+            + "".join(
+                f"""
+- timestamp: {1.0 + i}
+  event_type:
+    !CreatePod
+      pod:
+        metadata:
+          name: pod_{i:04d}
+        spec:
+          resources:
+            requests: {{cpu: 100, ram: 104857600}}
+            limits: {{cpu: 100, ram: 104857600}}
+          running_duration: {20.0 + (i % 5) * 5.0}
+"""
+                for i in range(N_PODS)
+            )
+        )
+
+    config = default_test_simulation_config()
+    cluster = UniformClusterTrace(6, cpu=16000, ram=32 * 1024**3)
+
+    scalar = KubernetriksSimulation(config)
+    scalar.initialize(cluster, workload_yaml())
+    scalar.step_until_time(END)
+    sm = scalar.metrics_collector.accumulated_metrics
+
+    sim = build_batched_from_traces(
+        config,
+        UniformClusterTrace(6, cpu=16000, ram=32 * 1024**3)
+        .convert_to_simulator_events(),
+        workload_yaml().convert_to_simulator_events(),
+        n_clusters=1,
+        max_pods_per_cycle=16,
+        pod_window=64,
+        fast_forward=False,
+        superspan=True,
+        superspan_k=8,
+        superspan_chunk=4,
+        stream=True,
+        stream_segment=96,
+        stream_depth=2,
+    )
+    sim.step_until_time(END)
+    _assert_streamed(sim)
+    # Segment budget far below the whole payload, ring bounded, many
+    # segment boundaries crossed.
+    rep = sim._feeder.report() if sim._feeder else None
+    assert rep is not None
+    assert rep["segment_cols"] * 3 < rep["trace_cols"], (
+        "segment budget is not far below the whole payload; the memory "
+        "bound is vacuous"
+    )
+    assert rep["ring_depth_high_water"] <= 2
+    assert sim.dispatch_stats["stage_refills"] >= 3
+    assert sim._pod_base > 0
+
+    bm = sim.metrics_summary()
+    assert bm["counters"]["pods_succeeded"] == sm.pods_succeeded == N_PODS
+    assert bm["counters"]["pods_removed"] == sm.pods_removed
+    assert (
+        bm["counters"]["terminated_pods"] == sm.internal.terminated_pods
+    )
+    for key, est in [
+        ("pod_duration", sm.pod_duration_stats),
+        ("pod_queue_time", sm.pod_queue_time_stats),
+        ("pod_schedule_time", sm.pod_scheduling_algorithm_latency_stats),
+    ]:
+        got = bm["timings"][key]
+        assert got["min"] == pytest.approx(est.min(), rel=1e-4, abs=1e-3), key
+        assert got["max"] == pytest.approx(est.max(), rel=1e-4, abs=1e-3), key
+        assert got["mean"] == pytest.approx(est.mean(), rel=1e-4, abs=1e-3), key
+    sim.close()
+
+
+# --- unit-level ring semantics (fake slabs, no jax) -----------------------
+
+
+def _fake_feeder(**kwargs):
+    def assemble(lo, width):
+        return {"lo": lo, "width": width}
+
+    def upload(seg):
+        return ("slab", seg["lo"], seg["width"])
+
+    kwargs.setdefault("base", 0)
+    kwargs.setdefault("window", 64)
+    kwargs.setdefault("trace_cols", 10_000)
+    return StreamFeeder(assemble, upload, settle=None, **kwargs)
+
+
+def test_feeder_never_reoffers_spent_or_retired_slab():
+    f = _fake_feeder(width=256, depth=2)  # stride 160: run-ahead mode
+    stage, lo, fresh = f.get_stage(0)
+    assert (lo, fresh) == (0, True)
+    assert stage == ("slab", 0, 256)
+    # Serving again without moving is NOT fresh (no double refill count).
+    _, _, fresh = f.get_stage(64)
+    assert not fresh
+    f.retire(0)
+    # The retired slab still COVERS base 100 (0 + 256 - 64 >= 100), but it
+    # must never be served again: the ring's head is now the slab at 160,
+    # and a base below it is a seek error, not a re-offer.
+    with pytest.raises(AssertionError, match="never .e-offered|re-offer"):
+        f.get_stage(100)
+    f.close()
+
+
+def test_feeder_ring_is_bounded_and_runs_ahead():
+    f = _fake_feeder(width=256, depth=2)
+    f.get_stage(0)  # wait until the first slab exists
+    deadline = 200
+    while f.ring_high_water < 2 and deadline:  # producer runs ahead to K
+        deadline -= 1
+        import time as _t
+
+        _t.sleep(0.01)
+    assert f.ring_high_water == 2, "producer never filled the ring to K"
+    # Advance the base across several strides: spent slabs are dropped,
+    # fresh slabs install, the ring NEVER exceeds its depth.
+    served = [f.get_stage(base)[1] for base in (200, 400, 600, 800)]
+    assert served == sorted(served)
+    assert f.ring_high_water <= 2
+    rep = f.report()
+    assert rep["slabs_produced"] >= len(set(served))
+    f.close()
+
+
+def test_feeder_demand_mode_builds_exactly_on_demand():
+    f = _fake_feeder(width=96, depth=2)  # stride 0: demand mode
+    assert not f.ahead
+    _, lo0, _ = f.get_stage(0)
+    assert lo0 == 0
+    f.retire(0)
+    _, lo1, fresh = f.get_stage(40)
+    assert (lo1, fresh) == (40, True)
+    rep = f.report()
+    assert rep["ring_depth_high_water"] == 1  # never runs ahead
+    assert rep["slabs_produced"] == 2
+    f.close()
+
+
+def test_feeder_producer_error_propagates():
+    def assemble(lo, width):
+        raise RuntimeError("boom at lo=%d" % lo)
+
+    f = StreamFeeder(
+        assemble,
+        lambda seg: seg,
+        base=0,
+        width=96,
+        window=64,
+        trace_cols=1000,
+        depth=2,
+        settle=None,
+    )
+    with pytest.raises(RuntimeError, match="stream feeder producer failed"):
+        f.get_stage(0)
+    f.close()
