@@ -30,11 +30,17 @@ chunks.
 short horizons, no ladder precompile) purely to prove the bench plumbing
 runs and parses end-to-end — the values are meaningless as performance
 numbers — plus a superspan-MACHINERY line (scanned executor forced on,
-in-bench asserts fail on silent fallback to the ladder) and a
+in-bench asserts fail on silent fallback to the ladder), a
 streaming-FEEDER line (superspan + the bounded-ring trace-ingestion
 pipeline forced on, in-bench asserts fail on silent fallback to
-whole-trace staging). tests/test_bench_smoke.py pins it under
-JAX_PLATFORMS=cpu.
+whole-trace staging), and a compiled-PROFILE line (the best_fit scheduler
+profile lowered into the decision kernels, in-bench asserts fail on
+silent fallback to the default pipeline). tests/test_bench_smoke.py pins
+it under JAX_PLATFORMS=cpu.
+
+`--profile NAME` runs every tracked line under a named scheduler profile
+(core/scheduler/kube_scheduler.NAMED_PROFILE_SPECS), compiled into the
+scan and Pallas kernel paths at engine build (batched/pipeline.py).
 
 `--trace` arms the flight recorder (kubernetriks_tpu/telemetry) on the
 composed lines: the JSON record gains a "telemetry" summary (per-phase
@@ -54,6 +60,25 @@ import numpy as np
 BASELINE_DECISIONS_PER_SEC_PER_CHIP = 1_000_000 / 8
 
 
+def _assert_profile_compiled(sim, profile, ctx: str) -> None:
+    """Loud no-silent-fallback contract for --profile lines: the requested
+    scheduler profile REALLY compiled into the pipeline (the bug class the
+    compiled-profile subsystem kills), mirroring the superspan/streaming
+    smoke asserts. No-op when no profile was requested."""
+    if profile is None:
+        return
+    from kubernetriks_tpu.batched.pipeline import DEFAULT_PROFILE
+
+    assert sim.profile.name == profile, (
+        f"{ctx}: requested scheduler profile {profile!r} but the engine "
+        f"compiled {sim.profile.name!r}"
+    )
+    assert profile == "default" or sim.profile != DEFAULT_PROFILE, (
+        f"{ctx}: non-default profile silently fell back to the default "
+        "pipeline"
+    )
+
+
 def run_shape(
     n_clusters: int,
     n_nodes: int,
@@ -62,6 +87,7 @@ def run_shape(
     warm_until: float = 190.0,
     t_end: float = 1200.0,
     step: float = 200.0,
+    profile: str = None,  # --profile: named scheduler profile (None = default)
 ) -> float:
     from kubernetriks_tpu.batched.engine import build_batched_from_traces
     from kubernetriks_tpu.config import SimulationConfig
@@ -88,7 +114,9 @@ def run_shape(
         workload.convert_to_simulator_events(),
         n_clusters=n_clusters,
         max_pods_per_cycle=64,
+        scheduler_profile=profile,
     )
+    _assert_profile_compiled(sim, profile, "bench")
 
     def decisions_now() -> int:
         # Device->host fetch of the (C,) decisions counter: a REAL sync
@@ -186,6 +214,7 @@ def run_composed(
     lane_major=None,
     window_razor=None,
     ca_descatter=None,
+    profile=None,  # --profile: named scheduler profile (None = default)
 ) -> dict:
     """The COMPOSED flagship configuration as a tracked line (VERDICT r3
     item 4): HPA pod groups + cluster autoscaler + sliding pod window +
@@ -267,6 +296,7 @@ cluster_autoscaler:
         lane_major=lane_major,
         window_razor=window_razor,
         ca_descatter=ca_descatter,
+        scheduler_profile=profile,
         # --trace arms the flight recorder: host span tracer + device
         # metrics ring. Bit-identical to telemetry-off and inside the <3%
         # overhead gate (tests/test_telemetry.py), so the traced line IS
@@ -275,6 +305,8 @@ cluster_autoscaler:
         # the recorder (a concrete False would override the env flag).
         telemetry=True if trace else None,
     )
+
+    _assert_profile_compiled(sim, profile, "composed bench")
 
     def decisions_now() -> int:
         return int(np.asarray(sim.state.metrics.scheduling_decisions).sum())
@@ -451,6 +483,19 @@ def main(argv=None) -> None:
     # telemetry summary lands in their JSON records and each traced line
     # writes a Perfetto-loadable Chrome trace (see _trace_path).
     trace = "--trace" in args
+    # --profile NAME: run every tracked line under a named scheduler
+    # profile (batched/pipeline.py compiles it into the scan and Pallas
+    # decision kernels; the in-bench asserts fail loudly on a silent
+    # fallback to the default pipeline). Default: the reference profile.
+    profile = None
+    if "--profile" in args:
+        idx = args.index("--profile") + 1
+        if idx >= len(args) or args[idx].startswith("--"):
+            raise SystemExit(
+                "bench: --profile needs a profile name "
+                "(default | best_fit | balanced_packing)"
+            )
+        profile = args[idx]
     if smoke:
         # CPU-safe plumbing check: every line must build, run its full
         # composed machinery (slides, HPA, CA asserts included) and print
@@ -508,6 +553,22 @@ def main(argv=None) -> None:
                          **smoke_composed),
         )
         _emit(
+            # The compiled-PROFILE line: the same toy shape under the
+            # second (best_fit packing) scheduler profile, exercising the
+            # profile -> kernel-static lowering end to end. The in-bench
+            # asserts require the engine really compiled the requested
+            # profile (never a silent fallback to the default pipeline,
+            # mirroring the streaming smoke line) —
+            # tests/test_bench_smoke.py pins this line's presence.
+            # Pinned to best_fit regardless of --profile: this line IS the
+            # second-profile machinery gate, and its label must match what
+            # ran (--profile still steers the non-smoke tracked lines).
+            "pod-scheduling decisions/sec (SMOKE, 4x8-node clusters, "
+            "best_fit profile)",
+            run_shape(4, 8, horizon=200.0, warm_until=90.0, t_end=290.0,
+                      step=100.0, profile="best_fit"),
+        )
+        _emit(
             "pod-scheduling decisions/sec (SMOKE, 4x8-node clusters = "
             "north-star stand-in)",
             # Same shape as the continuity line ON PURPOSE: the second run
@@ -524,28 +585,30 @@ def main(argv=None) -> None:
                 run_composed(4, 8, faults=True, **smoke_composed),
             )
         return
+    suffix = f", {profile} profile" if profile else ""
     if faults:
         _emit(
             "pod-scheduling decisions/sec (single chip, composed flagship + "
-            "chaos faults: crashes/recoveries + CrashLoopBackOff)",
-            run_composed(faults=True),
+            f"chaos faults: crashes/recoveries + CrashLoopBackOff{suffix})",
+            run_composed(faults=True, profile=profile),
         )
     _emit(
-        "pod-scheduling decisions/sec (single chip, 1024x256-node clusters)",
-        run_shape(1024, 256),
+        f"pod-scheduling decisions/sec (single chip, 1024x256-node clusters{suffix})",
+        run_shape(1024, 256, profile=profile),
     )
     _emit(
         "pod-scheduling decisions/sec (single chip, composed flagship: "
-        "256 clusters x HPA+CA+sliding window+Pallas)",
+        f"256 clusters x HPA+CA+sliding window+Pallas{suffix})",
         run_composed(
             trace=trace,
             trace_path=_trace_path("composed") if trace else None,
+            profile=profile,
         ),
     )
     _emit(
         "pod-scheduling decisions/sec (single chip, 1250x1000-node clusters "
-        "= north-star per-chip share)",
-        run_shape(1250, 1000),
+        f"= north-star per-chip share{suffix})",
+        run_shape(1250, 1000, profile=profile),
     )
 
 

@@ -90,9 +90,16 @@ from kubernetriks_tpu.batched.timerep import (
     t_where,
     t_zeros,
 )
+from kubernetriks_tpu.batched.pipeline import DEVICE_FILTER_PLUGINS
+from kubernetriks_tpu.core.scheduler.plugins import FIT
 
 INF = jnp.inf
 _BIG_I32 = jnp.iinfo(jnp.int32).max
+
+# The Fit feasibility predicate, shared with the scheduler pipeline's
+# device-plugin registry: CA placement simulation stays first-fit by
+# reference semantics, but "fits" means the same thing everywhere.
+_fit_filter = DEVICE_FILTER_PLUGINS[FIT]
 
 
 class AutoscaleStatics(NamedTuple):
@@ -618,8 +625,14 @@ def _ca_scale_up(
         valid, rcpu, rram = xs
 
         # First-fit into already-planned nodes, in plan order; fitting pods
-        # deduct from the virtual allocatable (reference :81-87).
-        fit = planned & (rcpu[:, None] <= palloc_cpu) & (rram[:, None] <= palloc_ram)
+        # deduct from the virtual allocatable (reference :81-87). The
+        # feasibility mask is the Fit device plugin — CA placement is
+        # first-fit BY REFERENCE SEMANTICS regardless of the scheduler
+        # profile, but the fit predicate itself is the one registry
+        # definition (batched/pipeline.py).
+        fit = planned & _fit_filter(
+            palloc_cpu, palloc_ram, rcpu[:, None], rram[:, None]
+        )
         any_fit = fit.any(axis=1)
         first = jax.lax.argmin(jnp.where(fit, plan_seq, _BIG_I32), 1, jnp.int32)
         use = valid & any_fit
@@ -1003,8 +1016,7 @@ def _ca_scale_down(
             fit = (
                 nodes.alive
                 & (col_n != slot[:, None])
-                & (rcpu[:, None] <= vcpu)
-                & (rram[:, None] <= vram)
+                & _fit_filter(vcpu, vram, rcpu[:, None], rram[:, None])
             )
             any_fit = fit.any(axis=1)
             # First-fit in NODE-NAME order (the scalar iterates the

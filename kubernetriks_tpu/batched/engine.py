@@ -68,7 +68,7 @@ from kubernetriks_tpu.config import (
     SimulationConfig,
 )
 from kubernetriks_tpu import sanitize
-from kubernetriks_tpu.flags import flag_bool, flag_int, flag_tristate
+from kubernetriks_tpu.flags import flag_bool, flag_int, flag_str, flag_tristate
 from kubernetriks_tpu.telemetry import (
     GaugeSeries,
     NULL_TRACER,
@@ -139,6 +139,7 @@ def _fused_chunk_slide_impl(
     lane_major: bool = False,
     window_razor: bool = True,
     ca_descatter: bool = True,
+    profile=None,
     W: int = 0,
 ):
     """The composed path's steady-state MEGASTEP: one device program runs a
@@ -182,6 +183,7 @@ def _fused_chunk_slide_impl(
             lane_major=lane_major,
             window_razor=window_razor,
             ca_descatter=ca_descatter,
+            profile=profile,
         )
         return new, None
 
@@ -560,8 +562,23 @@ class BatchedSimulation:
         lane_major: Optional[bool] = None,
         window_razor: Optional[bool] = None,
         ca_descatter: Optional[bool] = None,
+        scheduler_profile=None,
     ) -> None:
         self.config = config
+        # Compiled scheduler profile (batched/pipeline.py): the configured
+        # Filter/Score plugin profile lowered to kernel statics. Resolution
+        # order: explicit arg > config.scheduler_profile > KTPU_PROFILE env
+        # (bench/CLI selection) > the reference default. compile_profile
+        # RAISES (UnsupportedProfileError, naming the plugin and the
+        # supported set) on anything the batched path cannot lower —
+        # never a silent fallback to the hard-coded default.
+        from kubernetriks_tpu.batched.pipeline import compile_profile
+
+        if scheduler_profile is None:
+            scheduler_profile = getattr(config, "scheduler_profile", None)
+        if scheduler_profile is None:
+            scheduler_profile = flag_str("KTPU_PROFILE")
+        self.profile = compile_profile(scheduler_profile)
         # Flight recorder (KTPU_TRACE / telemetry arg): host-side span
         # tracer over every dispatch phase + the device-side per-window
         # metrics ring carried in ClusterBatchState (attached below, once
@@ -1212,19 +1229,21 @@ class BatchedSimulation:
                     self.autoscale_statics,
                     self._state_shardings(sharding, self.autoscale_statics),
                 )
-        # Standalone name-rank tables for fault-injection runs WITHOUT
-        # autoscalers (full-resident only): node crashes produce large
-        # same-instant reschedule batches, whose queue order must follow the
-        # scalar's sorted-name walk — the slot-order fallback diverges
-        # there. With autoscalers on, the autoscale statics already carry
-        # the ranks; under a sliding pod window without autoscalers the
+        # Standalone name-rank tables for full-resident runs WITHOUT
+        # autoscalers: same-instant reschedule batches (node crashes under
+        # fault injection, but ALSO plain same-timestamp trace RemoveNode
+        # events) need queue order following the scalar's sorted-name walk —
+        # the slot-order fallback diverges there. Historically these tables
+        # were built only for fault runs; the per-profile equivalence
+        # sweeps surfaced a profile trajectory (balanced_packing, seed 101)
+        # where two trace removals co-reschedule pods and slot order flips
+        # the next cycle's queue, so the ranks are now built for EVERY
+        # full-resident engine (two small int tables, memoized argsort).
+        # With autoscalers on, the autoscale statics already carry the
+        # ranks; under a sliding pod window without autoscalers the
         # slot-order stand-in remains (documented in docs/PARITY.md).
         self._fault_name_ranks = None
-        if (
-            self.fault_params is not None
-            and self.autoscale_statics is None
-            and self.pod_window is None
-        ):
+        if self.autoscale_statics is None and self.pod_window is None:
             BIG_RANK = np.int32(1 << 30)
             nnr = np.full((C, self.n_nodes), BIG_RANK, np.int32)
             pnr = np.full((C, self.n_pods), BIG_RANK, np.int32)
@@ -1434,6 +1453,7 @@ class BatchedSimulation:
             lane_major=self.lane_major,
             window_razor=self.window_razor,
             ca_descatter=self.ca_descatter,
+            profile=self.profile,
         )
 
     def _dispatch_windows(self, idxs: np.ndarray, fuse_slide: bool = False) -> None:
@@ -2616,6 +2636,7 @@ class BatchedSimulation:
             lane_major=self.lane_major,
             window_razor=self.window_razor,
             ca_descatter=self.ca_descatter,
+            profile=self.profile,
         )
         if self.collect_gauges:
             from kubernetriks_tpu.batched.step import gauge_snapshot
@@ -2993,6 +3014,20 @@ class BatchedSimulation:
                 # restore template must carry a matching ring, so record
                 # its capacity for load_checkpoint's loud guard.
                 meta["telemetry_ring"] = int(self._telemetry_ring_size)
+            from kubernetriks_tpu.batched.pipeline import DEFAULT_PROFILE
+
+            if self.profile != DEFAULT_PROFILE:
+                # The compiled scheduler profile is an engine-build static:
+                # restoring this state into an engine compiled with a
+                # different profile would silently continue the run under
+                # different scheduling semantics — record it for
+                # load_checkpoint's loud guard (default-profile saves write
+                # nothing, keeping old checkpoints loadable).
+                meta["scheduler_profile"] = {
+                    "name": self.profile.name,
+                    "filters": list(self.profile.filters),
+                    "scores": [list(s) for s in self.profile.scores],
+                }
             if meta:
                 import json
 
@@ -3043,6 +3078,34 @@ class BatchedSimulation:
                 f"{have_ring} — build with telemetry="
                 f"{saved_ring is not None} and telemetry_ring="
                 f"{saved_ring} (or KTPU_TRACE) to restore it"
+            )
+        # Scheduler-profile mismatch guard: the compiled profile is a
+        # build-time static, so a restore into a differently-profiled
+        # engine would silently continue the run under different
+        # scheduling semantics (the silent-wrong-profile failure mode).
+        # Saves under the default profile write no key; absence == default.
+        from kubernetriks_tpu.batched.pipeline import (
+            CompiledProfile,
+            DEFAULT_PROFILE,
+        )
+
+        saved_prof = meta.get("scheduler_profile")
+        if saved_prof is not None:
+            saved_prof = CompiledProfile(
+                name=saved_prof["name"],
+                filters=tuple(saved_prof["filters"]),
+                scores=tuple(
+                    (str(n), float(w)) for n, w in saved_prof["scores"]
+                ),
+            )
+        if (saved_prof or DEFAULT_PROFILE) != self.profile:
+            raise ValueError(
+                f"checkpoint scheduler-profile mismatch: saved "
+                f"{(saved_prof or DEFAULT_PROFILE).name!r} "
+                f"{(saved_prof or DEFAULT_PROFILE).scores}, this engine "
+                f"compiled {self.profile.name!r} {self.profile.scores} — "
+                "build the restoring engine with the same "
+                "scheduler_profile to continue the run"
             )
         saved_window = meta.get("pod_window")
         if saved_window is not None and self.pod_window is not None:

@@ -1,0 +1,299 @@
+"""Compiled scheduler-profile pipeline: the device-plugin subsystem that
+lowers a KubeScheduler profile (ordered filter refs + weighted score refs)
+into the batched hot path.
+
+The scalar path interprets profiles per pod through the plugin registry
+(core/scheduler/plugins.py, kube_scheduler.py). The batched path cannot —
+its decision core runs inside jit-compiled programs and Mosaic/Pallas
+kernels — so a profile is COMPILED here, once, at engine construction:
+
+- `compile_profile` validates every plugin ref against the device registry
+  below and produces a `CompiledProfile`: a small, hashable NamedTuple of
+  plugin names and weights. A profile referencing a plugin the device
+  registry cannot lower raises `UnsupportedProfileError` naming the plugin
+  and the supported set — the batched engine REFUSES profiles it cannot
+  honor instead of silently running the hard-coded default (the
+  silent-wrong-profile failure mode this subsystem kills).
+- The `CompiledProfile` threads through `_STEP_STATICS` exactly like
+  `fault_params` (batched/step.py): it is a jit static, so each profile
+  compiles its own window programs, and the expressions below are inlined
+  into both the lax.scan oracle path and the Pallas kernels
+  (`ops/scheduler_kernel._fit_score_place`) as kernel statics.
+- `profile_fit_mask` / `profile_score` are the ONE definition of the
+  filter-mask and weighted-score expressions. They are pure elementwise
+  jnp programs over broadcast-compatible arrays, which is precisely what
+  makes them lowerable in BOTH worlds: the scan body calls them on
+  (C, N) node arrays with (C, 1) requests, the kernels on (Np, LANE) node
+  tiles with (1, LANE) requests. All literals are explicitly typed
+  (Mosaic cannot lower weak f64/i64 constants under jax_enable_x64).
+
+Semantics (pinned bit-for-bit against the pre-profile hard-fused core for
+the default profile, and against the scalar oracle for every profile by
+tests/test_random_equivalence.py):
+
+- Filters AND into the alive mask (scalar: list comprehension chain).
+- Scores are float32, summed over scorers after weighting; a weight of
+  exactly 1.0 skips the multiply so the default profile's expression tree
+  is textually identical to the historical hard-fused one.
+- Zero-allocatable nodes score NaN on the scalar path (plugins.py) and
+  -inf here: neither can win the last-max-wins `>=` argmax, so decisions
+  agree; -inf keeps the kernels free of NaN-propagation hazards.
+- Tie-breaks: last max in node-slot order == the reference's `>=` sweep
+  over name-sorted nodes (kube_scheduler.rs:140-150).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, NamedTuple, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from kubernetriks_tpu.core.scheduler.kube_scheduler import (
+    DEFAULT_SCHEDULER_NAME,
+    KubeSchedulerConfig,
+    kube_scheduler_config_from_spec,
+)
+from kubernetriks_tpu.core.scheduler.plugins import (
+    BALANCED,
+    FIT,
+    LEAST_ALLOCATED,
+    MOST_ALLOCATED,
+)
+
+_NEG_INF = float(np.float32(-np.inf))
+
+
+class UnsupportedProfileError(ValueError):
+    """A configured profile references a plugin the device pipeline cannot
+    lower (or an un-lowerable weight). Raised at engine construction —
+    loudly, naming the offender and the supported set — never silently
+    replaced by the default pipeline."""
+
+
+class CompiledProfile(NamedTuple):
+    """A profile lowered to kernel statics: hashable (it keys the jit
+    cache through _STEP_STATICS) and tiny (names + weights only; the
+    expressions are regenerated from the registry at trace time)."""
+
+    name: str  # display name ("default", "best_fit", or "custom")
+    filters: Tuple[str, ...]  # ordered filter plugin names
+    scores: Tuple[Tuple[str, float], ...]  # (scorer name, weight) pairs
+
+
+def _zero(x):
+    """A typed zero matching x's dtype — Mosaic rejects weak Python-scalar
+    constants inside kernel bodies under jax_enable_x64."""
+    return x.dtype.type(0)
+
+
+# --- device plugin registry ---------------------------------------------------
+# Filters: fn(cpu, ram, rc, rr) -> bool mask (AND-composed onto `alive`).
+# Scorers: fn(cpu, ram, rc, rr) -> float32 score (summed after weighting).
+# cpu/ram are the nodes' current allocatable, rc/rr the candidate's requests;
+# any broadcast-compatible shapes (the scan path and the kernels differ).
+
+
+def _filter_fit(cpu, ram, rc, rr):
+    return (rc <= cpu) & (rr <= ram)
+
+
+def _score_least_allocated(cpu, ram, rc, rr):
+    neg_inf = jnp.float32(_NEG_INF)
+    hundred = jnp.float32(100.0)
+    half = jnp.float32(0.5)
+    cpu_f = cpu.astype(jnp.float32)
+    ram_f = ram.astype(jnp.float32)
+    cpu_score = jnp.where(
+        cpu > _zero(cpu),
+        (cpu_f - rc.astype(jnp.float32)) * hundred / cpu_f,
+        neg_inf,
+    )
+    ram_score = jnp.where(
+        ram > _zero(ram),
+        (ram_f - rr.astype(jnp.float32)) * hundred / ram_f,
+        neg_inf,
+    )
+    return (cpu_score + ram_score) * half
+
+
+def _score_most_allocated(cpu, ram, rc, rr):
+    neg_inf = jnp.float32(_NEG_INF)
+    hundred = jnp.float32(100.0)
+    half = jnp.float32(0.5)
+    cpu_f = cpu.astype(jnp.float32)
+    ram_f = ram.astype(jnp.float32)
+    cpu_score = jnp.where(
+        cpu > _zero(cpu),
+        (rc.astype(jnp.float32) - cpu_f) * hundred / cpu_f,
+        neg_inf,
+    )
+    ram_score = jnp.where(
+        ram > _zero(ram),
+        (rr.astype(jnp.float32) - ram_f) * hundred / ram_f,
+        neg_inf,
+    )
+    return (cpu_score + ram_score) * half
+
+
+def _score_balanced(cpu, ram, rc, rr):
+    neg_inf = jnp.float32(_NEG_INF)
+    hundred = jnp.float32(100.0)
+    cpu_f = cpu.astype(jnp.float32)
+    ram_f = ram.astype(jnp.float32)
+    ok = (cpu > _zero(cpu)) & (ram > _zero(ram))
+    # Guard the divisors so the masked-out lanes never divide by zero
+    # (where() evaluates both branches).
+    one = jnp.float32(1.0)
+    cpu_frac = rc.astype(jnp.float32) / jnp.where(ok, cpu_f, one)
+    ram_frac = rr.astype(jnp.float32) / jnp.where(ok, ram_f, one)
+    return jnp.where(
+        ok, hundred - jnp.abs(cpu_frac - ram_frac) * hundred, neg_inf
+    )
+
+
+DEVICE_FILTER_PLUGINS: Dict[str, Callable] = {
+    FIT: _filter_fit,
+}
+
+DEVICE_SCORE_PLUGINS: Dict[str, Callable] = {
+    LEAST_ALLOCATED: _score_least_allocated,
+    MOST_ALLOCATED: _score_most_allocated,
+    BALANCED: _score_balanced,
+}
+
+
+# The reference default, hard-fused into the batched path since its first
+# version — now just the profile every other one is compiled like.
+DEFAULT_PROFILE = CompiledProfile(
+    name="default",
+    filters=(FIT,),
+    scores=((LEAST_ALLOCATED, 1.0),),
+)
+
+
+def compile_profile(spec=None) -> CompiledProfile:
+    """Lower one profile spec to a CompiledProfile.
+
+    Accepts everything kube_scheduler_config_from_spec does (None, a named
+    profile string, an explicit {filters, score} mapping, a
+    KubeSchedulerConfig) plus an already-compiled CompiledProfile (validated
+    again — a hand-built one may still name unknown plugins).
+
+    Raises UnsupportedProfileError naming the offending plugin and the
+    supported set when the batched path cannot lower the profile; the
+    scalar interpreter may still run such a profile, but the engine must
+    never silently substitute the default for it."""
+    if isinstance(spec, CompiledProfile):
+        prof = spec
+    else:
+        if spec is None:
+            spec = "default"
+        name = spec if isinstance(spec, str) else None
+        config = kube_scheduler_config_from_spec(spec)
+        kprof = config.profiles[DEFAULT_SCHEDULER_NAME]
+        prof = CompiledProfile(
+            name=name or "custom",
+            filters=tuple(p.name for p in kprof.plugins.filter),
+            scores=tuple(
+                (p.name, float(1.0 if p.weight is None else p.weight))
+                for p in kprof.plugins.score
+            ),
+        )
+    for fname in prof.filters:
+        if fname not in DEVICE_FILTER_PLUGINS:
+            raise UnsupportedProfileError(
+                f"scheduler profile {prof.name!r}: filter plugin {fname!r} "
+                f"has no device lowering — the batched path supports "
+                f"filters {sorted(DEVICE_FILTER_PLUGINS)} and scorers "
+                f"{sorted(DEVICE_SCORE_PLUGINS)} "
+                f"(kubernetriks_tpu/batched/pipeline.py); run the scalar "
+                f"backend for scalar-only plugins"
+            )
+    for sname, weight in prof.scores:
+        if sname not in DEVICE_SCORE_PLUGINS:
+            raise UnsupportedProfileError(
+                f"scheduler profile {prof.name!r}: score plugin {sname!r} "
+                f"has no device lowering — the batched path supports "
+                f"filters {sorted(DEVICE_FILTER_PLUGINS)} and scorers "
+                f"{sorted(DEVICE_SCORE_PLUGINS)} "
+                f"(kubernetriks_tpu/batched/pipeline.py); run the scalar "
+                f"backend for scalar-only plugins"
+            )
+        if not (weight > 0.0) or not np.isfinite(weight):
+            # Scalar NaN-score semantics survive any positive weight; a
+            # zero/negative/non-finite weight would flip the -inf lowering
+            # of zero-allocatable nodes into a winning score.
+            raise UnsupportedProfileError(
+                f"scheduler profile {prof.name!r}: score plugin {sname!r} "
+                f"has weight {weight!r}; the device lowering requires a "
+                f"finite weight > 0"
+            )
+    return prof
+
+
+def to_kube_scheduler_config(profile: CompiledProfile) -> KubeSchedulerConfig:
+    """CompiledProfile -> the KubeSchedulerConfig that makes the scalar
+    KubeScheduler run the SAME profile — the oracle side of the per-profile
+    equivalence sweeps."""
+    return kube_scheduler_config_from_spec(
+        {
+            "filters": list(profile.filters),
+            "score": [
+                {"name": n, "weight": w} for n, w in profile.scores
+            ],
+        }
+    )
+
+
+# --- compiled expressions -----------------------------------------------------
+
+
+def profile_fit_mask(profile: CompiledProfile, alive, cpu, ram, rc, rr):
+    """The profile's filter chain ANDed onto the alive mask. Elementwise;
+    usable in the scan body and inside Mosaic kernels."""
+    fit = alive
+    for fname in profile.filters:
+        fit = fit & DEVICE_FILTER_PLUGINS[fname](cpu, ram, rc, rr)
+    return fit
+
+
+def profile_score(profile: CompiledProfile, fit, cpu, ram, rc, rr):
+    """The profile's weighted score sum, masked to -inf off the fit set.
+    weight == 1.0 skips the multiply, so the default profile generates the
+    exact historical expression tree (bit-identical programs)."""
+    neg_inf = jnp.float32(_NEG_INF)
+    total = None
+    for sname, weight in profile.scores:
+        s = DEVICE_SCORE_PLUGINS[sname](cpu, ram, rc, rr)
+        if weight != 1.0:
+            s = s * jnp.float32(weight)
+        total = s if total is None else total + s
+    if total is None:
+        # Scoreless profile: every fitting node scores 0.0; the last-max
+        # argmax then picks the last fitting slot, matching the scalar
+        # `>=` sweep over all-zero node_scores.
+        return jnp.where(fit, jnp.float32(0.0), neg_inf)
+    return jnp.where(fit, total, neg_inf)
+
+
+def profile_fit_score(profile: CompiledProfile, alive, cpu, ram, rc, rr):
+    """(fit mask, masked score) in one call — the decision core both the
+    lax.scan path (batched/step.py) and the Pallas kernels
+    (ops/scheduler_kernel._fit_score_place) build on."""
+    fit = profile_fit_mask(profile, alive, cpu, ram, rc, rr)
+    return fit, profile_score(profile, fit, cpu, ram, rc, rr)
+
+
+def bestfit_logits_from_obs(obs):
+    """The MostAllocatedResources scorer evaluated on the RL environment's
+    observation channels (rl/env.featurize: alloc and request fractions of
+    node capacity). The scorer is scale-invariant per resource —
+    (rc - cpu)/cpu is unchanged by dividing both by capacity — so the
+    capacity-normalized channels rank nodes exactly like the raw
+    allocatables. This is the ONE best-fit definition shared by the
+    learning proof's heuristic baseline (rl/evaluate.bestfit_policy_apply)
+    and the scheduler's "best_fit" device profile."""
+    return DEVICE_SCORE_PLUGINS[MOST_ALLOCATED](
+        obs[..., 2], obs[..., 3], obs[..., 4], obs[..., 5]
+    )

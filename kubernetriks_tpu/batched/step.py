@@ -1459,9 +1459,17 @@ def _run_scheduling_cycle(
     use_megakernel: bool = True,
     fault_params=None,
     lane_major: bool = False,
+    profile=None,
 ) -> ClusterBatchState:
     """One vectorized kube-scheduler cycle at window W for every cluster
     (scalar equivalent: reference scheduler.rs:246-333).
+
+    profile (pipeline.CompiledProfile, static; None = the reference
+    default): the compiled scheduler profile whose filter-mask and
+    weighted-score expressions the decision core runs — threaded to the
+    lax.scan body and every Pallas kernel below, so all four formulations
+    of the cycle execute the SAME configured profile (the scalar path's
+    composable Filter/Score plugins, lowered; batched/pipeline.py).
 
     NOTE on a rejected optimization: skipping empty cycles behind a scalar
     lax.cond (predicate: no eligible/parked pod, no wake signal) is exact,
@@ -1472,6 +1480,10 @@ def _run_scheduling_cycle(
     consume/return them without transposes (nodes_lane_major); the lax.scan
     fallback converts at its branch boundary (CPU-parity path only).
     """
+    from kubernetriks_tpu.batched.pipeline import DEFAULT_PROFILE
+
+    if profile is None:
+        profile = DEFAULT_PROFILE
     C, P = state.pods.phase.shape
     N = (
         state.nodes.alive.shape[0]
@@ -1516,6 +1528,7 @@ def _run_scheduling_cycle(
             k_pods=K,
             interpret=pallas_interpret,
             nodes_lane_major=lane_major,
+            profile=profile,
         )
         if pallas_mesh is not None:
             core = _shard_rowwise(core, 15, 7, pallas_mesh, pallas_axis)
@@ -1585,6 +1598,7 @@ def _run_scheduling_cycle(
             k_pods=max_pods_per_cycle,
             interpret=pallas_interpret,
             nodes_lane_major=lane_major,
+            profile=profile,
         )
         if pallas_mesh is not None:
             core = _shard_rowwise(core, 9, 7, pallas_mesh, pallas_axis)
@@ -1618,6 +1632,7 @@ def _run_scheduling_cycle(
             fused_schedule_cycle,
             interpret=pallas_interpret,
             nodes_lane_major=lane_major,
+            profile=profile,
         )
         if pallas_mesh is not None:
             core = _shard_rowwise(core, 6, 5, pallas_mesh, pallas_axis)
@@ -1644,33 +1659,28 @@ def _run_scheduling_cycle(
         acpu0 = state.nodes.alloc_cpu.T if lane_major else state.nodes.alloc_cpu
         aram0 = state.nodes.alloc_ram.T if lane_major else state.nodes.alloc_ram
 
+        from kubernetriks_tpu.batched.pipeline import profile_fit_score
+
         def body(carry, xs):
             alloc_cpu, alloc_ram = carry
             valid, req_cpu, req_ram = xs
 
-            # Fit filter + LeastAllocatedResources score (reference:
-            # plugin.rs:33-63). Scores are float32 on BOTH batched paths
-            # (this scan and the Pallas kernel); the precision only affects
-            # argmax tie-breaks between near-equal node scores, which the
-            # cross-path equivalence tests cover.
-            fit = (
-                alive_x
-                & (req_cpu[:, None] <= alloc_cpu)
-                & (req_ram[:, None] <= alloc_ram)
+            # The compiled profile's filter mask + weighted score
+            # (pipeline.py; default = Fit + LeastAllocatedResources,
+            # reference: plugin.rs:33-63) — the SAME expressions the Pallas
+            # kernels inline, so the scan oracle and the kernels cannot
+            # drift per profile. Scores are float32 on BOTH batched paths;
+            # the precision only affects argmax tie-breaks between
+            # near-equal node scores, which the cross-path equivalence
+            # tests cover.
+            fit, score = profile_fit_score(
+                profile,
+                alive_x,
+                alloc_cpu,
+                alloc_ram,
+                req_cpu[:, None],
+                req_ram[:, None],
             )
-            alloc_cpu_f = alloc_cpu.astype(jnp.float32)
-            alloc_ram_f = alloc_ram.astype(jnp.float32)
-            cpu_score = jnp.where(
-                alloc_cpu > 0,
-                (alloc_cpu_f - req_cpu[:, None].astype(jnp.float32)) * 100.0 / alloc_cpu_f,
-                -INF,
-            )
-            ram_score = jnp.where(
-                alloc_ram > 0,
-                (alloc_ram_f - req_ram[:, None].astype(jnp.float32)) * 100.0 / alloc_ram_f,
-                -INF,
-            )
-            score = jnp.where(fit, (cpu_score + ram_score) * jnp.float32(0.5), -INF)
             # Last-max-wins argmax, matching the reference's `>=` sweep over
             # name-sorted nodes (kube_scheduler.rs:140-150).
             best = jnp.int32(N - 1) - jax.lax.argmax(score[:, ::-1], 1, jnp.int32)
@@ -1784,6 +1794,7 @@ def _window_body(
     lane_major: bool = False,
     window_razor: bool = True,
     ca_descatter: bool = True,
+    profile=None,
 ) -> ClusterBatchState:
     W = jnp.broadcast_to(jnp.asarray(W, jnp.int32), state.time.shape)
     # Telemetry ring (flight recorder): the window's incoming metric
@@ -1843,6 +1854,7 @@ def _window_body(
         use_megakernel=use_megakernel,
         fault_params=fault_params,
         lane_major=lane_major,
+        profile=profile,
     )
     if autoscale_statics is not None:
         # Autoscaler ticks due by this window run after the scheduling cycle
@@ -1953,6 +1965,13 @@ _STEP_STATICS = (
     "lane_major",
     "window_razor",
     "ca_descatter",
+    # pipeline.CompiledProfile (hashable NamedTuple of plugin names +
+    # weights) or None; the compiled scheduler profile whose filter/score
+    # expressions the decision core runs. None compiles programs identical
+    # to the pre-profile build (the reference default). Co-travels with
+    # fault_params through every window-program entry (the ktpu-lint
+    # jit-static pass enforces the pairing).
+    "profile",
 )
 
 
@@ -1980,6 +1999,7 @@ def window_step(
     lane_major: bool = False,
     window_razor: bool = True,
     ca_descatter: bool = True,
+    profile=None,
 ) -> ClusterBatchState:
     """Advance every cluster through scheduling-cycle window index W.
 
@@ -2011,6 +2031,7 @@ def window_step(
         lane_major=lane_major,
         window_razor=window_razor,
         ca_descatter=ca_descatter,
+        profile=profile,
     )
     if lane_major:
         state = swap_node_layout(state)
@@ -2188,6 +2209,7 @@ def _run_windows_skip_impl(
     lane_major: bool = False,
     window_razor: bool = True,
     ca_descatter: bool = True,
+    profile=None,
 ):
     """run_windows with FAST-FORWARD over provably no-op windows: a dynamic
     while_loop executes only interesting windows (see
@@ -2231,6 +2253,7 @@ def _run_windows_skip_impl(
             lane_major=lane_major,
             window_razor=window_razor,
             ca_descatter=ca_descatter,
+            profile=profile,
         )
         W_next = jnp.minimum(
             _next_interesting_window(
@@ -2294,6 +2317,7 @@ def _run_windows_impl(
     lane_major: bool = False,
     window_razor: bool = True,
     ca_descatter: bool = True,
+    profile=None,
 ):
     """Scan a whole sequence of scheduling-cycle windows on-device (the hot
     benchmark loop: no host round-trips between cycles). window_idxs: (Wn,)
@@ -2329,6 +2353,7 @@ def _run_windows_impl(
             lane_major=lane_major,
             window_razor=window_razor,
             ca_descatter=ca_descatter,
+            profile=profile,
         )
         return new, (
             gauge_snapshot(new, lane_major=lane_major)
@@ -2495,6 +2520,7 @@ def _run_superspan_impl(
     lane_major: bool = False,
     window_razor: bool = True,
     ca_descatter: bool = True,
+    profile=None,
     W: int = 0,
     K: int = 16,
     chunk: int = 8,
@@ -2590,6 +2616,7 @@ def _run_superspan_impl(
                 lane_major=lane_major,
                 window_razor=window_razor,
                 ca_descatter=ca_descatter,
+                profile=profile,
             )
             return new, None
 

@@ -242,6 +242,15 @@ def main(argv=None) -> int:
         "resident; set to ~2x peak pod concurrency to stream long traces)",
     )
     parser.add_argument(
+        "--profile",
+        default=None,
+        help="Scheduler profile: a named profile (default, best_fit, "
+        "balanced_packing) overriding the config's scheduler_profile "
+        "block. Both backends honor it; the batched backend compiles it "
+        "into the scan/Pallas decision kernels and fails loudly on a "
+        "profile it cannot lower.",
+    )
+    parser.add_argument(
         "--gauge-csv",
         default=None,
         help="Path for the 5s gauge-metrics CSV (off by default)",
@@ -258,6 +267,13 @@ def main(argv=None) -> int:
 
     config = SimulationConfig.from_file(args.config_file)
     setup_logging(config)
+    if args.profile is not None:
+        # --profile supersedes the config's scheduler_profile block for
+        # BOTH backends (the scalar simulator parses it through the same
+        # spec parser; the batched engine compiles it).
+        import dataclasses
+
+        config = dataclasses.replace(config, scheduler_profile=args.profile)
     if args.report is not None:
         # --report supersedes the config's metrics_printer block; nulling
         # it here keeps the run-loop callbacks from ALSO printing the

@@ -335,6 +335,15 @@ class SimulationConfig:
     metrics_printer: Optional[MetricsPrinterConfig] = None
     default_cluster: Optional[List[NodeGroup]] = None
     scheduling_cycle_interval: float = 10.0
+    # Scheduler profile spec: a NAMED_PROFILE_SPECS string ("default",
+    # "best_fit", "balanced_packing") or an explicit mapping
+    # {filters: [...], score: [{name, weight}, ...]}. Parsed by
+    # core.scheduler.kube_scheduler.kube_scheduler_config_from_spec — the
+    # ONE parser both backends share; the batched engine additionally
+    # compiles it into kernel statics (batched/pipeline.py) and raises at
+    # construction on a profile it cannot lower. None = reference default
+    # (Fit + LeastAllocatedResources).
+    scheduler_profile: Optional[Any] = None
     enable_unscheduled_pods_conditional_move: bool = False
     # Simulated control-plane network delays in seconds; as = api server,
     # ps = persistent storage, ca = cluster autoscaler, hpa = horizontal pod
@@ -370,6 +379,7 @@ class SimulationConfig:
                 else None
             ),
             scheduling_cycle_interval=float(d.get("scheduling_cycle_interval", 10.0)),
+            scheduler_profile=d.get("scheduler_profile"),
             enable_unscheduled_pods_conditional_move=bool(
                 d.get("enable_unscheduled_pods_conditional_move", False)
             ),

@@ -12,7 +12,11 @@ from kubernetriks_tpu.core.scheduler.interface import (
     SchedulingFailure,
 )
 from kubernetriks_tpu.core.scheduler.plugins import (
+    BALANCED,
+    FIT,
     FilterPlugin,
+    LEAST_ALLOCATED,
+    MOST_ALLOCATED,
     PLUGIN_REGISTRY,
     ScorePlugin,
 )
@@ -47,12 +51,97 @@ class KubeSchedulerConfig:
 def default_kube_scheduler_config() -> KubeSchedulerConfig:
     """Fit filter + LeastAllocatedResources score at weight 1.0
     (reference: src/core/scheduler/kube_scheduler.rs:44-61)."""
+    return kube_scheduler_config_from_spec("default")
+
+
+# Named profile specs — the shared catalogue both paths resolve: the scalar
+# KubeScheduler builds its plugin refs from these, and the batched device
+# pipeline (kubernetriks_tpu/batched/pipeline.py) lowers the same specs into
+# compiled kernel statics. Each value is (filter names, (scorer, weight)...).
+NAMED_PROFILE_SPECS: Dict[str, tuple] = {
+    # The reference default (kube_scheduler.rs:44-61): spread pods by free
+    # share.
+    "default": ((FIT,), ((LEAST_ALLOCATED, 1.0),)),
+    # Best-fit packing — the policy the RL bimodal proof discovers: the
+    # tightest-fitting node wins, keeping whole nodes free for large pods.
+    "best_fit": ((FIT,), ((MOST_ALLOCATED, 1.0),)),
+    # Weighted filter+score combination: pack first, but trade up to ~12.5
+    # score points of tightness for an even cpu/ram drain.
+    "balanced_packing": ((FIT,), ((MOST_ALLOCATED, 1.0), (BALANCED, 0.25))),
+}
+
+
+def kube_scheduler_config_from_spec(spec) -> KubeSchedulerConfig:
+    """One profile spec -> KubeSchedulerConfig, accepted forms:
+
+    - None                      -> the reference default profile;
+    - "name"                    -> NAMED_PROFILE_SPECS lookup (loud on typos);
+    - {"filters": [...],
+       "score": [{"name":..., "weight":...}, ...]}
+                                -> an explicit profile (weight defaults 1.0);
+    - KubeSchedulerConfig       -> passed through.
+
+    This is the ONE parser both backends use (the batched pipeline compiles
+    its device profile from the config this returns), so a YAML
+    `scheduler_profile:` block means the same thing everywhere."""
+    if spec is None:
+        spec = "default"
+    if isinstance(spec, KubeSchedulerConfig):
+        return spec
+    if isinstance(spec, str):
+        named = NAMED_PROFILE_SPECS.get(spec)
+        if named is None:
+            raise ValueError(
+                f"unknown named scheduler profile {spec!r}; available: "
+                f"{sorted(NAMED_PROFILE_SPECS)}"
+            )
+        filters, scores = named
+        spec = {
+            "filters": list(filters),
+            "score": [{"name": n, "weight": w} for n, w in scores],
+        }
+    if not isinstance(spec, dict):
+        raise TypeError(
+            f"scheduler profile spec must be None, a named-profile string, "
+            f"a mapping, or a KubeSchedulerConfig; got {type(spec).__name__}"
+        )
+    # Reject unknown keys LOUDLY: a typo like `scores:` would otherwise
+    # yield a silently scoreless profile — the silent-wrong-profile
+    # failure mode this subsystem exists to kill.
+    unknown = set(spec) - {"filters", "score"}
+    if unknown:
+        raise ValueError(
+            f"scheduler profile spec has unknown key(s) {sorted(unknown)}; "
+            "expected 'filters' (list of filter plugin names) and 'score' "
+            "(list of {name, weight} scorer refs)"
+        )
+    # Default the filter chain to Fit only when the key is ABSENT: an
+    # explicit `filters: []` is a coherent profile (score every alive
+    # node, no feasibility filter) and must not be silently substituted.
+    filters_spec = spec.get("filters", [FIT])
+    if filters_spec is None:
+        filters_spec = [FIT]
+    filter_refs = [Plugin(name=str(name)) for name in filters_spec]
+    score_refs = []
+    for entry in spec.get("score") or []:
+        if isinstance(entry, str):
+            entry = {"name": entry}
+        bad = set(entry) - {"name", "weight"}
+        if bad:
+            raise ValueError(
+                f"scheduler profile score entry {entry!r} has unknown "
+                f"key(s) {sorted(bad)}; expected 'name' and optional "
+                "'weight'"
+            )
+        score_refs.append(
+            Plugin(
+                name=str(entry["name"]),
+                weight=float(entry.get("weight", 1.0)),
+            )
+        )
     profile = KubeSchedulerProfile(
         scheduler_name=DEFAULT_SCHEDULER_NAME,
-        plugins=Plugins(
-            filter=[Plugin(name="Fit")],
-            score=[Plugin(name="LeastAllocatedResources", weight=1.0)],
-        ),
+        plugins=Plugins(filter=filter_refs, score=score_refs),
     )
     return KubeSchedulerConfig(profiles={DEFAULT_SCHEDULER_NAME: profile})
 
@@ -93,9 +182,10 @@ class KubeScheduler(PodSchedulingAlgorithm):
             assert isinstance(plugin, ScorePlugin), (
                 f"{scorer_ref.name!r} plugin is not a ScorePlugin"
             )
+            weight = 1.0 if scorer_ref.weight is None else scorer_ref.weight
             for node in filtered_nodes:
                 node_scores[node.metadata.name] += (
-                    plugin.score(pod, node) * scorer_ref.weight
+                    plugin.score(pod, node) * weight
                 )
 
         assigned_node = filtered_nodes[0].metadata.name

@@ -161,6 +161,19 @@ _FLAGS = [
         "KTPU_DEBUG_FINITE state sweep runs at every dispatch boundary.",
     ),
     Flag(
+        "KTPU_PROFILE",
+        "str",
+        None,
+        "Named scheduler profile for batched engines that were not handed "
+        "an explicit profile (bench/CLI selection): a key of "
+        "core.scheduler.kube_scheduler.NAMED_PROFILE_SPECS ('default', "
+        "'best_fit', 'balanced_packing'). Compiled into the scan and "
+        "Pallas kernel paths at engine build (batched/pipeline.py); an "
+        "unknown name or un-lowerable plugin raises at construction "
+        "instead of silently running the default pipeline. Unset: the "
+        "config's scheduler_profile, else the reference default.",
+    ),
+    Flag(
         "KTPU_TRACE",
         "bool",
         False,

@@ -1,6 +1,6 @@
 """Jit-static discipline pass.
 
-Two rules over the package-wide jit table (lint.build_context — every
+Three rules over the package-wide jit table (lint.build_context — every
 `jax.jit` / `partial(jax.jit, ...)` site, decorator or assignment form,
 with `static_argnames` resolved through module-level tuple constants and
 `+` concatenations):
@@ -14,6 +14,14 @@ with `static_argnames` resolved through module-level tuple constants and
    in one variant and traced in the other, so the "bit-identical" pair
    quietly compiles different programs (they drifted once already in
    step.py).
+3. COUPLED window-program statics must travel together: an entry whose
+   static set names one of a coupled pair (today: `fault_params` and
+   `profile`, the two _STEP_STATICS config objects every window-program
+   entry threads) but not the other has forked off the shared static
+   set — the entry would compile the default scheduler pipeline (or the
+   fault-free build) no matter what the engine configured, which is
+   exactly the silent-wrong-profile failure mode the compiled-profile
+   subsystem exists to kill.
 
 Unresolvable `static_argnames` expressions (anything beyond literals,
 module constants and `+`) are themselves violations: the discipline is
@@ -30,6 +38,10 @@ from typing import Dict, List, Tuple
 from kubernetriks_tpu.lint import JitEntry, LintContext, Violation
 
 PASS_ID = "jitstatic"
+
+# Rule 3: statics that must co-occur in any entry naming one of them —
+# the window-program config objects threaded through _STEP_STATICS.
+COUPLED_STATICS: Tuple[Tuple[str, ...], ...] = (("fault_params", "profile"),)
 
 
 def check(ctx: LintContext) -> List[Violation]:
@@ -62,6 +74,34 @@ def check(ctx: LintContext) -> List[Violation]:
                     "no parameter of the wrapped function (params: "
                     f"{', '.join(entry.params)})",
                 )
+
+    # Rule 3: coupled statics travel together. Only entries whose wrapped
+    # function actually HAS both parameters are held to it — a kernel
+    # wrapper with a profile static but no fault_params parameter is not a
+    # window program and correctly declares only what it takes.
+    for entry in ctx.jit_entries:
+        if not entry.static_resolved:
+            continue  # already flagged by rule 1
+        statics = frozenset(entry.static_argnames or ())
+        for pair in COUPLED_STATICS:
+            present = [name for name in pair if name in statics]
+            if not present or len(present) == len(pair):
+                continue
+            missing = [name for name in pair if name not in statics]
+            if entry.params is not None and not entry.has_varkw and any(
+                name not in entry.params for name in missing
+            ):
+                continue
+            flag(
+                entry,
+                f"static_argnames of {entry.name} declares "
+                f"{sorted(present)} but not {sorted(missing)} — the "
+                "coupled window-program statics "
+                f"{sorted(pair)} must travel together (thread them "
+                "through the shared _STEP_STATICS tuple), or the entry "
+                "silently compiles the default configuration for the "
+                "missing one",
+            )
 
     # Rule 2: donated/undonated pairs declare identical static sets.
     by_name: Dict[Tuple[str, str], List[JitEntry]] = defaultdict(list)

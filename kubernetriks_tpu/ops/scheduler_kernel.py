@@ -9,11 +9,13 @@ Every kernel carries a data-dependent early exit at the tile's actual work
 count, which lax.scan formulations cannot express.
 
 - `_cycle_kernel` (fused_schedule_cycle): the K-pod scheduling loop — pod
-  k's Fit filter + LeastAllocatedResources score + last-wins argmax
-  (reference: src/core/scheduler/plugin.rs:33-63, kube_scheduler.rs:140-150)
-  must see the allocatable updates of pods 0..k-1; the node tile stays
-  pinned in VMEM across the loop (one HBM round-trip per cycle instead
-  of K).
+  k's compiled-profile filter mask + weighted score (batched/pipeline.py;
+  the default profile is Fit + LeastAllocatedResources, reference:
+  src/core/scheduler/plugin.rs:33-63) + last-wins argmax
+  (kube_scheduler.rs:140-150) must see the allocatable updates of pods
+  0..k-1; the node tile stays pinned in VMEM across the loop (one HBM
+  round-trip per cycle instead of K). The profile is a kernel static —
+  each profile compiles its own kernel, selected at engine build.
 - `_select_cycle_kernel` (fused_select_schedule_cycle): the same loop with
   candidate EXTRACTION in-kernel via an iterated per-lane lexicographic
   argmin over the queue keys — the dense-batch default, eliminating the
@@ -43,6 +45,14 @@ import numpy as np
 from jax.experimental import enable_x64 as jax_enable_x64_ctx
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+# The compiled scheduler-profile pipeline: profiles lower into the decision
+# kernels as statics. pipeline.py imports only core.scheduler (NOT
+# batched/state.py), so kernel-only users still dodge the x64 config flip.
+from kubernetriks_tpu.batched.pipeline import (
+    DEFAULT_PROFILE,
+    profile_fit_score,
+)
 
 _NEG_INF = float(np.float32(-np.inf))
 
@@ -105,31 +115,24 @@ def kernel_fits(n_nodes: int, k_pods: int) -> bool:
     return resident <= _VMEM_BUDGET_BYTES
 
 
-def _fit_score_place(alive, node_ok, iota_n, cpu, ram, rc, rr, valid):
+def _fit_score_place(profile, alive, node_ok, iota_n, cpu, ram, rc, rr, valid):
     """ONE in-kernel definition of the per-candidate decision core shared by
-    _cycle_kernel and _select_cycle_kernel: Fit filter +
-    LeastAllocatedResources score + last-max-wins argmax (ties resolve to
-    the highest node slot, matching the reference's `>=` sweep over
-    name-sorted nodes) + the allocatable update for the placed node.
+    _cycle_kernel, _select_cycle_kernel and _select_cycle_commit_kernel:
+    the compiled profile's filter mask + weighted score
+    (batched/pipeline.py — the default profile is Fit +
+    LeastAllocatedResources, reference plugin.rs:33-63) + last-max-wins
+    argmax (ties resolve to the highest node slot, matching the
+    reference's `>=` sweep over name-sorted nodes) + the allocatable
+    update for the placed node. `profile` is a kernel STATIC (a
+    pipeline.CompiledProfile closed over via functools.partial); its
+    expressions inline into the kernel body like the shape statics do.
     Inputs: (Np, LC) node tiles, (1, LC) candidate requests/validity.
     Returns (assign (1, LC) bool, any_fit (1, LC) bool, best (1, LC) i32,
     new_cpu (Np, LC), new_ram (Np, LC))."""
     i0 = jnp.int32(0)
     neg1 = jnp.int32(-1)
-    hundred = jnp.float32(100.0)
-    half = jnp.float32(0.5)
-    neg_inf = jnp.float32(_NEG_INF)
 
-    fit = alive & (rc <= cpu) & (rr <= ram)
-    cpu_f = cpu.astype(jnp.float32)
-    ram_f = ram.astype(jnp.float32)
-    cpu_score = jnp.where(
-        cpu > i0, (cpu_f - rc.astype(jnp.float32)) * hundred / cpu_f, neg_inf
-    )
-    ram_score = jnp.where(
-        ram > i0, (ram_f - rr.astype(jnp.float32)) * hundred / ram_f, neg_inf
-    )
-    score = jnp.where(fit, (cpu_score + ram_score) * half, neg_inf)
+    fit, score = profile_fit_score(profile, alive, cpu, ram, rc, rr)
     max_score = jnp.max(score, axis=0, keepdims=True)
     best = jnp.max(
         jnp.where((score == max_score) & node_ok, iota_n, neg1),
@@ -149,6 +152,7 @@ def _fit_score_place(alive, node_ok, iota_n, cpu, ram, rc, rr, valid):
 def _cycle_kernel(
     n_real: int,
     k_pods: int,
+    profile,        # pipeline.CompiledProfile (kernel static)
     alive_ref,      # (Np, LC) int32
     alloc_cpu_ref,  # (Np, LC) int32
     alloc_ram_ref,  # (Np, LC) int32
@@ -193,7 +197,8 @@ def _cycle_kernel(
         valid = valid_ref[pl.ds(k, 1), :] != i0
 
         assign, any_fit, best, new_cpu, new_ram = _fit_score_place(
-            alive, node_ok, iota, cpu_out[:], ram_out[:], req_cpu, req_ram, valid
+            profile, alive, node_ok, iota, cpu_out[:], ram_out[:],
+            req_cpu, req_ram, valid,
         )
         cpu_out[:] = new_cpu
         ram_out[:] = new_ram
@@ -234,6 +239,7 @@ def select_kernel_fits(n_nodes: int, n_pods: int, k_pods: int) -> bool:
 def _select_cycle_kernel(
     n_nodes: int,
     k_pods: int,
+    profile,        # pipeline.CompiledProfile (kernel static)
     alive_ref,      # (Np, LC) int32
     alloc_cpu_ref,  # (Np, LC) int32
     alloc_ram_ref,  # (Np, LC) int32
@@ -304,7 +310,8 @@ def _select_cycle_kernel(
         rr = jnp.max(seli * preq_ram_ref[:], axis=0, keepdims=True)
 
         assign, any_fit, best, new_cpu, new_ram = _fit_score_place(
-            alive, node_ok, iota_n, cpu_out[:], ram_out[:], rc, rr, valid
+            profile, alive, node_ok, iota_n, cpu_out[:], ram_out[:],
+            rc, rr, valid,
         )
         cpu_out[:] = new_cpu
         ram_out[:] = new_ram
@@ -323,7 +330,8 @@ def _select_cycle_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("k_pods", "interpret", "nodes_lane_major")
+    jax.jit,
+    static_argnames=("k_pods", "interpret", "nodes_lane_major", "profile"),
 )
 def fused_select_schedule_cycle(
     alive: jnp.ndarray,      # (C, N) bool — (N, C) when nodes_lane_major
@@ -338,6 +346,7 @@ def fused_select_schedule_cycle(
     k_pods: int,
     interpret: bool = False,
     nodes_lane_major: bool = False,
+    profile=None,  # pipeline.CompiledProfile; None = the default profile
 ):
     """Fused selection + scheduling loop in VMEM.
 
@@ -375,7 +384,9 @@ def fused_select_schedule_cycle(
     pod_spec = pl.BlockSpec((Pp, _LANE), lambda i: (0, i), memory_space=pltpu.VMEM)
     cand_spec = pl.BlockSpec((Kp, _LANE), lambda i: (0, i), memory_space=pltpu.VMEM)
 
-    kernel = functools.partial(_select_cycle_kernel, N, K)
+    kernel = functools.partial(
+        _select_cycle_kernel, N, K, profile or DEFAULT_PROFILE
+    )
     with jax_enable_x64_ctx(False):
         cpu_o, ram_o, cand_o, valid_o, assign_o, fitany_o, best_o = pl.pallas_call(
             kernel,
@@ -887,7 +898,7 @@ def _pad_axis(x: jnp.ndarray, axis: int, to: int, value) -> jnp.ndarray:
 
 
 @functools.partial(
-    jax.jit, static_argnames=("interpret", "nodes_lane_major")
+    jax.jit, static_argnames=("interpret", "nodes_lane_major", "profile")
 )
 def fused_schedule_cycle(
     alive: jnp.ndarray,      # (C, N) bool — (N, C) when nodes_lane_major
@@ -898,6 +909,7 @@ def fused_schedule_cycle(
     req_ram: jnp.ndarray,    # (C, K) int32
     interpret: bool = False,
     nodes_lane_major: bool = False,
+    profile=None,  # pipeline.CompiledProfile; None = the default profile
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Run the K-pod scheduling loop in VMEM.
 
@@ -926,7 +938,7 @@ def fused_schedule_cycle(
     node_spec = pl.BlockSpec((Np, _LANE), lambda i: (0, i), memory_space=pltpu.VMEM)
     cand_spec = pl.BlockSpec((Kp, _LANE), lambda i: (0, i), memory_space=pltpu.VMEM)
 
-    kernel = functools.partial(_cycle_kernel, N, K)
+    kernel = functools.partial(_cycle_kernel, N, K, profile or DEFAULT_PROFILE)
     # Trace the kernel with x64 semantics OFF: the batched path enables
     # jax_enable_x64 for its f64 time arrays, but under x64 pallas_call's own
     # index bookkeeping traces as i64, which Mosaic fails to legalize
@@ -998,6 +1010,7 @@ def _argmin_select(rem, qwin_ref, qoff_ref, qseq_ref, iota_p):
 def _select_cycle_commit_kernel(
     n_nodes: int,
     k_pods: int,
+    profile,        # pipeline.CompiledProfile (kernel static)
     alive_ref,      # (Np, LC) int32
     alloc_cpu_ref,  # (Np, LC) int32
     alloc_ram_ref,  # (Np, LC) int32
@@ -1074,7 +1087,8 @@ def _select_cycle_commit_kernel(
         rr = jnp.max(seli * preq_ram_ref[:], axis=0, keepdims=True)
 
         assign, any_fit, best, new_cpu, new_ram = _fit_score_place(
-            alive, node_ok, iota_n, cpu_out[:], ram_out[:], rc, rr, valid
+            profile, alive, node_ok, iota_n, cpu_out[:], ram_out[:],
+            rc, rr, valid,
         )
         cpu_out[:] = new_cpu
         ram_out[:] = new_ram
@@ -1119,7 +1133,8 @@ def _select_cycle_commit_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("k_pods", "interpret", "nodes_lane_major")
+    jax.jit,
+    static_argnames=("k_pods", "interpret", "nodes_lane_major", "profile"),
 )
 def fused_select_cycle_commit(
     alive: jnp.ndarray,      # (C, N) bool — (N, C) when nodes_lane_major
@@ -1140,6 +1155,7 @@ def fused_select_cycle_commit(
     k_pods: int,
     interpret: bool = False,
     nodes_lane_major: bool = False,
+    profile=None,  # pipeline.CompiledProfile; None = the default profile
 ):
     """Megakernel wrapper. Returns (alloc_cpu, alloc_ram, phase, node,
     start_tmp (+inf untouched), park_tmp, qstats (C, 5)). With
@@ -1182,7 +1198,9 @@ def fused_select_cycle_commit(
     cand_spec = pl.BlockSpec((Kp, _LANE), lambda i: (0, i), memory_space=pltpu.VMEM)
     stat_spec = pl.BlockSpec((8, _LANE), lambda i: (0, i), memory_space=pltpu.VMEM)
 
-    kernel = functools.partial(_select_cycle_commit_kernel, N, K)
+    kernel = functools.partial(
+        _select_cycle_commit_kernel, N, K, profile or DEFAULT_PROFILE
+    )
     with jax_enable_x64_ctx(False):
         (cpu_o, ram_o, phase_o, node_o, start_o, park_o, stats_o) = pl.pallas_call(
             kernel,

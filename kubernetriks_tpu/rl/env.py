@@ -45,11 +45,17 @@ class Transition(NamedTuple):
 def featurize(
     alive, alloc_cpu, alloc_ram, cap_cpu, cap_ram, req_cpu, req_ram
 ) -> jnp.ndarray:
-    """Per-node features for one pending pod: (C, N, F)."""
+    """Per-node features for one pending pod: (C, N, F). The action mask's
+    feasibility channel is the scheduler pipeline's Fit device plugin
+    (batched/pipeline.py) — the policy's action space and the
+    kube-scheduler's filter chain agree on what "fits" means."""
+    from kubernetriks_tpu.batched.pipeline import profile_fit_mask, DEFAULT_PROFILE
+
     cap_cpu_f = jnp.maximum(cap_cpu.astype(jnp.float32), 1.0)
     cap_ram_f = jnp.maximum(cap_ram.astype(jnp.float32), 1.0)
-    fits = (
-        alive & (req_cpu[:, None] <= alloc_cpu) & (req_ram[:, None] <= alloc_ram)
+    fits = profile_fit_mask(
+        DEFAULT_PROFILE, alive, alloc_cpu, alloc_ram,
+        req_cpu[:, None], req_ram[:, None],
     )
     return jnp.stack(
         [

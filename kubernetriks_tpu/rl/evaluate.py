@@ -25,12 +25,24 @@ import jax.numpy as jnp
 import numpy as np
 
 from kubernetriks_tpu.batched.engine import BatchedSimulation
+from kubernetriks_tpu.batched.pipeline import bestfit_logits_from_obs
 from kubernetriks_tpu.batched.state import (
     PHASE_RUNNING,
     PHASE_SUCCEEDED,
     PHASE_UNSCHEDULABLE,
 )
 from kubernetriks_tpu.rl.env import rollout
+
+
+def bestfit_policy_apply(params, obs):
+    """The best-fit packing heuristic as a policy_apply — THE upper-bound
+    reference of the learning proof, deduplicated onto the device-plugin
+    registry: the logits are the MostAllocatedResources scorer of the
+    scheduler's "best_fit" profile evaluated on the observation channels
+    (batched/pipeline.bestfit_logits_from_obs), so the proof's baseline
+    and the deployable scheduler profile share ONE scorer definition.
+    `params` is unused (heuristic); the value head returns zeros."""
+    return bestfit_logits_from_obs(obs), jnp.zeros(obs.shape[:-2])
 
 
 def _summary(

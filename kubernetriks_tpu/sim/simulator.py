@@ -26,7 +26,10 @@ from kubernetriks_tpu.core.node_component import (
 )
 from kubernetriks_tpu.core.persistent_storage import PersistentStorage
 from kubernetriks_tpu.core.scheduler.interface import PodSchedulingAlgorithm
-from kubernetriks_tpu.core.scheduler.kube_scheduler import KubeScheduler
+from kubernetriks_tpu.core.scheduler.kube_scheduler import (
+    KubeScheduler,
+    kube_scheduler_config_from_spec,
+)
 from kubernetriks_tpu.core.scheduler.scheduler import Scheduler
 from kubernetriks_tpu.core.types import Node, NodeConditionType
 from kubernetriks_tpu.metrics.collector import MetricsCollector
@@ -112,7 +115,14 @@ class KubernetriksSimulation:
 
         self.scheduler = Scheduler(
             api_server_id,
-            KubeScheduler(),
+            # The configured profile (config.scheduler_profile; None = the
+            # reference default) — same spec the batched engine compiles
+            # into its device pipeline, parsed by the one shared parser.
+            KubeScheduler(
+                kube_scheduler_config_from_spec(
+                    getattr(config, "scheduler_profile", None)
+                )
+            ),
             scheduler_ctx,
             config,
             self.metrics_collector,

@@ -95,12 +95,10 @@ def main() -> None:
             jax.random.PRNGKey(123), greedy=True, large_cpu=PROOF_LARGE["cpu"],
         )
 
-    def bestfit_apply(params, obs):
-        # Hand-coded best-fit (pack: least free cpu among fitting nodes) —
-        # the heuristic the policy should discover; upper-bound reference.
-        import jax.numpy as jnp
-
-        return -10.0 * obs[..., 2], jnp.zeros(obs.shape[:-2])
+    # Best-fit packing baseline — shared definition with the scheduler's
+    # "best_fit" device profile (rl/evaluate.py wraps the
+    # MostAllocatedResources scorer from the device-plugin registry).
+    from kubernetriks_tpu.rl.evaluate import bestfit_policy_apply as bestfit_apply
 
     kube = eval_kube(
         make_sim(HELDOUT_SEED_BASE, args.eval_clusters), windows,

@@ -1,0 +1,17 @@
+"""Seeded violation: a window-program entry that threads `fault_params` as
+a static but leaves `profile` traced (or undeclared) — the forked static
+set would compile the DEFAULT scheduler pipeline no matter what profile the
+engine configured (the silent-wrong-profile failure mode)."""
+
+import jax
+
+_STATICS = ("max_events", "fault_params")
+
+
+def _impl(state, slab, max_events, fault_params=None, profile=None):
+    return state
+
+
+# BAD: "profile" missing from the static set while "fault_params" is
+# declared and the wrapped function takes both.
+run_entry = jax.jit(_impl, static_argnames=_STATICS)

@@ -38,20 +38,24 @@ def _smoke_records(capsys, args):
     return records
 
 
-def test_bench_smoke_emits_five_parseable_lines(capsys, tmp_path, monkeypatch):
+def test_bench_smoke_emits_six_parseable_lines(capsys, tmp_path, monkeypatch):
     # --trace rides along (the CI smoke job runs it this way): the
     # composed lines must carry the flight-recorder summary AND write a
     # Perfetto-loadable Chrome trace per traced line.
     monkeypatch.setenv("KTPU_TRACE_PATH", str(tmp_path / "ktpu_trace"))
     records = _smoke_records(capsys, ["--smoke", "--trace"])
-    assert len(records) == 5, records
+    assert len(records) == 6, records
     # Line order is part of the contract: continuity, composed, superspan
-    # machinery, streaming feeder, north-star (the LAST line is the
-    # headline the driver reads).
+    # machinery, streaming feeder, compiled profile, north-star (the LAST
+    # line is the headline the driver reads).
     assert "composed" in records[1]["metric"]
     assert "superspan" in records[2]["metric"]
     assert "streaming" in records[3]["metric"]
-    assert "north-star" in records[4]["metric"]
+    # The compiled-profile line ran under the second (best_fit) scheduler
+    # profile — its in-bench asserts fail loudly when the engine silently
+    # falls back to the default pipeline, so its presence IS the gate.
+    assert "best_fit profile" in records[4]["metric"]
+    assert "north-star" in records[5]["metric"]
     # Composed lines report the >= 5-span median with min/max spread; the
     # plain-shape lines keep the bare single-region value.
     for rec in records[1:4]:
@@ -63,11 +67,12 @@ def test_bench_smoke_emits_five_parseable_lines(capsys, tmp_path, monkeypatch):
         # committed decisions — spans.min == 0 can no longer happen.
         assert spans["dropped"] >= 0
         assert spans["min"] > 0
-    assert "spans" not in records[0] and "spans" not in records[4]
+    for rec in (records[0], records[4], records[5]):
+        assert "spans" not in rec
     # Telemetry summary embedded in (exactly) the traced composed lines:
     # per-phase wall time, the observed-vs-expected sync budget, dispatch
     # stats with the ladder_fallbacks observable, device-ring totals.
-    for rec in (records[0], records[4]):
+    for rec in (records[0], records[4], records[5]):
         assert "telemetry" not in rec
     for rec in records[1:4]:
         tel = rec["telemetry"]
@@ -118,14 +123,14 @@ def test_bench_smoke_emits_five_parseable_lines(capsys, tmp_path, monkeypatch):
 
 def test_bench_smoke_faults_adds_chaos_line(capsys, tmp_path, monkeypatch):
     """--faults appends a fault-enabled composed smoke line (the chaos
-    engine's dispatch/throughput tracker) after the standard five.
+    engine's dispatch/throughput tracker) after the standard six.
     --trace rides along so the traced composed lines are jit-cache hits
     from the previous test (same programs); the chaos line itself is
     untraced either way."""
     monkeypatch.setenv("KTPU_TRACE_PATH", str(tmp_path / "ktpu_trace"))
     records = _smoke_records(capsys, ["--smoke", "--faults", "--trace"])
-    assert len(records) == 6, records
-    assert "chaos" in records[5]["metric"]
-    assert records[5]["value"] > 0
-    assert records[5]["spans"]["n"] >= 5
-    assert "telemetry" not in records[5]
+    assert len(records) == 7, records
+    assert "chaos" in records[6]["metric"]
+    assert records[6]["value"] > 0
+    assert records[6]["spans"]["n"] >= 5
+    assert "telemetry" not in records[6]

@@ -194,6 +194,32 @@ def test_restore_structure_mismatch_raises_value_error(tmp_path):
         ckpt_restore(path, {"something": jnp.zeros((3,), jnp.int32)})
 
 
+def test_restore_profile_mismatch_raises_value_error(tmp_path):
+    """The compiled scheduler profile is an engine-build static: restoring
+    a checkpoint into an engine compiled with a DIFFERENT profile must
+    raise the actionable guard, not silently continue the run under
+    different scheduling semantics (both directions: profiled save into a
+    default engine, and a default save into a profiled engine — the
+    latter exercises the no-meta-means-default rule)."""
+    path = str(tmp_path / "ckpt")
+    profiled = _build(scheduler_profile="best_fit")
+    profiled.step_until_time(200.0)
+    profiled.save_checkpoint(path)
+    with pytest.raises(ValueError, match="scheduler-profile mismatch"):
+        _build().load_checkpoint(path)
+
+    path2 = str(tmp_path / "ckpt2")
+    plain = _build()
+    plain.step_until_time(200.0)
+    plain.save_checkpoint(path2)
+    with pytest.raises(ValueError, match="scheduler-profile mismatch"):
+        _build(scheduler_profile="best_fit").load_checkpoint(path2)
+    # Matching profile restores cleanly.
+    ok = _build(scheduler_profile="best_fit")
+    ok.load_checkpoint(path)
+    assert ok.profile.name == "best_fit"
+
+
 def test_restore_missing_path_raises_value_error(tmp_path):
     sim = _build()
     with pytest.raises(ValueError, match="no checkpoint"):

@@ -46,6 +46,7 @@ FIXTURE_CASES = [
     ("hostsync_cast_and_branch.py", "hostsync", 2, "int()"),
     ("jitstatic_unknown_param.py", "jitstatic", 1, "max_pods"),
     ("jitstatic_pair_drift.py", "jitstatic", 1, "collect_gauges"),
+    ("jitstatic_coupled_drift.py", "jitstatic", 1, "travel together"),
     ("prng_jax_random.py", "prng", 3, "jax.random"),
     ("prng_np_random.py", "prng", 2, "random"),
     ("envflags_direct_read.py", "envflags", 1, "KTPU_SUPERSPAN"),

@@ -3,9 +3,12 @@ CPU test platform): the kernel must reproduce the lax.scan formulation of the
 scheduling cycle bit for bit — same decisions, same allocatables, same parks —
 at both the kernel-call level and the full-simulation level.
 
-Scalar semantics under test: Fit filter + LeastAllocatedResources score +
-last-max-wins argmax (reference: src/core/scheduler/kube_scheduler.rs:63-152,
-plugin.rs:33-63).
+Scalar semantics under test: the compiled scheduler profile's filter mask +
+weighted score (batched/pipeline.py; default = Fit + LeastAllocatedResources,
+reference: src/core/scheduler/kube_scheduler.rs:63-152, plugin.rs:33-63) +
+last-max-wins argmax. Kernel-level parity is gated PER PROFILE: every
+supported profile has an independent NumPy restatement of its scoring below,
+so a lowering bug in one profile's expressions cannot hide behind another's.
 """
 
 import numpy as np
@@ -15,6 +18,7 @@ import jax
 import jax.numpy as jnp
 
 from kubernetriks_tpu.batched.engine import build_batched_from_traces
+from kubernetriks_tpu.batched.pipeline import compile_profile
 from kubernetriks_tpu.batched.state import compare_states
 from kubernetriks_tpu.config import SimulationConfig
 from kubernetriks_tpu.ops.scheduler_kernel import fused_schedule_cycle
@@ -26,9 +30,60 @@ from kubernetriks_tpu.trace.generator import (
 NEG_INF = np.float32(-np.inf)
 
 
-def scan_reference(alive, alloc_cpu, alloc_ram, valid, req_cpu, req_ram):
-    """NumPy restatement of the lax.scan scheduling core (float32 scores,
-    last-max-wins argmax), the oracle for the kernel."""
+def _np_least_allocated(cpu, ram, rc, rr):
+    cpu_f = cpu.astype(np.float32)
+    ram_f = ram.astype(np.float32)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        cpu_s = np.where(
+            cpu > 0, (cpu_f - np.float32(rc)) * np.float32(100.0) / cpu_f, NEG_INF
+        )
+        ram_s = np.where(
+            ram > 0, (ram_f - np.float32(rr)) * np.float32(100.0) / ram_f, NEG_INF
+        )
+    return (cpu_s + ram_s) * np.float32(0.5)
+
+
+def _np_most_allocated(cpu, ram, rc, rr):
+    cpu_f = cpu.astype(np.float32)
+    ram_f = ram.astype(np.float32)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        cpu_s = np.where(
+            cpu > 0, (np.float32(rc) - cpu_f) * np.float32(100.0) / cpu_f, NEG_INF
+        )
+        ram_s = np.where(
+            ram > 0, (np.float32(rr) - ram_f) * np.float32(100.0) / ram_f, NEG_INF
+        )
+    return (cpu_s + ram_s) * np.float32(0.5)
+
+
+def _np_balanced(cpu, ram, rc, rr):
+    cpu_f = cpu.astype(np.float32)
+    ram_f = ram.astype(np.float32)
+    ok = (cpu > 0) & (ram > 0)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        cpu_frac = np.float32(rc) / np.where(ok, cpu_f, np.float32(1.0))
+        ram_frac = np.float32(rr) / np.where(ok, ram_f, np.float32(1.0))
+    return np.where(
+        ok,
+        np.float32(100.0) - np.abs(cpu_frac - ram_frac) * np.float32(100.0),
+        NEG_INF,
+    )
+
+
+# Independent score restatements per profile: name -> [(scorer fn, weight)].
+NP_PROFILE_SCORERS = {
+    "default": [(_np_least_allocated, 1.0)],
+    "best_fit": [(_np_most_allocated, 1.0)],
+    "balanced_packing": [(_np_most_allocated, 1.0), (_np_balanced, 0.25)],
+}
+
+
+def scan_reference(
+    alive, alloc_cpu, alloc_ram, valid, req_cpu, req_ram, profile="default"
+):
+    """NumPy restatement of the lax.scan scheduling core under the given
+    profile (float32 scores, last-max-wins argmax), the oracle for the
+    kernel."""
     C, N = alloc_cpu.shape
     K = valid.shape[1]
     alloc_cpu = alloc_cpu.copy()
@@ -36,23 +91,15 @@ def scan_reference(alive, alloc_cpu, alloc_ram, valid, req_cpu, req_ram):
     assign = np.zeros((C, K), bool)
     fit_any = np.zeros((C, K), bool)
     best = np.zeros((C, K), np.int32)
+    scorers = NP_PROFILE_SCORERS[profile]
     for c in range(C):
         for k in range(K):
             fit = alive[c] & (req_cpu[c, k] <= alloc_cpu[c]) & (req_ram[c, k] <= alloc_ram[c])
-            cpu_f = alloc_cpu[c].astype(np.float32)
-            ram_f = alloc_ram[c].astype(np.float32)
-            with np.errstate(divide="ignore", invalid="ignore"):
-                cpu_s = np.where(
-                    alloc_cpu[c] > 0,
-                    (cpu_f - np.float32(req_cpu[c, k])) * np.float32(100.0) / cpu_f,
-                    NEG_INF,
-                )
-                ram_s = np.where(
-                    alloc_ram[c] > 0,
-                    (ram_f - np.float32(req_ram[c, k])) * np.float32(100.0) / ram_f,
-                    NEG_INF,
-                )
-            score = np.where(fit, (cpu_s + ram_s) * np.float32(0.5), NEG_INF)
+            total = np.zeros(N, np.float32)
+            for fn, w in scorers:
+                s = fn(alloc_cpu[c], alloc_ram[c], req_cpu[c, k], req_ram[c, k])
+                total = total + (s if w == 1.0 else s * np.float32(w))
+            score = np.where(fit, total, NEG_INF)
             fit_any[c, k] = fit.any()
             if fit.any():
                 m = score.max()
@@ -65,8 +112,11 @@ def scan_reference(alive, alloc_cpu, alloc_ram, valid, req_cpu, req_ram):
     return assign, fit_any, best, alloc_cpu, alloc_ram
 
 
+@pytest.mark.parametrize(
+    "profile", ["default", "best_fit", "balanced_packing"]
+)
 @pytest.mark.parametrize("shape", [(3, 7, 5), (5, 130, 9), (2, 256, 33)])
-def test_kernel_matches_scan_reference(shape):
+def test_kernel_matches_scan_reference(shape, profile):
     C, N, K = shape
     rng = np.random.default_rng(shape[1])
     alive = rng.random((C, N)) < 0.8
@@ -85,9 +135,10 @@ def test_kernel_matches_scan_reference(shape):
         jnp.asarray(req_cpu),
         jnp.asarray(req_ram),
         interpret=True,
+        profile=compile_profile(profile),
     )
     a_ref, f_ref, b_ref, cpu_ref, ram_ref = scan_reference(
-        alive, alloc_cpu, alloc_ram, valid, req_cpu, req_ram
+        alive, alloc_cpu, alloc_ram, valid, req_cpu, req_ram, profile=profile
     )
     np.testing.assert_array_equal(np.asarray(out[0]), a_ref)
     # fit_any/best are only defined for valid candidates: the kernel's
@@ -105,7 +156,7 @@ def test_kernel_matches_scan_reference(shape):
     np.testing.assert_array_equal(np.asarray(out[4]), ram_ref)
 
 
-def _build(use_pallas):
+def _build(use_pallas, profile=None):
     config = SimulationConfig.from_yaml(
         "sim_name: pallas_parity\nseed: 9\nscheduling_cycle_interval: 10.0"
     )
@@ -126,15 +177,20 @@ def _build(use_pallas):
         max_pods_per_cycle=16,
         use_pallas=use_pallas,
         pallas_interpret=use_pallas,
+        scheduler_profile=profile,
     )
 
 
-def test_full_sim_pallas_matches_scan():
+@pytest.mark.parametrize("profile", [None, "best_fit"])
+def test_full_sim_pallas_matches_scan(profile):
     """Whole-run parity: identical final state pytrees (phases, assignments,
-    allocatables, timings, metrics) between the scan and Pallas paths."""
-    sim_scan = _build(use_pallas=False)
-    sim_pallas = _build(use_pallas=True)
+    allocatables, timings, metrics) between the scan and Pallas paths —
+    under the default AND a non-default compiled profile (the profile is a
+    kernel static; both formulations must lower it identically)."""
+    sim_scan = _build(use_pallas=False, profile=profile)
+    sim_pallas = _build(use_pallas=True, profile=profile)
     assert sim_pallas.use_pallas and not sim_scan.use_pallas
+    assert sim_pallas.profile.name == (profile or "default")
     sim_scan.step_until_time(500.0)
     sim_pallas.step_until_time(500.0)
 
@@ -383,11 +439,23 @@ def test_commit_kernel_matches_scatters():
     np.testing.assert_array_equal(np.asarray(got[3]), park_tmp)
 
 
-@pytest.mark.parametrize("seed,megakernel", [(3, "1"), (17, "1"), (17, "0")])
-def test_random_trace_all_kernels_match_scan(seed, megakernel, monkeypatch):
+@pytest.mark.parametrize(
+    "seed,megakernel,profile",
+    [
+        (3, "1", None),
+        (17, "1", "balanced_packing"),
+        (17, "0", "best_fit"),
+    ],
+)
+def test_random_trace_all_kernels_match_scan(seed, megakernel, profile, monkeypatch):
     # Pin the megakernel choice regardless of ambient env (the engine reads
     # KTPU_MEGAKERNEL at build time); the "0" case keeps the two-kernel
-    # fallback path covered.
+    # fallback path covered. The non-default profiles ride the same
+    # engines (zero extra compiles vs parametrizing profiles separately):
+    # the megakernel case lowers balanced_packing into
+    # _select_cycle_commit_kernel, the two-kernel case lowers best_fit
+    # into _select_cycle_kernel — so every in-kernel decision core is
+    # profile-exercised against the scan path.
     monkeypatch.setenv("KTPU_MEGAKERNEL", megakernel)
     """Randomized full-sim equivalence with EVERY Pallas kernel forced on
     (the r4 MEGAKERNEL — selection + cycle + commit + queue-time estimator
@@ -461,6 +529,7 @@ cluster_autoscaler:
             max_pods_per_cycle=8,
             use_pallas=pallas,
             pallas_interpret=pallas,
+            scheduler_profile=profile,
         )
         if pallas:
             sim.use_pallas_select = True  # force the dense kernel set at C=4

@@ -25,6 +25,7 @@ import pytest
 from kubernetriks_tpu.rl.evaluate import (
     PROOF_LARGE,
     PROOF_WINDOWS,
+    bestfit_policy_apply,
     eval_kube,
     eval_policy,
     make_proof_sim,
@@ -36,9 +37,11 @@ HELDOUT_SEED_BASE = 91_000
 
 
 def _bestfit_apply(params, obs):
-    """Hand-coded best-fit (pack: least free cpu among fitting nodes) —
-    the heuristic the policy should discover; upper-bound reference."""
-    return -10.0 * obs[..., 2], jnp.zeros(obs.shape[:-2])
+    """Best-fit packing baseline — the heuristic the policy should
+    discover; upper-bound reference. Shared definition with the
+    scheduler's "best_fit" device profile (rl/evaluate.py wraps the
+    MostAllocatedResources scorer from the device-plugin registry)."""
+    return bestfit_policy_apply(params, obs)
 
 
 @pytest.mark.slow

@@ -9,7 +9,10 @@ and must be BIT-IDENTICAL to the ladder path it replaces:
    the unfused two-dispatch-slide ladder: every state leaf exact, metrics
    exact, same slide trajectory — fault-free AND with fault_injection
    enabled (the commit-time threefry draws are slot-keyed and
-   slide-invariant, so the on-device slides must not perturb them).
+   slide-invariant, so the on-device slides must not perturb them). The
+   fault variant runs the non-default "best_fit" compiled scheduler
+   profile on ladder+fused AND superspan executors — the chaos-on
+   profile bit-identity gate (batched/pipeline.py).
 2. The bounded RefillStage path (whole-trace payload over budget): staging
    installs, the double-buffered successor, and the SUPERSPAN_STAGE
    mid-flight exhaustion exit all preserve bit-identity.
@@ -91,12 +94,21 @@ def test_superspan_composed_bit_identical_under_faults(tmp_path):
     windows — the on-device slides must leave every draw slot-keyed exactly
     as the ladder path sees it.
 
+    BOTH engines run the non-default "best_fit" compiled scheduler profile
+    (batched/pipeline.py): this is the chaos-on bit-identity gate for a
+    non-default profile ACROSS EXECUTORS — the subject is the superspan
+    executor, and the comparator dispatches plain ladder chunks PLUS the
+    fused chunk+slide megastep (fuse_slide=True: the fused program is the
+    last ladder chunk of every slide span), so ladder, fused and superspan
+    all execute the same compiled profile and must agree bit for bit.
+    Riding the existing fault engines keeps this at zero extra engines
+    (the profile variant replaces the programs this test compiled anyway,
+    the PR-8 telemetry pattern).
+
     The ss engine ALSO runs with the flight recorder armed (PR 8): the
-    parity compare against the telemetry-OFF ladder is then the composed
-    HPA+CA+superspan+chaos telemetry bit-identity gate — telemetry-on,
-    across executors, changes no simulation leaf — at zero extra compile
-    cost (the ring variant replaces the program this test compiled
-    anyway). The composed-scale ring/report/budget gates ride here too;
+    parity compare against the telemetry-OFF comparator is then the
+    composed HPA+CA+superspan+chaos telemetry bit-identity gate. The
+    composed-scale ring/report/budget gates ride here too;
     tests/test_telemetry.py covers the mechanics on cheap engines."""
     ss = _run(
         _build_composed(
@@ -106,19 +118,33 @@ def test_superspan_composed_bit_identical_under_faults(tmp_path):
             superspan_chunk=4,
             telemetry=True,
             telemetry_ring=32,  # < executed windows: drains + wrap exercised
+            scheduler_profile="best_fit",
         )
     )
     assert ss.fault_params is not None
+    assert ss.profile.name == "best_fit"
     ladder = _run(
         _build_composed(
-            config_suffix=FAULT_SUFFIX, donate=False, fuse_slide=False
+            config_suffix=FAULT_SUFFIX,
+            donate=False,
+            fuse_slide=True,
+            scheduler_profile="best_fit",
         )
     )
+    # The comparator really exercised BOTH non-superspan executors: plain
+    # ladder chunks and the fused chunk+slide megastep.
+    assert ladder.dispatch_stats["window_chunks"] > 0
+    assert ladder.dispatch_stats["fused_slides"] > 0
     counters = ss.metrics_summary()["counters"]
     assert counters["pod_interruptions"] + counters["pods_failed"] > 0, (
         "fault run produced no faults; parity under faults is vacuous"
     )
     _assert_superspan_matches_ladder(ss, ladder)
+    # Threading the profile static added no host syncs: the superspan
+    # engine's dispatch accounting still meets the steady-state budget
+    # (asserted == below) and the comparator's chunk accounting is the
+    # fused-ladder shape, exactly as under the default profile.
+    assert ss.dispatch_stats["ladder_fallbacks"] == 0
 
     # --- composed-scale flight-recorder gates (PR 8) ---------------------
     from kubernetriks_tpu.telemetry.ring import RING_COLUMNS
