@@ -208,6 +208,7 @@ def run_composed(
     fast_forward=None,
     trace: bool = False,  # --trace: flight recorder + telemetry in the JSON
     trace_path: str = None,  # Chrome trace output (Perfetto-loadable)
+    metrics_path: str = None,  # capacity-observatory JSONL/prom export stem
     # PR 9 window-cost switches (None = engine/platform default) — exposed
     # so the A/B capture protocol can isolate each front against the same
     # bench scenario (see BENCH_r07.json).
@@ -307,6 +308,16 @@ cluster_autoscaler:
     )
 
     _assert_profile_compiled(sim, profile, "composed bench")
+
+    if trace and metrics_path:
+        # Capacity-observatory time-series export (telemetry/export.py):
+        # every ring drain appends one JSONL record (occupancy gauges,
+        # memory watermarks, watchdog verdicts) — the artifact CI uploads
+        # next to the Chrome trace; the final report also lands as a
+        # Prometheus textfile so standard scrape tooling can watch a run.
+        from kubernetriks_tpu.telemetry.export import JsonlExporter
+
+        sim.attach_metrics_exporter(JsonlExporter(metrics_path + ".jsonl"))
 
     def decisions_now() -> int:
         return int(np.asarray(sim.state.metrics.scheduling_decisions).sum())
@@ -438,8 +449,34 @@ cluster_autoscaler:
             "windows": pw["windows"],
             "ms_per_window": round(pw["ms_per_window"], 4),
         }
+        # Capacity-observatory section: occupancy high-water vs reserve
+        # capacity plus RSS/slab watermarks — present and sane on every
+        # traced run (CPU CI runs --smoke --trace, so a change that stops
+        # the observatory sampling fails loudly there).
+        res = rep.get("resources")
+        assert res and res["memory"].get("rss_bytes", 0) > 0, (
+            "composed bench --trace: telemetry report carries no "
+            "resources section (observatory not sampling?)"
+        )
+        occ = res["occupancy"]
+        assert {"hpa_reserve_used", "ca_reserve_used"} <= set(occ), occ
+        out["telemetry"]["resources"] = {
+            "occupancy": occ,
+            "rss_mb": round(res["memory"]["rss_bytes"] / 1e6, 1),
+            "rss_high_water_mb": round(
+                res["memory"]["high_water"].get("rss_bytes", 0) / 1e6, 1
+            ),
+            "slabs": res["memory"].get("slabs", {}),
+            "watchdog_fired": res["watchdog"]["fired"],
+        }
         if trace_path:
             sim.write_chrome_trace(trace_path)
+        if metrics_path:
+            from kubernetriks_tpu.telemetry.export import (
+                write_prometheus_textfile,
+            )
+
+            write_prometheus_textfile(metrics_path + ".prom", rep)
     # Release the streaming feeder's producer thread (and the engine it
     # keeps alive through its bound callbacks) — a driver looping bench
     # configurations must not accumulate parked feeders + staged slabs.
@@ -454,6 +491,18 @@ def _trace_path(label: str) -> str:
 
     stem = flag_str("KTPU_TRACE_PATH") or "ktpu_trace"
     return f"{stem}_{label}.json"
+
+
+def _metrics_path(label: str) -> str:
+    """Per-line capacity-observatory export stem:
+    <KTPU_METRICS_PATH or ./ktpu_metrics>_<label> — the engine appends
+    drain records to <stem>.jsonl (bounded rotation) and the bench writes
+    the final report to <stem>.prom (Prometheus textfile); CI uploads the
+    glob next to the Chrome traces."""
+    from kubernetriks_tpu.flags import flag_str
+
+    stem = flag_str("KTPU_METRICS_PATH") or "ktpu_metrics"
+    return f"{stem}_{label}"
 
 
 def _emit(metric: str, value) -> None:
@@ -516,6 +565,7 @@ def main(argv=None) -> None:
             "4 clusters x HPA+CA+sliding window)",
             run_composed(4, 8, trace=trace,
                          trace_path=_trace_path("smoke_composed") if trace else None,
+                         metrics_path=_metrics_path("smoke_composed") if trace else None,
                          **smoke_composed),
         )
         _emit(
@@ -530,6 +580,7 @@ def main(argv=None) -> None:
             run_composed(4, 8, superspan=True, fast_forward=False,
                          trace=trace,
                          trace_path=_trace_path("smoke_superspan") if trace else None,
+                         metrics_path=_metrics_path("smoke_superspan") if trace else None,
                          **smoke_composed),
         )
         _emit(
@@ -550,6 +601,7 @@ def main(argv=None) -> None:
             run_composed(4, 8, superspan=True, stream=True,
                          fast_forward=False, trace=trace,
                          trace_path=_trace_path("smoke_stream") if trace else None,
+                         metrics_path=_metrics_path("smoke_stream") if trace else None,
                          **smoke_composed),
         )
         _emit(
@@ -602,6 +654,7 @@ def main(argv=None) -> None:
         run_composed(
             trace=trace,
             trace_path=_trace_path("composed") if trace else None,
+            metrics_path=_metrics_path("composed") if trace else None,
             profile=profile,
         ),
     )

@@ -559,6 +559,7 @@ class BatchedSimulation:
         sanitize_mode: Optional[bool] = None,
         telemetry: Optional[bool] = None,
         telemetry_ring: int = 1024,
+        watchdog: Optional[bool] = None,
         lane_major: Optional[bool] = None,
         window_razor: Optional[bool] = None,
         ca_descatter: Optional[bool] = None,
@@ -592,9 +593,36 @@ class BatchedSimulation:
             self._telemetry = flag_bool("KTPU_TRACE")
         self.tracer = SpanTracer() if self._telemetry else NULL_TRACER
         self._telemetry_ring_size = max(8, int(telemetry_ring))
-        # window-index -> (C, K) drained ring rows; bounded by distinct
-        # windows, deduped across overlapping drains (telemetry/ring.py).
+        # Saturation watchdog (KTPU_WATCHDOG / watchdog arg): the capacity
+        # observatory's trajectory checks over the ring's reserve-occupancy
+        # columns (telemetry/observatory.py). Rides the flight recorder —
+        # unset means "armed exactly when telemetry is"; an explicit
+        # watchdog=True with telemetry off would silently watch nothing,
+        # so it raises (the stream-without-superspan precedent).
+        if watchdog is not None:
+            self._watchdog = bool(watchdog)
+        else:
+            env = flag_tristate("KTPU_WATCHDOG")
+            self._watchdog = self._telemetry if env is None else bool(env)
+        if self._watchdog and not self._telemetry:
+            raise ValueError(
+                "watchdog=True requires the flight recorder (telemetry="
+                "True / KTPU_TRACE=1): the saturation watchdog reads the "
+                "device ring's reserve-occupancy columns"
+            )
+        # window-index -> (C, K) drained ring rows, deduped across
+        # overlapping drains (telemetry/ring.py) and BOUNDED: the host
+        # series keeps at most telemetry_series_windows distinct windows
+        # (oldest pruned first, disclosed as ring.series_dropped_windows)
+        # — without the cap the observatory's lossless mid-call drains
+        # would re-grow an O(T) host term on exactly the endurance runs
+        # they exist to watch. The default (64k windows ≈ 11 MB at the
+        # composed shape) far exceeds any bench/test span; endurance
+        # consumers stream the full series through the JSONL exporter
+        # instead of holding it resident.
         self._ring_seen: dict = {}
+        self.telemetry_series_windows = 1 << 16
+        self._ring_series_dropped = 0
         self._ring_windows_recorded = 0  # device cursor high-water mark
         self._ring_drained_at = 0  # window cursor of the last ring drain
         self._pending_flow = 0  # tracer flow id of an in-flight readback
@@ -977,6 +1005,11 @@ class BatchedSimulation:
         self.autoscale_statics = None
         self.max_ca_pods_per_cycle = max_ca_pods_per_cycle
         self.max_pods_per_scale_down = max_pods_per_scale_down
+        # Per-cluster reserve capacities for the capacity observatory's
+        # occupancy gauges (telemetry/observatory.py): total HPA pod-group
+        # slots and total CA node slots. Host python ints, fetched ONCE
+        # here at build time (cold path, before mesh placement).
+        self._reserve_capacities: dict = {}
         self.pod_group_names = [[g.name for g in c.pod_groups] for c in compiled_traces]
         if hpa_on or ca_on:
             statics, extra_cpu, extra_ram, extra_names = build_autoscale_statics(
@@ -990,6 +1023,16 @@ class BatchedSimulation:
                 sliding=pod_window is not None,
             )
             self.autoscale_statics = statics
+            self._reserve_capacities = {
+                "hpa_reserve": [
+                    int(v)
+                    for v in np.asarray(statics.pg_slot_count).sum(axis=1)
+                ],
+                "ca_reserve": [
+                    int(v)
+                    for v in np.asarray(statics.ng_slot_count).sum(axis=1)
+                ],
+            }
             if ca_on and extra_names:
                 node_cap_cpu = np.concatenate(
                     [node_cap_cpu, np.tile(extra_cpu, (C, 1))], axis=1
@@ -1162,6 +1205,7 @@ class BatchedSimulation:
                         hpa_idx=jnp.asarray(hpa_idx0)
                     )
                 )
+        self.observatory = None
         if self._telemetry:
             # Attach the device metrics ring BEFORE mesh placement below,
             # so its leaves pick up the state sharding like every other
@@ -1171,6 +1215,17 @@ class BatchedSimulation:
 
             self.state = self.state._replace(
                 telemetry=init_ring(C, self._telemetry_ring_size)
+            )
+            # Capacity observatory (telemetry/observatory.py): occupancy
+            # series + memory watermarks + the saturation watchdog, fed
+            # strictly from drained host copies at the ring's existing
+            # drain points (_maybe_drain_ring / drain_telemetry).
+            from kubernetriks_tpu.telemetry.observatory import Observatory
+
+            self.observatory = Observatory(
+                interval=config.scheduling_cycle_interval,
+                capacities=self._reserve_capacities,
+                watchdog=self._watchdog,
             )
         ev_win, ev_off = from_f64_np(ev_time, config.scheduling_cycle_interval)
         self.slab = TraceSlab.build(ev_win, ev_off, ev_kind, ev_slot)
@@ -1756,6 +1811,10 @@ class BatchedSimulation:
                         "and no leading pod is terminal yet, and the window "
                         "already covers the whole plain trace segment"
                     )
+            # Ring pressure check riding the slide/grow sync that just
+            # blocked (host arithmetic otherwise — no new syncs): ladder
+            # spans longer than the ring stay lossless inside ONE call.
+            self._maybe_drain_ring()
 
     def _fused_slide_ok(self) -> bool:
         """Whether spans can end in the fused chunk+slide megastep: needs
@@ -2103,6 +2162,11 @@ class BatchedSimulation:
                     self._feeder.retire(lo)
                 self._stage_cur = None
             # SUPERSPAN_RUN with w <= target: K-span budget hit; redispatch.
+            # Telemetry ring pressure check (host arithmetic; the fetch, if
+            # due, rides the progress readback that JUST blocked — still
+            # zero new syncs): long single calls no longer wrap rows out
+            # unless ONE dispatch retires more windows than the ring holds.
+            self._maybe_drain_ring()
 
     def _resolve_pending_slide(self) -> bool:
         """Consume a fused slide's pending shift — the span's ONLY host
@@ -2838,26 +2902,167 @@ class BatchedSimulation:
 
     # --- telemetry readout --------------------------------------------------
 
-    def _maybe_drain_ring(self, force: bool = False) -> None:
+    def _maybe_drain_ring(self, force: bool = False):
         """Drain the device telemetry ring before records wrap out. The
         pressure check is pure host arithmetic (window cursor vs ring
         capacity); the blocking fetch itself lives in telemetry/ring.py
         and only ever runs at boundaries where the host already blocks —
-        step_until_time exit and readout — never inside the dispatch loop
-        (the no-new-syncs half of the telemetry contract)."""
+        step_until_time entry/exit, readout, and (since the capacity
+        observatory) the steady-state loop's OWN sync points, immediately
+        after the superspan progress readback / slide-shift readback
+        blocked anyway — never a new sync (the no-new-syncs half of the
+        telemetry contract; dispatch_stats stay equal on/off). Returns the
+        observatory's drain record when a drain happened, else None."""
         if self.state.telemetry is None:
-            return
+            return None
         pending = self.next_window_idx - self._ring_drained_at
         if not force and pending * 2 < self._telemetry_ring_size:
-            return
+            return None
         from kubernetriks_tpu.telemetry import ring as dring
 
-        buf, cursor = dring.snapshot(self.state.telemetry)
+        with sanitize.allow_transfer(
+            self._sanitize,
+            "telemetry ring drain riding an existing host-block boundary",
+        ):
+            buf, cursor = dring.snapshot(self.state.telemetry)
         dring.merge_snapshot(self._ring_seen, buf)
+        cap = self.telemetry_series_windows
+        if cap and len(self._ring_seen) > cap:
+            # Prune the OLDEST windows past the series bound (disclosed
+            # in telemetry_report as ring.series_dropped_windows).
+            drop = sorted(self._ring_seen)[: len(self._ring_seen) - cap]
+            for w in drop:
+                del self._ring_seen[w]
+            self._ring_series_dropped += len(drop)
         self._ring_windows_recorded = max(
             self._ring_windows_recorded, cursor
         )
         self._ring_drained_at = self.next_window_idx
+        return self._observe_drain(buf)
+
+    def _observe_drain(self, buf) -> Optional[Dict]:
+        """Feed one drained ring buffer (an OWNED host copy — see
+        drain_telemetry's aliasing note) to the capacity observatory:
+        occupancy ingest, memory-watermark sample, watchdog pass, and the
+        export hooks. Pure host work on drained copies."""
+        if self.observatory is None:
+            return None
+        fresh = self.observatory.ingest(buf)
+        feeder_rep = None
+        if self._feeder is not None:
+            feeder_rep = self._feeder.report()
+            self.dispatch_stats["feeder_slabs_produced"] = (
+                self._feeder_produced_total + feeder_rep["slabs_produced"]
+            )
+        stats = dict(self.dispatch_stats)
+        return self.observatory.observe(
+            resources=self._sample_resources(),
+            dispatch_stats=stats,
+            sync_budget={
+                "steady_state_expected": stats["superspans"]
+                + stats["fused_slides"],
+                "observed_slide_syncs": stats["slide_syncs"],
+            },
+            feeder=feeder_rep,
+            fresh=fresh,
+        )
+
+    def drain_telemetry(self) -> Dict:
+        """Force a telemetry-ring drain + observatory observation NOW and
+        return the drain record ({} when telemetry is off). THE explicit
+        seam the watchdog/export path uses between step_until_time calls
+        (PR 8 left mid-run drains riding step_until_time exits only; the
+        steady-state loop now also drains under pressure at its own sync
+        points, so a long single call can no longer silently exceed the
+        windows_recorded > windows_kept disclosure unless ONE dispatch
+        retires more than the ring holds).
+
+        Owned-copy rule (the donated-dispatch aliasing hazard): on the
+        CPU backend the drain's device fetch may ALIAS the live ring
+        buffer, and the next donated dispatch mutates that buffer in
+        place — telemetry/ring.snapshot therefore forces an owned
+        np.array copy before anything downstream sees the rows. Rows
+        returned here stay valid across later dispatches
+        (tests/test_telemetry.py pins this against a donated engine)."""
+        return self._maybe_drain_ring(force=True) or {}
+
+    def attach_metrics_exporter(self, exporter) -> None:
+        """Register a time-series export hook — an object with
+        .emit(record: dict), e.g. telemetry/export.JsonlExporter — called
+        once per ring drain with the observatory's pure-python record.
+        Exports run strictly from drained host copies (the export seam
+        carries the hot-path lint pragma with zero sync waivers)."""
+        if self.observatory is None:
+            raise ValueError(
+                "telemetry is off — build with telemetry=True or "
+                "KTPU_TRACE=1 to attach metrics exporters"
+            )
+        self.observatory.exporters.append(exporter)
+
+    def _sample_resources(self) -> Dict:  # ktpu: sync-ok(drain-point resource sampling: backend allocator stats + host RSS + slab byte accounting — host-side reads, no simulation-state sync)
+        """Host/device memory sample for the observatory's watermarks:
+        host RSS (procfs), backend allocator stats where the platform
+        exposes them (TPU/GPU; CPU usually returns nothing), and EXACT
+        slab/ring byte accounting from the staging machinery. Runs only
+        at drain points (ring pressure / explicit drain_telemetry), never
+        inside a dispatch."""
+        from kubernetriks_tpu.telemetry.observatory import sample_host_memory
+
+        res: Dict = dict(sample_host_memory())
+        dev_in_use = dev_peak = 0
+        have_dev = False
+        for d in jax.local_devices():
+            try:
+                ms = d.memory_stats()
+            except Exception:
+                ms = None
+            if not ms:
+                continue
+            have_dev = True
+            dev_in_use += int(ms.get("bytes_in_use", 0))
+            dev_peak += int(ms.get("peak_bytes_in_use", 0))
+        if have_dev:
+            res["device_bytes_in_use"] = dev_in_use
+            res["device_peak_bytes_in_use"] = dev_peak
+        res["slabs"] = self._slab_accounting()
+        return res
+
+    def _slab_accounting(self) -> Dict:
+        """Exact staging-memory accounting (host arithmetic over known
+        geometry + buffer sizes): the device slide payload, live staging
+        slabs, the feeder ring's capacity bound, and the telemetry ring
+        itself. Flat numbers here across superspans ARE the bounded-memory
+        claim of the streaming pipeline (tests/test_soak.py pins it)."""
+
+        def nbytes(tree) -> int:
+            total = 0
+            for leaf in jax.tree_util.tree_leaves(tree):
+                total += int(getattr(leaf, "nbytes", 0) or 0)
+            return total
+
+        acct = {
+            "device_slide_bytes": (
+                nbytes(self._device_slide)
+                if self._device_slide is not None
+                else 0
+            ),
+            "stage_bytes": nbytes(
+                [s for s in (self._stage_cur, self._stage_next) if s is not None]
+            ),
+            "telemetry_ring_bytes": (
+                nbytes(self.state.telemetry)
+                if self.state.telemetry is not None
+                else 0
+            ),
+        }
+        if self._feeder is not None:
+            n_arrays = 5 + (1 if self.autoscale_statics is not None else 0)
+            per_slab = (
+                self.n_clusters * self._feeder.width * 4 * n_arrays
+            )
+            acct["feeder_slab_bytes"] = per_slab
+            acct["feeder_ring_capacity_bytes"] = per_slab * self._feeder.depth
+        return acct
 
     def telemetry_window_series(self):
         """(windows (Wn,), records (Wn, C, K)) device-ring per-window
@@ -2941,12 +3146,29 @@ class BatchedSimulation:
                 "columns": list(dring.RING_COLUMNS),
                 "windows_recorded": self._ring_windows_recorded,
                 "windows_kept": int(len(wins)),
+                "series_dropped_windows": self._ring_series_dropped,
+                # Sums only make sense for the per-window ACTION deltas;
+                # point-in-time gauges (queue depths, alive nodes, the
+                # observatory's reserve-occupancy columns) report their
+                # high-water mark instead.
                 "totals": {
                     name: int(data[:, :, col].sum()) if len(wins) else 0
                     for col, name in enumerate(dring.RING_COLUMNS)
-                    if col > 0
+                    if col > 0 and name not in dring.GAUGE_COLUMNS
+                },
+                "high_water": {
+                    name: int(data[:, :, col].max()) if len(wins) else 0
+                    for col, name in enumerate(dring.RING_COLUMNS)
+                    if name in dring.GAUGE_COLUMNS
                 },
             }
+        if self.observatory is not None:
+            # Capacity-observatory section: occupancy (current +
+            # high-water vs reserve capacity), host/device memory
+            # watermarks, slab/ring accounting, watchdog verdicts. The
+            # memory sample is refreshed so the report reflects NOW.
+            self.observatory.update_memory(self._sample_resources())
+            rep["resources"] = self.observatory.report()
             windows = int(self._ring_windows_recorded)
             if windows > 0:
                 rep["per_window"] = {
@@ -3141,8 +3363,13 @@ class BatchedSimulation:
             # Ring rows drained before the restore described the
             # pre-restore trajectory; the restored ring carries its own.
             self._ring_seen = {}
+            self._ring_series_dropped = 0
             self._ring_windows_recorded = 0
             self._ring_drained_at = 0
+            if self.observatory is not None:
+                # The occupancy trajectory restarts at the restored state;
+                # mixing pre-restore points would corrupt the watchdog fit.
+                self.observatory.reset()
 
     def gauge_series(self):
         """(times (W,), samples (W, C, 7)) accumulated gauge time-series;

@@ -228,7 +228,19 @@ TELEM_HPA_PODS = 4  # HPA pod actions this window (scale-ups + scale-downs)
 TELEM_CA_NODES = 5  # CA node actions this window (scale-ups + scale-downs)
 TELEM_FAULTS = 6  # chaos events this window (crashes/recoveries/retries/fails)
 TELEM_ALIVE_NODES = 7  # alive node count after the window
-TELEMETRY_COLS = 8
+# Capacity-observatory occupancy gauges (telemetry/observatory.py): the
+# reserve consumptions whose exhaustion kills a long run (ROADMAP #2),
+# folded from tiny (C, G)/(C,) state the window body already holds — no
+# reductions over the trace slab or pod axis beyond what the record
+# already pays, zeros when autoscaling is off.
+TELEM_HPA_RESERVE = 8  # live HPA replicas across groups (hpa_tail - hpa_head)
+TELEM_CA_RESERVE = 9  # CA slots consumed across groups (ca_cursor, monotone)
+# Plain-trace refill columns the device pod window has NOT yet covered
+# (trace_pod_bound - pod_base - plain window width). Values at or above
+# telemetry/observatory.UNBOUNDED_SENTINEL mean "no sliding window /
+# whole trace resident" (the trace_pod_bound default is a huge sentinel).
+TELEM_POD_HEADROOM = 10
+TELEMETRY_COLS = 11
 
 
 class TelemetryRing(NamedTuple):

@@ -1720,17 +1720,26 @@ def _run_scheduling_cycle(
 
 
 def _telemetry_record(
-    state: ClusterBatchState, m0, W: jnp.ndarray, lane_major: bool = False
+    state: ClusterBatchState,
+    m0,
+    W: jnp.ndarray,
+    consts: StepConstants,
+    lane_major: bool = False,
 ):
     """Fold one per-window record row into the device telemetry ring:
     metric-counter deltas vs the window's incoming metrics `m0` plus queue
-    depths / alive-node counts read straight off the post-window state.
-    Pure bookkeeping — reads simulation state, writes only the ring — so
-    telemetry-on runs are bit-identical to telemetry-off on every other
-    leaf (tests/test_telemetry.py pins this). Cost: two (C, P) phase
-    reductions, one (C, N) reduction and one (C, 1, K) scatter per window,
-    only compiled in when the ring exists (state.telemetry is a
-    structural static, like `auto`)."""
+    depths / alive-node counts / reserve-occupancy gauges read straight
+    off the post-window state. Pure bookkeeping — reads simulation state,
+    writes only the ring — so telemetry-on runs are bit-identical to
+    telemetry-off on every other leaf (tests/test_telemetry.py pins this).
+    Cost: two (C, P) phase reductions, one (C, N) reduction, two tiny
+    (C, G) occupancy sums and one (C, 1, K) scatter per window, only
+    compiled in when the ring exists (state.telemetry is a structural
+    static, like `auto`). The occupancy columns are derived from state
+    the body already carries (auto counters, pod_base, static geometry) —
+    no reductions over the slab or the pod axis beyond the record's own,
+    and nothing here runs on the KTPU_WINDOW_RAZOR skip path (the record
+    sits after the razor cond, once per executed window)."""
     from kubernetriks_tpu.batched.state import TelemetryRing
 
     ring = state.telemetry
@@ -1739,6 +1748,31 @@ def _telemetry_record(
     queued = (pods.phase == PHASE_QUEUED).sum(axis=1, dtype=jnp.int32)
     unsched = (pods.phase == PHASE_UNSCHEDULABLE).sum(axis=1, dtype=jnp.int32)
     alive = nodes.alive.sum(axis=0 if lane_major else 1, dtype=jnp.int32)
+    # Reserve-occupancy gauges (capacity observatory): live HPA replicas
+    # (tail - head over groups), consumed CA reserve slots (ca_cursor is
+    # monotone — THE saturation driver of ROADMAP #2), and the remaining
+    # plain-trace headroom of the sliding pod window. auto-off engines
+    # record zeros (their programs never carry the auto pytree anyway).
+    if state.auto is not None:
+        hpa_used = (state.auto.hpa_tail - state.auto.hpa_head).sum(
+            axis=1, dtype=jnp.int32
+        )
+        ca_used = state.auto.ca_cursor.sum(axis=1, dtype=jnp.int32)
+    else:
+        hpa_used = jnp.zeros_like(queued)
+        ca_used = jnp.zeros_like(queued)
+    # The device window covers plain_width plain-trace slots starting at
+    # pod_base (plain_width = full device axis on non-segmented runs);
+    # trace_pod_bound defaults to a huge sentinel there, so the headroom
+    # column lands >= UNBOUNDED_SENTINEL and the observatory skips it.
+    # Scalar int32 arithmetic on values the body already carries.
+    plain_width = jnp.minimum(
+        jnp.int32(pods.phase.shape[1]),
+        consts.trace_pod_bound - consts.resident_shift,
+    )
+    headroom = jnp.maximum(
+        consts.trace_pod_bound - state.pod_base - plain_width, 0
+    )
     hpa = (m1.scaled_up_pods - m0.scaled_up_pods) + (
         m1.scaled_down_pods - m0.scaled_down_pods
     )
@@ -1762,6 +1796,9 @@ def _telemetry_record(
             ca,
             faults,
             alive,
+            hpa_used,
+            ca_used,
+            headroom,
         ],
         axis=-1,
     ).astype(jnp.int32)
@@ -1890,7 +1927,9 @@ def _window_body(
         state = state._replace(auto=auto)
     if state.telemetry is not None:
         state = state._replace(
-            telemetry=_telemetry_record(state, m0, W, lane_major=lane_major)
+            telemetry=_telemetry_record(
+                state, m0, W, consts, lane_major=lane_major
+            )
         )
     return state
 

@@ -184,6 +184,15 @@ def run_batched(config: SimulationConfig, args) -> int:
         "batched run: %d clusters x %d node slots x %d pod slots (pallas=%s)",
         sim.n_clusters, sim.n_nodes, sim.n_pods, sim.use_pallas,
     )
+    if args.metrics_export:
+        # Capacity-observatory time-series export: every telemetry-ring
+        # drain appends a JSONL record (occupancy gauges, memory
+        # watermarks, watchdog verdicts); the final report lands as a
+        # Prometheus textfile next to it. Requires the flight recorder
+        # (KTPU_TRACE=1) — attach_metrics_exporter raises otherwise.
+        from kubernetriks_tpu.telemetry.export import JsonlExporter
+
+        sim.attach_metrics_exporter(JsonlExporter(args.metrics_export + ".jsonl"))
     sim.collect_gauges = bool(args.gauge_csv)
     t0 = time.perf_counter()
     sim.run_to_completion()
@@ -201,8 +210,11 @@ def run_batched(config: SimulationConfig, args) -> int:
     print(render_metrics(summary, args.report or "json"))
     if sim._telemetry:
         # Flight recorder was armed (KTPU_TRACE=1): emit the telemetry
-        # report in the same format and write the Perfetto trace.
-        print(render_telemetry(sim.telemetry_report(), args.report or "json"))
+        # report in the same format and write the Perfetto trace. ONE
+        # report serves both the render and the Prometheus textfile (a
+        # second call would only force a redundant drain).
+        telemetry_rep = sim.telemetry_report()
+        print(render_telemetry(telemetry_rep, args.report or "json"))
         from kubernetriks_tpu.flags import flag_str
 
         trace_path = (flag_str("KTPU_TRACE_PATH") or "ktpu_trace") + ".json"
@@ -210,6 +222,18 @@ def run_batched(config: SimulationConfig, args) -> int:
         logging.getLogger(__name__).info(
             "wrote Chrome trace (Perfetto-loadable) to %s", trace_path
         )
+        if args.metrics_export:
+            from kubernetriks_tpu.telemetry.export import (
+                write_prometheus_textfile,
+            )
+
+            prom = write_prometheus_textfile(
+                args.metrics_export + ".prom", telemetry_rep
+            )
+            logging.getLogger(__name__).info(
+                "wrote observatory metrics to %s.jsonl and %s",
+                args.metrics_export, prom,
+            )
     return 0
 
 
@@ -254,6 +278,14 @@ def main(argv=None) -> int:
         "--gauge-csv",
         default=None,
         help="Path for the 5s gauge-metrics CSV (off by default)",
+    )
+    parser.add_argument(
+        "--metrics-export",
+        default=None,
+        help="batched backend: capacity-observatory export stem — drain "
+        "records append to <stem>.jsonl (bounded rotation) and the final "
+        "telemetry report is written as <stem>.prom (Prometheus "
+        "textfile). Requires the flight recorder (KTPU_TRACE=1).",
     )
     parser.add_argument(
         "--report",

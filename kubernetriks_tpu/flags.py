@@ -193,6 +193,31 @@ _FLAGS = [
         "working directory.",
     ),
     Flag(
+        "KTPU_WATCHDOG",
+        "tristate",
+        None,
+        "Saturation watchdog (telemetry/observatory.py): at every "
+        "telemetry-ring drain, fit the reserve-occupancy trajectories "
+        "(CA node-slot reserve, HPA pod-reserve, pod-window headroom) and "
+        "emit SaturationWarning with an estimated time-to-exhaustion "
+        "BEFORE the loud reserve bound fires; also flags feeder "
+        "starvation and sync-budget violations. Unset: armed exactly when "
+        "the flight recorder is (KTPU_TRACE / telemetry=True) — it reads "
+        "the ring's occupancy columns, so it rides telemetry; an explicit "
+        "1 with telemetry off raises at engine build instead of silently "
+        "watching nothing.",
+    ),
+    Flag(
+        "KTPU_METRICS_PATH",
+        "str",
+        None,
+        "Output path stem for the capacity observatory's time-series "
+        "export (telemetry/export.py): bench.py --trace appends drain "
+        "records to <stem>_<label>.jsonl (bounded, rotating) and writes "
+        "the final report as <stem>_<label>.prom (Prometheus textfile). "
+        "Unset: ktpu_metrics under the working directory.",
+    ),
+    Flag(
         "KUBERNETRIKS_PALLAS",
         "tristate",
         None,

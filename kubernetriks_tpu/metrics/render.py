@@ -127,6 +127,28 @@ def render_telemetry(rep: Dict[str, Any], fmt: str) -> str:
             [f"Ring total {humanize(k).lower()}", v]
             for k, v in ring.get("totals", {}).items()
         ]
+        rows += [
+            [f"Ring high-water {humanize(k).lower()}", v]
+            for k, v in ring.get("high_water", {}).items()
+        ]
+    resources = rep.get("resources")
+    if resources:
+        # Capacity-observatory summary: occupancy vs reserve, memory
+        # watermarks, watchdog verdicts (full detail stays in the JSON).
+        for name, entry in resources.get("occupancy", {}).items():
+            if isinstance(entry, dict) and "used_max" in entry:
+                cap = entry.get("capacity_min")
+                rows.append(
+                    [
+                        f"Occupancy {humanize(name).lower()}",
+                        f"{entry['used_max']}/{cap}" if cap else entry["used_max"],
+                    ]
+                )
+        mem = resources.get("memory", {})
+        if mem.get("rss_bytes"):
+            rows.append(["Host RSS (MB)", round(mem["rss_bytes"] / 1e6, 1)])
+        fired = resources.get("watchdog", {}).get("fired", {})
+        rows.append(["Watchdog verdicts fired", len(fired)])
     if rows:
         parts.append(format_table(rows, ["Metric", "Count"]))
     return "\n".join(parts)

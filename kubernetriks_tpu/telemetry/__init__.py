@@ -14,9 +14,21 @@ Two synchronized halves (docs/DESIGN.md §"Telemetry"):
   syncs and stays bit-identical to telemetry-off on every simulation
   leaf.
 
+Plus the capacity half (docs/DESIGN.md §10):
+
+- **Capacity observatory** (observatory.py): reserve-occupancy series
+  (the ring's hpa/ca/headroom gauge columns), host/device memory
+  watermarks sampled at ring drains, and the saturation watchdog
+  (`KTPU_WATCHDOG`) whose time-to-exhaustion estimates fire BEFORE the
+  loud reserve bound.
+- **Time-series export** (export.py): bounded JSONL drain records + an
+  atomic Prometheus-textfile writer, fed strictly from drained host
+  copies.
+
 Enable with `KTPU_TRACE=1` (or `BatchedSimulation(telemetry=True)`);
-`engine.telemetry_report()` / `engine.write_chrome_trace()` read it out,
-and `bench.py --trace` embeds the summary in the BENCH JSON.
+`engine.telemetry_report()` / `engine.write_chrome_trace()` /
+`engine.drain_telemetry()` read it out, and `bench.py --trace` embeds
+the summary in the BENCH JSON.
 """
 
 from kubernetriks_tpu.telemetry.gauges import GaugeSeries

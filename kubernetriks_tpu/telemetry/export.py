@@ -1,0 +1,141 @@
+# ktpu: hot-path
+"""Time-series export seams for the capacity observatory: bounded JSONL
+append + Prometheus-textfile writer.
+
+Both exporters consume the PURE-PYTHON drain records / reports the
+observatory builds from drained host copies — never a device value, never
+a jax import. This module carries the `# ktpu: hot-path` pragma ON
+PURPOSE (like tracer.py and observatory.py) and stays golden-clean with
+ZERO sync-ok waivers: an export hook is exactly the place a careless
+`np.asarray(state...)` would smuggle a host sync into the drain path, so
+the lint host-sync pass patrols it (seeded fixture:
+tests/lint_fixtures/hostsync_export_hook.py).
+
+- `JsonlExporter` appends one JSON object per drain record, BOUNDED: when
+  the file would exceed `max_bytes` it rotates to `<path>.1` (replacing
+  the previous rotation), so an endurance run's metrics file is capped at
+  ~2x max_bytes no matter how many weeks it simulates. Tail-friendly:
+  `tail -f metrics.jsonl | jq .occupancy`.
+- `write_prometheus_textfile` renders the latest telemetry report as
+  Prometheus text exposition format via tmp+rename (atomic — the
+  node_exporter textfile collector's contract), so standard scrape
+  tooling can watch a resident fleet without any HTTP endpoint in the
+  engine.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+from typing import Dict, List, Optional
+
+
+class JsonlExporter:
+    """Bounded JSONL appender for observatory drain records."""
+
+    def __init__(self, path: str, max_bytes: int = 8 << 20) -> None:
+        self.path = path
+        self.max_bytes = int(max_bytes)
+        self.lines_written = 0
+        directory = os.path.dirname(os.path.abspath(path))
+        if directory:
+            os.makedirs(directory, exist_ok=True)
+
+    def emit(self, record: Dict) -> None:
+        line = json.dumps(record, sort_keys=True) + "\n"
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            size = 0
+        if size and size + len(line) > self.max_bytes:
+            # Rotate: the previous window of history survives as .1, the
+            # live file restarts — total footprint <= ~2x max_bytes.
+            os.replace(self.path, self.path + ".1")
+        with open(self.path, "a") as fh:
+            fh.write(line)
+        self.lines_written += 1
+
+
+def _prom_escape(value: str) -> str:
+    return value.replace("\\", "\\\\").replace('"', '\\"')
+
+
+def _num(value) -> Optional[float]:
+    if isinstance(value, bool):
+        return 1.0 if value else 0.0
+    if isinstance(value, (int, float)):
+        return float(value)
+    return None
+
+
+def prometheus_lines(report: Dict, prefix: str = "ktpu_") -> List[str]:
+    """Render a telemetry report (engine.telemetry_report()) as Prometheus
+    text exposition lines: dispatch counters, the sync budget, the ring
+    totals, and the capacity observatory's occupancy/memory gauges."""
+    lines: List[str] = []
+
+    def gauge(name: str, value, labels: Optional[Dict[str, str]] = None):
+        num = _num(value)
+        if num is None:
+            return
+        label_txt = ""
+        if labels:
+            inner = ",".join(
+                f'{k}="{_prom_escape(str(v))}"' for k, v in sorted(labels.items())
+            )
+            label_txt = "{" + inner + "}"
+        # Precision-preserving rendering: %g would round integers past 6
+        # significant digits (an endurance run's window counters / byte
+        # watermarks must stay exact; repr round-trips floats).
+        txt = (
+            str(int(num))
+            if math.isfinite(num) and num == int(num)
+            else repr(num)
+        )
+        lines.append(f"{prefix}{name}{label_txt} {txt}")
+
+    for key, value in (report.get("dispatch_stats") or {}).items():
+        gauge("dispatch_total", value, {"kind": key})
+    budget = report.get("sync_budget") or {}
+    gauge("sync_budget_expected", budget.get("steady_state_expected"))
+    gauge("sync_budget_observed", budget.get("observed_slide_syncs"))
+    ring = report.get("ring") or {}
+    gauge("ring_windows_recorded", ring.get("windows_recorded"))
+    gauge("ring_windows_kept", ring.get("windows_kept"))
+    for key, value in (ring.get("totals") or {}).items():
+        gauge("ring_total", value, {"column": key})
+    resources = report.get("resources") or {}
+    for name, entry in (resources.get("occupancy") or {}).items():
+        if not isinstance(entry, dict):
+            continue
+        for field, value in entry.items():
+            gauge("occupancy", value, {"gauge": name, "field": field})
+    memory = resources.get("memory") or {}
+    for key, value in memory.items():
+        if key == "high_water":
+            for hw_key, hw_val in value.items():
+                gauge("memory_high_water_bytes", hw_val, {"kind": hw_key})
+        elif isinstance(value, dict):
+            for sub_key, sub_val in value.items():
+                gauge("memory_bytes", sub_val, {"kind": f"{key}.{sub_key}"})
+        else:
+            gauge("memory_bytes", value, {"kind": key})
+    watchdog = (resources.get("watchdog") or {})
+    gauge("watchdog_enabled", watchdog.get("enabled"))
+    for kind, window in (watchdog.get("fired") or {}).items():
+        gauge("watchdog_fired_window", window, {"kind": kind})
+    gauge("observatory_samples", resources.get("samples"))
+    return lines
+
+
+def write_prometheus_textfile(
+    path: str, report: Dict, prefix: str = "ktpu_"
+) -> str:
+    """Atomically write the report as a Prometheus textfile (tmp+rename —
+    a scraping node_exporter never sees a torn file)."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        fh.write("\n".join(prometheus_lines(report, prefix)) + "\n")
+    os.replace(tmp, path)
+    return path

@@ -1,0 +1,497 @@
+# ktpu: hot-path
+"""Capacity observatory: reserve-occupancy tracking, memory watermarks
+and the saturation watchdog (the flight recorder's capacity half).
+
+The flight recorder (PR 8) made per-window *cost* visible; this module
+makes the two things that actually kill a long run visible *before* they
+do:
+
+- **Reserve occupancy.** The batched path consumes bounded reserves that
+  churn can exhaust (ROADMAP #2): the CA node-slot reserve (`ca_cursor`
+  is monotone — slots are never reclaimed), the HPA pod-group slot
+  reserve, and the sliding pod window's plain-trace headroom. The window
+  body appends these as gauge columns of the device telemetry ring
+  (batched/state.py TELEM_HPA_RESERVE / TELEM_CA_RESERVE /
+  TELEM_POD_HEADROOM), so they ride the existing per-window record
+  scatter — zero new reductions on the hot path, zero new host syncs
+  (the ring drains only at existing host-block boundaries, PR 8's rule).
+- **Memory watermarks.** At those same drain points the engine samples
+  host RSS, backend device-memory stats and exact slab/ring accounting
+  (`engine._sample_resources`); this module folds the samples into
+  high-water marks, so an O(T) leak shows as a rising watermark instead
+  of an OOM three weeks in.
+- **Saturation watchdog.** At each drain the observatory fits the recent
+  occupancy trajectory (closed-form least squares per cluster) and emits
+  a `SaturationWarning` with the estimated time-to-exhaustion while the
+  run is still healthy — BEFORE the loud reserve bound
+  (`engine.check_autoscaler_bounds`) fires at readout. It also flags a
+  starved/wasteful streaming feeder (production vs install drift, the
+  feeder-not-ready stall counter) and steady-state sync-budget
+  violations.
+
+Everything here runs strictly on DRAINED HOST COPIES (owned numpy
+arrays from `telemetry/ring.snapshot`, plain dicts from the engine):
+this module carries the `# ktpu: hot-path` pragma ON PURPOSE and stays
+golden-clean with ZERO sync-ok waivers — it must never touch a device
+value. Export seams (JSONL, Prometheus textfile) live in
+`telemetry/export.py` under the same contract.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import time
+import warnings
+from collections import deque
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from kubernetriks_tpu.batched.state import (
+    TELEM_CA_RESERVE,
+    TELEM_HPA_RESERVE,
+    TELEM_POD_HEADROOM,
+    TELEM_WINDOW,
+)
+
+# TELEM_POD_HEADROOM values at or above this mean "no sliding window /
+# whole plain trace resident" (state.StepConstants.trace_pod_bound
+# defaults to a 1 << 30 sentinel): the watchdog skips those clusters.
+UNBOUNDED_SENTINEL = 1 << 28
+
+
+class SaturationWarning(UserWarning):
+    """A capacity reserve is trending toward exhaustion (or a pipeline
+    health invariant drifted): actionable ahead of the loud bound."""
+
+
+def sample_host_memory() -> Dict[str, int]:
+    """Host memory sample: current RSS from /proc/self/statm (Linux;
+    0 where unavailable) and the process peak RSS from getrusage.
+    Pure host I/O — no jax, no device values."""
+    rss = 0
+    try:
+        with open("/proc/self/statm") as fh:
+            fields = fh.read().split()
+        rss = int(fields[1]) * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, IndexError, ValueError):
+        pass
+    peak = 0
+    try:
+        import resource
+
+        # ru_maxrss is KiB on Linux.
+        peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+    except Exception:
+        pass
+    return {"rss_bytes": rss, "peak_rss_bytes": peak}
+
+
+def fit_slope(x: Sequence[float], y: np.ndarray) -> np.ndarray:
+    """Closed-form least-squares slope of y against x. x: (n,) times;
+    y: (n,) or (n, C) values. Returns a scalar or (C,) slope (0 where x
+    has no spread)."""
+    xs = np.fromiter((float(v) for v in x), dtype=np.float64)
+    ys = y.astype(np.float64)
+    xm = xs.mean()
+    dx = xs - xm
+    denom = float((dx * dx).sum())
+    if denom <= 0.0:
+        return np.zeros(ys.shape[1:], np.float64) if ys.ndim > 1 else np.float64(0.0)
+    dy = ys - ys.mean(axis=0)
+    if ys.ndim > 1:
+        return (dx[:, None] * dy).sum(axis=0) / denom
+    return (dx * dy).sum() / denom
+
+
+def time_to_exhaustion(
+    now: float, slope: float, capacity: Optional[float], falling: bool = False
+) -> float:
+    """Estimated seconds until `now` reaches `capacity` at `slope`
+    (rising gauges) or reaches zero (falling gauges). math.inf when the
+    trajectory never gets there."""
+    if falling:
+        if slope >= 0.0:
+            return math.inf
+        return max(now, 0.0) / -slope
+    if capacity is None or slope <= 0.0:
+        return math.inf
+    remaining = capacity - now
+    if remaining <= 0.0:
+        return 0.0
+    return remaining / slope
+
+
+class Observatory:
+    """Folds drained ring buffers + resource samples into occupancy
+    series, high-water marks and watchdog verdicts.
+
+    Parameters:
+    - interval: scheduling interval (seconds per window) — converts the
+      window axis to sim-seconds for trajectory fits.
+    - capacities: {"hpa_reserve": [per-cluster total], "ca_reserve":
+      [per-cluster total]} — plain python ints, computed once at engine
+      build from the autoscale statics (None entries = no such reserve).
+    - watchdog: arm the saturation checks (off: ingest/report only).
+    - warn_frac: occupancy fraction that fires immediately.
+    - min_frac: floor below which trajectory (eta-based) warnings stay
+      quiet — an early-transient slope extrapolated from a nearly-empty
+      reserve is noise, not a verdict.
+    - horizon_s: fire when estimated exhaustion lands within this many
+      sim-seconds (default: 500 windows).
+    - fit_window: trajectory points kept per gauge (bounded history —
+      the observatory's memory is O(fit_window * C), never O(T)).
+    - exporters: objects with .emit(record: dict) called once per
+      observe() with the pure-python drain record (telemetry/export.py).
+    """
+
+    def __init__(
+        self,
+        *,
+        interval: float,
+        capacities: Optional[Dict[str, Sequence[int]]] = None,
+        watchdog: bool = True,
+        warn_frac: float = 0.8,
+        min_frac: float = 0.3,
+        horizon_s: Optional[float] = None,
+        min_points: int = 4,
+        fit_window: int = 64,
+        exporters: Optional[list] = None,
+        max_events: int = 256,
+    ) -> None:
+        self.interval = float(interval)
+        self.capacities = dict(capacities or {})
+        self.watchdog = bool(watchdog)
+        self.warn_frac = float(warn_frac)
+        self.min_frac = float(min_frac)
+        self.horizon_s = (
+            float(horizon_s) if horizon_s is not None else 500.0 * self.interval
+        )
+        self.min_points = max(2, int(min_points))
+        self.fit_window = max(self.min_points, int(fit_window))
+        self.exporters = list(exporters or [])
+        self.max_events = int(max_events)
+        self.reset()
+
+    def reset(self) -> None:
+        """Drop accumulated series/watermarks (checkpoint restore: the
+        restored run is a fresh trajectory)."""
+        # (window, hpa_used (C,), ca_used (C,), headroom (C,)) — bounded.
+        self._points: deque = deque(maxlen=self.fit_window)
+        self._last_window = -1
+        self._high_water: Dict[str, int] = {}
+        self._mem_high: Dict[str, int] = {}
+        self._last_resources: Dict = {}
+        self._last_stall_not_ready = 0
+        self.events: List[Dict] = []
+        self.fired: Dict[str, int] = {}
+        self.samples = 0
+
+    # -- ingest -------------------------------------------------------------
+
+    def ingest(self, buf: np.ndarray) -> int:
+        """Fold one drained ring buffer ((C, R, K) OWNED numpy copy —
+        telemetry/ring.snapshot's owned-copy rule: a view of the device
+        buffer would be mutated in place by the next donated dispatch)
+        into the bounded occupancy history. Overlapping drains re-observe
+        rows bit-identically; only windows past the last ingested one are
+        appended. Returns the number of FRESH windows ingested (0 when
+        the drain re-observed only known rows)."""
+        wins = buf[0, :, TELEM_WINDOW]
+        fresh = np.nonzero(wins > self._last_window)[0]
+        if fresh.size == 0:
+            return 0
+        order = fresh[np.argsort(wins[fresh], kind="stable")]
+        for slot in order.tolist():
+            w = int(wins[slot])
+            hpa = buf[:, slot, TELEM_HPA_RESERVE].copy()
+            ca = buf[:, slot, TELEM_CA_RESERVE].copy()
+            head = buf[:, slot, TELEM_POD_HEADROOM].copy()
+            self._points.append((w, hpa, ca, head))
+            self._last_window = w
+        # High-water folds over EVERY fresh row, not just the last one:
+        # hpa_reserve_used is non-monotone (scale-downs shrink it), so an
+        # intra-drain peak would otherwise be lost.
+        for name, col in (
+            ("hpa_reserve_used", TELEM_HPA_RESERVE),
+            ("ca_reserve_used", TELEM_CA_RESERVE),
+        ):
+            peak = int(buf[:, order, col].max())
+            self._high_water[name] = max(self._high_water.get(name, 0), peak)
+        return int(order.size)
+
+    # -- watchdog -----------------------------------------------------------
+
+    def _warn(self, kind: str, message: str, **info) -> Dict:
+        event = {"kind": kind, "window": self._last_window, "message": message}
+        event.update(info)
+        self.events.append(event)
+        if len(self.events) > self.max_events:
+            del self.events[: len(self.events) - self.max_events]
+        self.fired.setdefault(kind, self._last_window)
+        warnings.warn(message, SaturationWarning, stacklevel=3)
+        return event
+
+    def _check_reserve(self, name: str, idx: int, warnings_out: list) -> None:
+        caps = self.capacities.get(name.replace("_used", ""))
+        if caps is None or len(self._points) < self.min_points:
+            return
+        xs = [p[0] * self.interval for p in self._points]
+        ys = np.stack([p[idx] for p in self._points], axis=0)  # (n, C)
+        slopes = fit_slope(xs, ys)  # (C,) per sim-second
+        now = ys[-1]
+        worst_eta = math.inf
+        worst = None
+        for c in range(now.shape[0]):
+            cap = float(caps[c]) if c < len(caps) else 0.0
+            if cap <= 0.0:
+                continue
+            frac = float(now[c]) / cap
+            eta = time_to_exhaustion(float(now[c]), float(slopes[c]), cap)
+            if frac >= self.warn_frac or (
+                frac >= self.min_frac and eta <= self.horizon_s
+            ):
+                if eta < worst_eta or worst is None:
+                    worst_eta = eta
+                    worst = (c, frac, eta, cap)
+        if worst is not None:
+            c, frac, eta, cap = worst
+            eta_txt = (
+                f"~{eta:.0f} sim-seconds to exhaustion"
+                if math.isfinite(eta)
+                else "trajectory flat but already past the warning fraction"
+            )
+            warnings_out.append(
+                self._warn(
+                    name,
+                    f"saturation watchdog: {name} at {frac:.0%} of its "
+                    f"reserve on cluster {c} ({int(now[c])}/{int(cap)}), "
+                    f"{eta_txt} — the loud reserve bound "
+                    "(engine.check_autoscaler_bounds) fires when demand "
+                    "outruns it; widen the reserve "
+                    "(ca_slot_multiplier / pg_slot_count) or curb churn",
+                    cluster=c,
+                    used=int(now[c]),
+                    capacity=int(cap),
+                    eta_s=None if math.isinf(eta) else round(eta, 1),
+                )
+            )
+
+    def _check_headroom(self, warnings_out: list) -> None:
+        # One verdict per run: approaching the trace end is expected and
+        # monotone — repeating it every drain would be noise (the reserve
+        # verdicts DO repeat: their trajectories can keep worsening).
+        if "pod_headroom" in self.fired:
+            return
+        if len(self._points) < self.min_points:
+            return
+        ys = np.stack([p[3] for p in self._points], axis=0)  # (n, C)
+        now = ys[-1]
+        bounded = now < UNBOUNDED_SENTINEL
+        if not bool(bounded.any()):
+            return
+        xs = [p[0] * self.interval for p in self._points]
+        slopes = fit_slope(xs, ys)
+        for c in np.nonzero(bounded)[0].tolist():
+            eta = time_to_exhaustion(
+                float(now[c]), float(slopes[c]), None, falling=True
+            )
+            # Running out of plain-trace headroom is NORMAL at trace end;
+            # only a projected exhaustion well inside the horizon with
+            # headroom still nonzero is worth a line (feeder/window
+            # tuning, not a failure).
+            if 0.0 < eta <= self.horizon_s and now[c] > 0:
+                warnings_out.append(
+                    self._warn(
+                        "pod_headroom",
+                        f"saturation watchdog: sliding-window trace "
+                        f"headroom on cluster {c} is {int(now[c])} columns "
+                        f"and falling (~{eta:.0f} sim-seconds to trace "
+                        "end) — expected near end of trace; if early, the "
+                        "stream segment/pod window is undersized",
+                        cluster=c,
+                        headroom=int(now[c]),
+                        eta_s=round(eta, 1),
+                    )
+                )
+                break  # one headroom line per observe is plenty
+
+    def _check_pipeline(
+        self, dispatch_stats: Optional[Dict], sync_budget: Optional[Dict],
+        feeder: Optional[Dict], warnings_out: list,
+    ) -> None:
+        if sync_budget:
+            expected = sync_budget.get("steady_state_expected", 0)
+            observed = sync_budget.get("observed_slide_syncs", 0)
+            # The budget is EXACT only in the pure superspan steady state
+            # (tests/test_superspan.py's equality gate); mixed ladder
+            # engines legitimately pay extra slide syncs on their unfused
+            # advances, so a verdict there would be noise.
+            exact_regime = bool(dispatch_stats) and (
+                dispatch_stats.get("superspans", 0) > 0
+                and dispatch_stats.get("window_chunks", 0) == 0
+            )
+            if exact_regime and expected > 0 and observed > expected:
+                warnings_out.append(
+                    self._warn(
+                        "sync_budget",
+                        f"saturation watchdog: {observed} blocking slide "
+                        f"syncs observed vs the documented steady-state "
+                        f"budget of {expected} (1 progress readback per "
+                        "superspan + 1 shift readback per fused slide) — "
+                        "a new host sync crept into the dispatch loop",
+                        observed=observed,
+                        expected=expected,
+                    )
+                )
+        if feeder and dispatch_stats:
+            produced = dispatch_stats.get("feeder_slabs_produced", 0)
+            installed = dispatch_stats.get("stage_refills", 0)
+            depth = feeder.get("ring_capacity", 1)
+            if produced - installed > max(4, 2 * depth):
+                warnings_out.append(
+                    self._warn(
+                        "feeder_waste",
+                        f"saturation watchdog: feeder produced {produced} "
+                        f"slabs but only {installed} were installed — "
+                        "run-ahead production is being discarded (stride "
+                        "too small for this geometry; widen the stream "
+                        "segment)",
+                        produced=produced,
+                        installed=installed,
+                    )
+                )
+            stalls = (
+                feeder.get("stalls", {})
+                .get("feeder_not_ready", {})
+                .get("count", 0)
+            )
+            if stalls > self._last_stall_not_ready:
+                warnings_out.append(
+                    self._warn(
+                        "feeder_starved",
+                        f"saturation watchdog: the dispatch loop stalled "
+                        f"{stalls - self._last_stall_not_ready} time(s) "
+                        "waiting for an unpublished feeder slab since the "
+                        "last drain — the producer is not keeping ahead "
+                        "(raise KTPU_STREAM_DEPTH or widen segments)",
+                        stalls=stalls,
+                    )
+                )
+            self._last_stall_not_ready = stalls
+
+    # -- observe / report ---------------------------------------------------
+
+    def update_memory(self, resources: Dict) -> None:
+        """Fold one resource sample into the watermarks without running
+        the watchdog or the exporters (telemetry_report's refresh path)."""
+        self._last_resources = dict(resources)
+        for key in ("rss_bytes", "device_bytes_in_use"):
+            val = resources.get(key)
+            if val:
+                self._mem_high[key] = max(self._mem_high.get(key, 0), int(val))
+
+    def observe(
+        self,
+        resources: Optional[Dict] = None,
+        dispatch_stats: Optional[Dict] = None,
+        sync_budget: Optional[Dict] = None,
+        feeder: Optional[Dict] = None,
+        fresh: Optional[int] = None,
+    ) -> Dict:
+        """One drain-point observation: fold the resource sample into the
+        watermarks, run the watchdog over the ingested occupancy series,
+        and emit the record to every exporter. Everything consumed here
+        is a drained host copy — no device access.
+
+        `fresh`: the corresponding ingest()'s fresh-window count. fresh=0
+        means the drain re-observed only known rows (a readout call like
+        telemetry_report forcing a drain right after one happened) — the
+        watermarks still refresh, but the watchdog does not re-judge the
+        same data and NOTHING goes to the exporters, so readout APIs stay
+        side-effect-free on the JSONL stream (no phantom zero-interval
+        records). None (callers without ingest bookkeeping) behaves like
+        fresh data."""
+        self.samples += 1
+        if resources:
+            self.update_memory(resources)
+        is_fresh = fresh is None or fresh > 0
+        fired: list = []
+        if self.watchdog and is_fresh:
+            self._check_reserve("hpa_reserve_used", 1, fired)
+            self._check_reserve("ca_reserve_used", 2, fired)
+            self._check_headroom(fired)
+            self._check_pipeline(dispatch_stats, sync_budget, feeder, fired)
+        record = {
+            "t_wall_s": round(time.time(), 3),
+            "window": self._last_window,
+            "sim_time_s": round(max(self._last_window, 0) * self.interval, 3),
+            "fresh_windows": 0 if fresh is None else int(fresh),
+            "occupancy": self.occupancy(),
+            "resources": dict(self._last_resources),
+            "watchdog": [dict(e) for e in fired],
+        }
+        if fresh is None:
+            record["fresh_windows"] = len(self._points)
+        if is_fresh:
+            for exporter in self.exporters:
+                exporter.emit(record)
+        return record
+
+    def occupancy(self) -> Dict:
+        """Current + high-water occupancy per gauge (cross-cluster worst),
+        with capacity and fraction where a reserve exists."""
+        out: Dict = {}
+        if not self._points:
+            return out
+        last = self._points[-1]
+        for name, idx in (
+            ("hpa_reserve_used", 1),
+            ("ca_reserve_used", 2),
+        ):
+            caps = self.capacities.get(name.replace("_used", ""))
+            used = last[idx]
+            entry = {
+                "used_max": int(used.max()),
+                "high_water": self._high_water.get(name, int(used.max())),
+            }
+            if caps is not None and len(caps) > 0:
+                entry["capacity_min"] = int(min(caps))
+                # Worst PER-CLUSTER fraction (used[c]/cap[c]) — dividing
+                # the max-used cluster by the min-capacity cluster would
+                # overstate heterogeneous fleets.
+                fracs = [
+                    float(used[c]) / float(caps[c])
+                    for c in range(min(used.shape[0], len(caps)))
+                    if caps[c] > 0
+                ]
+                if fracs:
+                    entry["frac_max"] = round(max(fracs), 4)
+            out[name] = entry
+        head = last[3]
+        bounded = head[head < UNBOUNDED_SENTINEL]
+        out["pod_headroom"] = {
+            "min": int(bounded.min()) if bounded.size else None,
+            "unbounded_clusters": int((head >= UNBOUNDED_SENTINEL).sum()),
+        }
+        return out
+
+    def report(self) -> Dict:
+        """The `telemetry_report()["resources"]` section: occupancy,
+        memory watermarks, and the watchdog's verdict trail."""
+        return {
+            "occupancy": self.occupancy(),
+            "memory": {
+                **self._last_resources,
+                "high_water": dict(self._mem_high),
+            },
+            "watchdog": {
+                "enabled": self.watchdog,
+                "fired": dict(self.fired),
+                "events": [dict(e) for e in self.events[-16:]],
+                "horizon_s": self.horizon_s,
+                "warn_frac": self.warn_frac,
+            },
+            "samples": self.samples,
+        }

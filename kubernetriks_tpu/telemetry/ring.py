@@ -43,8 +43,29 @@ RING_COLUMNS = (
     "ca_node_actions",
     "fault_events",
     "alive_nodes",
+    # Capacity-observatory occupancy gauges (telemetry/observatory.py):
+    # live HPA replicas vs the pod-group slot reserve, consumed CA node
+    # slots (monotone — the ROADMAP #2 saturation driver), and the
+    # remaining plain-trace columns ahead of the sliding pod window.
+    "hpa_reserve_used",
+    "ca_reserve_used",
+    "pod_headroom",
 )
 assert len(RING_COLUMNS) == TELEMETRY_COLS
+
+# Gauges are POINT-IN-TIME readings: summing them across windows (the way
+# the per-window action deltas sum into ring totals) is meaningless, so
+# report consumers track their high-water mark instead.
+GAUGE_COLUMNS = frozenset(
+    {
+        "queued",
+        "unschedulable",
+        "alive_nodes",
+        "hpa_reserve_used",
+        "ca_reserve_used",
+        "pod_headroom",
+    }
+)
 
 
 def init_ring(n_clusters: int, capacity: int) -> TelemetryRing:

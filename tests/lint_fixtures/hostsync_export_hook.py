@@ -1,0 +1,38 @@
+# ktpu: hot-path
+"""Seeded violations: a metrics-export hook that smuggles device syncs
+into the telemetry drain path. The REAL export seam
+(kubernetriks_tpu/telemetry/export.py, observatory.py) runs strictly on
+drained host copies and carries ZERO sync-ok waivers — this fixture is
+the bug class the golden-clean gate keeps out of it."""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class LeakyJsonlExporter:
+    """An exporter that reaches back into live engine state instead of
+    consuming the drained record it was handed."""
+
+    def __init__(self, path, engine):
+        self.path = path
+        self.engine = engine
+
+    def emit(self, record):
+        # BAD: host materialization of a live device array inside the
+        # export hook (np.asarray on the engine's resident state).
+        queued = np.asarray(self.engine.state.pods.phase)
+        # BAD: .item() readback — a blocking device-to-host sync the
+        # drain path never budgeted for.
+        decisions = self.engine.state.metrics.scheduling_decisions.sum().item()
+        record = dict(record, queued=int(queued.sum()), decisions=decisions)
+        with open(self.path, "a") as fh:
+            fh.write(json.dumps(record) + "\n")
+
+
+def occupancy_now(engine):
+    used = jnp.sum(engine.state.auto.ca_cursor, axis=1)
+    # BAD: int() on an array-valued expression (implicit __int__ sync)
+    # while building an export record.
+    return int(used.max())

@@ -43,6 +43,7 @@ def test_bench_smoke_emits_six_parseable_lines(capsys, tmp_path, monkeypatch):
     # composed lines must carry the flight-recorder summary AND write a
     # Perfetto-loadable Chrome trace per traced line.
     monkeypatch.setenv("KTPU_TRACE_PATH", str(tmp_path / "ktpu_trace"))
+    monkeypatch.setenv("KTPU_METRICS_PATH", str(tmp_path / "ktpu_metrics"))
     records = _smoke_records(capsys, ["--smoke", "--trace"])
     assert len(records) == 6, records
     # Line order is part of the contract: continuity, composed, superspan
@@ -114,11 +115,44 @@ def test_bench_smoke_emits_six_parseable_lines(capsys, tmp_path, monkeypatch):
     assert feeder["slabs_produced"] <= tel["dispatch_stats"]["feeder_slabs_produced"]
     assert feeder["ring_depth_high_water"] <= feeder["ring_capacity"]
     assert set(feeder["stalls"]) == {"feeder_not_ready", "upload_wait"}
+    # Capacity-observatory resources section on every traced composed
+    # line (the capacity half of the flight recorder): occupancy gauges
+    # with reserve-capacity fractions plus RSS/slab watermarks — present
+    # and sane, so a change that stops the observatory sampling fails on
+    # CPU CI.
+    for rec in records[1:4]:
+        res = rec["telemetry"]["resources"]
+        assert res["rss_mb"] > 0
+        assert res["rss_high_water_mb"] >= res["rss_mb"] * 0.5
+        occ = res["occupancy"]
+        assert {"hpa_reserve_used", "ca_reserve_used", "pod_headroom"} <= set(occ)
+        ca = occ["ca_reserve_used"]
+        assert ca["capacity_min"] > 0
+        assert 0 <= ca["used_max"] <= ca["high_water"] <= ca["capacity_min"]
+        assert res["slabs"]["telemetry_ring_bytes"] > 0
+        assert "watchdog_fired" in res
+    # The streaming line's slab accounting shows the bounded feeder ring
+    # and NO whole-trace device payload (the memory bound, in bytes).
+    res = records[3]["telemetry"]["resources"]
+    assert res["slabs"]["device_slide_bytes"] == 0
+    assert res["slabs"].get("feeder_ring_capacity_bytes", 0) > 0
     for label in ("smoke_composed", "smoke_superspan", "smoke_stream"):
         path = tmp_path / f"ktpu_trace_{label}.json"
         assert path.exists(), f"missing Chrome trace {path}"
         doc = json.loads(path.read_text())
         assert doc["traceEvents"], "empty Chrome trace"
+        # The observatory's time-series export landed next to the trace:
+        # parseable JSONL drain records + the Prometheus textfile.
+        jsonl = tmp_path / f"ktpu_metrics_{label}.jsonl"
+        assert jsonl.exists(), f"missing metrics JSONL {jsonl}"
+        lines = [json.loads(ln) for ln in jsonl.read_text().splitlines()]
+        assert lines and all("occupancy" in ln for ln in lines)
+        assert lines[-1]["resources"]["rss_bytes"] > 0
+        prom = tmp_path / f"ktpu_metrics_{label}.prom"
+        assert prom.exists(), f"missing Prometheus textfile {prom}"
+        prom_text = prom.read_text()
+        assert "ktpu_occupancy{" in prom_text
+        assert "ktpu_memory_bytes{" in prom_text
 
 
 def test_bench_smoke_faults_adds_chaos_line(capsys, tmp_path, monkeypatch):
@@ -128,6 +162,7 @@ def test_bench_smoke_faults_adds_chaos_line(capsys, tmp_path, monkeypatch):
     from the previous test (same programs); the chaos line itself is
     untraced either way."""
     monkeypatch.setenv("KTPU_TRACE_PATH", str(tmp_path / "ktpu_trace"))
+    monkeypatch.setenv("KTPU_METRICS_PATH", str(tmp_path / "ktpu_metrics"))
     records = _smoke_records(capsys, ["--smoke", "--faults", "--trace"])
     assert len(records) == 7, records
     assert "chaos" in records[6]["metric"]

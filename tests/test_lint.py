@@ -44,6 +44,7 @@ FIXTURE_CASES = [
     ("donation_loop_carried.py", "donation", 1, "step_donated"),
     ("hostsync_item_and_asarray.py", "hostsync", 3, ".item()"),
     ("hostsync_cast_and_branch.py", "hostsync", 2, "int()"),
+    ("hostsync_export_hook.py", "hostsync", 3, "np.asarray"),
     ("jitstatic_unknown_param.py", "jitstatic", 1, "max_pods"),
     ("jitstatic_pair_drift.py", "jitstatic", 1, "collect_gauges"),
     ("jitstatic_coupled_drift.py", "jitstatic", 1, "travel together"),
@@ -114,6 +115,32 @@ def test_waiver_suppresses_with_reason_only():
     assert waived_lines, "fixture must contain a waived sync"
     assert not (waived_lines & {v.line for v in violations})
     assert violations, "unwaived syncs must still be reported"
+
+
+def test_observatory_and_export_are_hot_path_with_zero_waivers():
+    """The capacity observatory's host half (telemetry/observatory.py)
+    and its export seam (telemetry/export.py) carry the hot-path pragma
+    — the host-sync pass patrols them like tracer.py — and stay
+    golden-clean with ZERO sync-ok waivers: exports run strictly from
+    drained host copies, never a device value."""
+    from kubernetriks_tpu.lint import collect_files, is_hot
+
+    paths = [
+        "kubernetriks_tpu/telemetry/observatory.py",
+        "kubernetriks_tpu/telemetry/export.py",
+        "kubernetriks_tpu/telemetry/tracer.py",  # the PR 8 precedent
+    ]
+    files = collect_files(paths, ROOT)
+    assert len(files) == len(paths)
+    for sf in files:
+        assert is_hot(sf), f"{sf.path} lost its hot-path pragma"
+        src = open(os.path.join(ROOT, sf.path)).read()
+        assert "ktpu: sync-ok" not in src, (
+            f"{sf.path} grew a sync-ok waiver — the observatory/export "
+            "half of telemetry must stay waiver-free (drained copies only)"
+        )
+    violations = run_lint(paths, ROOT, passes=["hostsync"])
+    assert violations == [], "\n".join(v.render() for v in violations)
 
 
 def test_jit_table_is_scanned_not_hardcoded():

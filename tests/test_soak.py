@@ -1,0 +1,375 @@
+"""Capacity observatory soak gates (tests/test_soak.py).
+
+The measurable precursor to ROADMAP #2's endurance deliverable: a short
+composed run (HPA + CA + sliding window + superspan + streaming feeder +
+chaos) with the flight recorder AND the saturation watchdog armed,
+asserting the three observatory claims that make multi-week runs
+watchable:
+
+1. EXACT occupancy: the ring's reserve-occupancy gauge columns
+   (hpa_reserve_used / ca_reserve_used / pod_headroom) match an
+   INDEPENDENT host-side recomputation from drained state — integer
+   equality at every sampled window, not a tolerance.
+2. The watchdog fires BEFORE the loud bound: on an engineered
+   near-exhaustion CA reserve (ca_slot_multiplier=1), a SaturationWarning
+   with a time-to-exhaustion estimate lands while the
+   ca_reserve_starved divergence counter is still ZERO.
+3. FLAT watermarks: across steady-state superspans the slab/ring byte
+   accounting is exactly constant and host RSS does not trend — the
+   bounded-memory claim of the streaming pipeline, observed rather than
+   argued.
+
+A longer variant of the same gates runs behind `-m slow`. Pure
+observatory mechanics (trajectory fit, exporters, synthetic watchdog
+verdicts) are unit-tested here too — no engine needed.
+"""
+
+import json
+import math
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+from kubernetriks_tpu.telemetry.export import (
+    JsonlExporter,
+    prometheus_lines,
+    write_prometheus_textfile,
+)
+from kubernetriks_tpu.telemetry.observatory import (
+    Observatory,
+    SaturationWarning,
+    UNBOUNDED_SENTINEL,
+    fit_slope,
+    sample_host_memory,
+    time_to_exhaustion,
+)
+from kubernetriks_tpu.telemetry.ring import RING_COLUMNS
+
+from test_superspan import FAULT_SUFFIX
+from test_window_donation_dispatch import _build_composed
+
+COL = {name: idx for idx, name in enumerate(RING_COLUMNS)}
+
+
+def _build_soak(**kwargs):
+    """The soak engine: the composed fault scenario with streaming +
+    superspan forced on (CPU defaults are off), the flight recorder and
+    watchdog armed, and a deliberately TIGHT CA slot reserve
+    (ca_slot_multiplier=1) so sustained HPA/CA churn walks the
+    never-reclaimed cursor toward exhaustion inside the test budget."""
+    kwargs.setdefault("superspan", True)
+    kwargs.setdefault("superspan_k", 4)
+    kwargs.setdefault("superspan_chunk", 4)
+    kwargs.setdefault("stream", True)
+    kwargs.setdefault("telemetry", True)
+    kwargs.setdefault("watchdog", True)
+    kwargs.setdefault("telemetry_ring", 16)
+    kwargs.setdefault("ca_slot_multiplier", 1)
+    return _build_composed(config_suffix=FAULT_SUFFIX, **kwargs)
+
+
+def _oracle_occupancy(sim):
+    """INDEPENDENT host-side recomputation of the ring's occupancy gauge
+    columns from drained state: live HPA replicas (tail - head over
+    groups), consumed CA cursor, and the plain-trace headroom formula —
+    the acceptance-criteria oracle."""
+    auto = sim.state.auto
+    if auto is not None:
+        hpa = (
+            np.asarray(auto.hpa_tail).astype(np.int64)
+            - np.asarray(auto.hpa_head)
+        ).sum(axis=1)
+        ca = np.asarray(auto.ca_cursor).astype(np.int64).sum(axis=1)
+    else:
+        hpa = np.zeros(sim.n_clusters, np.int64)
+        ca = np.zeros(sim.n_clusters, np.int64)
+    T = int(sim.consts.trace_pod_bound)
+    plain_w = min(sim.n_pods, T - int(sim.consts.resident_shift))
+    headroom = np.maximum(T - np.asarray(sim.state.pod_base) - plain_w, 0)
+    return hpa, ca, headroom
+
+
+def _run_soak_and_check(sim, ends):
+    """Step through `ends`, oracle-checking the latest ring row against
+    state at every boundary and collecting watchdog warnings + resource
+    samples. Returns (warnings, samples)."""
+    caught = []
+    samples = []
+    first_fire_starved = None
+    for end in ends:
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            # Drains (and hence watchdog passes) fire both inside the
+            # step at its sync points AND at the forced series drain.
+            sim.step_until_time(end)
+            wins, data = sim.telemetry_window_series()
+        fired = [x for x in w if issubclass(x.category, SaturationWarning)]
+        if (
+            any("ca_reserve_used" in str(x.message) for x in fired)
+            and first_fire_starved is None
+        ):
+            # The moment the CA verdict FIRST fired, the loud bound had
+            # not: the divergence counter the engine raises on at readout
+            # is still zero (warning-before-failure, the acceptance gate).
+            first_fire_starved = int(
+                np.asarray(sim.state.metrics.ca_reserve_starved).sum()
+            )
+        caught.extend(fired)
+        last = sim.next_window_idx - 1
+        if len(wins) and last >= 0:
+            # Integer-exact gauge oracle at the latest executed window.
+            assert wins[-1] == last, (wins[-1], last)
+            hpa, ca, headroom = _oracle_occupancy(sim)
+            np.testing.assert_array_equal(
+                data[-1, :, COL["hpa_reserve_used"]], hpa
+            )
+            np.testing.assert_array_equal(
+                data[-1, :, COL["ca_reserve_used"]], ca
+            )
+            np.testing.assert_array_equal(
+                data[-1, :, COL["pod_headroom"]], headroom
+            )
+        # Tag each sample with the stage geometry: a pod-window GROWTH
+        # legitimately re-seeks the feeder at a wider slab, so flatness
+        # is asserted per geometry — a trend WITHIN one would be a leak.
+        samples.append((sim.pod_window, sim._sample_resources()))
+    return caught, samples, first_fire_starved
+
+
+def _assert_soak_gates(sim, caught, samples, first_fire_starved):
+    # The run really composed everything: superspans dispatched, feeder
+    # staged, faults happened, autoscalers acted.
+    assert sim.dispatch_stats["superspans"] > 0
+    assert sim.dispatch_stats["window_chunks"] == 0
+    assert sim.dispatch_stats["feeder_slabs_produced"] > 0
+    counters = np.asarray(sim.state.metrics.pod_interruptions).sum() + (
+        np.asarray(sim.state.metrics.pods_failed).sum()
+    )
+    assert counters > 0, "fault run produced no faults; soak is vacuous"
+
+    # Gate 2: the watchdog fired with a CA-reserve verdict carrying a
+    # time-to-exhaustion estimate, BEFORE the loud bound (starved == 0 at
+    # first fire — engine.check_autoscaler_bounds had nothing to raise).
+    ca_warnings = [
+        w for w in caught if "ca_reserve_used" in str(w.message)
+    ]
+    assert ca_warnings, [str(w.message) for w in caught]
+    assert any(
+        "to exhaustion" in str(w.message) for w in ca_warnings
+    ), [str(w.message) for w in ca_warnings]
+    assert first_fire_starved == 0, (
+        "watchdog first fired only AFTER the loud reserve bound tripped"
+    )
+    fired = sim.observatory.report()["watchdog"]["fired"]
+    assert "ca_reserve_used" in fired
+
+    # Gate 3: flat watermarks across steady-state superspans. Slab/ring
+    # accounting is EXACTLY constant per stage geometry (a pod-window
+    # growth re-seeks the feeder at a wider slab — a step, not a trend);
+    # host RSS may wiggle with allocator noise but must not trend
+    # (generous container bound).
+    steady = samples[1:]
+    by_geometry: dict = {}
+    for pod_window, sample in steady:
+        by_geometry.setdefault(pod_window, []).append(sample["slabs"])
+        assert sample["slabs"]["device_slide_bytes"] == 0, (
+            "streaming engine materialized the whole-trace slide payload"
+        )
+    for pod_window, slabs in by_geometry.items():
+        for later in slabs[1:]:
+            assert later == slabs[0], (pod_window, later, slabs[0])
+    last_slabs = steady[-1][1]["slabs"]
+    assert last_slabs.get("feeder_ring_capacity_bytes", 0) > 0
+    assert last_slabs["feeder_ring_capacity_bytes"] == (
+        last_slabs["feeder_slab_bytes"] * sim._stream_depth
+    )
+    rss = [s["rss_bytes"] for _, s in steady if s["rss_bytes"] > 0]
+    if len(rss) >= 2:
+        assert rss[-1] - rss[0] < 256 * 1024 * 1024, (
+            f"RSS trended across steady superspans: {rss}"
+        )
+
+
+def test_soak_composed_chaos_streaming_watchdog():
+    """The tier-1 soak: ~45 windows of the composed fault scenario with
+    an engineered near-exhaustion CA reserve. Occupancy exact, watchdog
+    before the bound, watermarks flat."""
+    sim = _build_soak()
+    try:
+        caught, samples, first_fire_starved = _run_soak_and_check(
+            sim, ends=np.arange(50.0, 451.0, 50.0)
+        )
+        _assert_soak_gates(sim, caught, samples, first_fire_starved)
+    finally:
+        sim.close()
+
+
+@pytest.mark.slow
+def test_soak_composed_long():
+    """The slow-lane variant: the same gates over 3x the simulated span
+    (the HPA load curve cycles indefinitely, so churn keeps walking the
+    CA cursor) — closer to the endurance shape ROADMAP #2 asks for."""
+    sim = _build_soak(ca_slot_multiplier=2, telemetry_ring=64)
+    try:
+        caught, samples, first_fire_starved = _run_soak_and_check(
+            sim, ends=np.arange(50.0, 1351.0, 50.0)
+        )
+        _assert_soak_gates(sim, caught, samples, first_fire_starved)
+    finally:
+        sim.close()
+
+
+# --- observatory mechanics (no engine) -----------------------------------
+
+
+def test_fit_and_eta_math():
+    xs = [0.0, 10.0, 20.0, 30.0]
+    ys = np.array([[0.0, 0.0], [1.0, 0.0], [2.0, 0.0], [3.0, 0.0]])
+    slopes = fit_slope(xs, ys)
+    assert slopes.shape == (2,)
+    assert abs(slopes[0] - 0.1) < 1e-12 and slopes[1] == 0.0
+    assert time_to_exhaustion(3.0, 0.1, 10.0) == pytest.approx(70.0)
+    assert time_to_exhaustion(3.0, 0.0, 10.0) == math.inf
+    assert time_to_exhaustion(12.0, 0.1, 10.0) == 0.0
+    # falling gauges (pod headroom): eta to zero
+    assert time_to_exhaustion(50.0, -5.0, None, falling=True) == pytest.approx(10.0)
+    assert time_to_exhaustion(50.0, 5.0, None, falling=True) == math.inf
+
+
+def _ring_buf(rows):
+    """Synthetic drained ring buffer: rows = [(window, hpa, ca, head)]
+    for ONE cluster, padded into the (C=1, R, K) int32 layout."""
+    R = len(rows)
+    buf = np.full((1, R, len(RING_COLUMNS)), -1, np.int32)
+    for slot, (w, hpa, ca, head) in enumerate(rows):
+        buf[0, slot, COL["window"]] = w
+        buf[0, slot, COL["hpa_reserve_used"]] = hpa
+        buf[0, slot, COL["ca_reserve_used"]] = ca
+        buf[0, slot, COL["pod_headroom"]] = head
+    return buf
+
+
+def test_watchdog_fires_on_rising_reserve_trajectory():
+    obs = Observatory(
+        interval=10.0,
+        capacities={"hpa_reserve": [100], "ca_reserve": [20]},
+        horizon_s=1e6,
+    )
+    obs.ingest(
+        _ring_buf([(w, 0, 8 + w, UNBOUNDED_SENTINEL) for w in range(6)])
+    )
+    with pytest.warns(SaturationWarning, match="ca_reserve_used"):
+        rec = obs.observe()
+    assert rec["watchdog"], rec
+    ev = [e for e in rec["watchdog"] if e["kind"] == "ca_reserve_used"][0]
+    # occupancy 13/20 rising 1 slot / 10 sim-s -> 70 s to exhaustion.
+    assert ev["eta_s"] == pytest.approx(70.0, abs=1.0)
+    assert obs.report()["watchdog"]["fired"]["ca_reserve_used"] == 5
+
+
+def test_watchdog_quiet_on_flat_and_low_occupancy():
+    obs = Observatory(
+        interval=10.0,
+        capacities={"hpa_reserve": [100], "ca_reserve": [100]},
+    )
+    obs.ingest(
+        _ring_buf([(w, 5, 10, UNBOUNDED_SENTINEL) for w in range(6)])
+    )
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        rec = obs.observe()
+    assert not [x for x in w if issubclass(x.category, SaturationWarning)]
+    assert rec["watchdog"] == []
+
+
+def test_watchdog_flags_feeder_and_sync_budget():
+    obs = Observatory(interval=10.0, capacities={})
+    obs.ingest(_ring_buf([(0, 0, 0, UNBOUNDED_SENTINEL)]))
+    with pytest.warns(SaturationWarning) as caught:
+        obs.observe(
+            dispatch_stats={
+                "feeder_slabs_produced": 40,
+                "stage_refills": 3,
+                "superspans": 10,
+                "fused_slides": 0,
+                "slide_syncs": 13,
+            },
+            sync_budget={
+                "steady_state_expected": 10,
+                "observed_slide_syncs": 13,
+            },
+            feeder={
+                "ring_capacity": 3,
+                "stalls": {
+                    "feeder_not_ready": {"count": 2, "ms": 5.0},
+                    "upload_wait": {"count": 0, "ms": 0.0},
+                },
+            },
+        )
+    kinds = {e["kind"] for e in obs.events}
+    assert {"sync_budget", "feeder_waste", "feeder_starved"} <= kinds
+    messages = " ".join(str(w.message) for w in caught)
+    assert "budget" in messages and "producer is not keeping ahead" in messages
+
+
+def test_host_memory_sample_is_live():
+    mem = sample_host_memory()
+    assert mem["rss_bytes"] > 0
+    assert mem["peak_rss_bytes"] >= mem["rss_bytes"] // 2
+
+
+def test_jsonl_exporter_is_bounded(tmp_path):
+    path = str(tmp_path / "metrics.jsonl")
+    exp = JsonlExporter(path, max_bytes=2048)
+    record = {"occupancy": {"ca_reserve_used": {"used_max": 3}}, "pad": "x" * 64}
+    for i in range(200):
+        exp.emit({**record, "window": i})
+    assert exp.lines_written == 200
+    # Bounded: live file + one rotation, both under the cap (plus one line).
+    assert os.path.getsize(path) <= 2048 + 256
+    assert os.path.getsize(path + ".1") <= 2048 + 256
+    # Tail-friendly: every kept line parses and the newest window is last.
+    lines = open(path).read().splitlines()
+    assert json.loads(lines[-1])["window"] == 199
+
+
+def test_prometheus_textfile(tmp_path):
+    report = {
+        "dispatch_stats": {"superspans": 7},
+        "sync_budget": {"steady_state_expected": 7, "observed_slide_syncs": 7},
+        "ring": {"windows_recorded": 12, "windows_kept": 12,
+                 "totals": {"decisions": 99}},
+        "resources": {
+            "occupancy": {
+                "ca_reserve_used": {"used_max": 3, "capacity_min": 8,
+                                    "frac_max": 0.375, "high_water": 3},
+            },
+            "memory": {
+                "rss_bytes": 123456,
+                "slabs": {"feeder_ring_capacity_bytes": 4096},
+                "high_water": {"rss_bytes": 234567},
+            },
+            "watchdog": {"enabled": True, "fired": {"ca_reserve_used": 9}},
+            "samples": 4,
+        },
+    }
+    lines = prometheus_lines(report)
+    text = "\n".join(lines)
+    assert 'ktpu_dispatch_total{kind="superspans"} 7' in text
+    assert 'ktpu_ring_total{column="decisions"} 99' in text
+    assert 'ktpu_occupancy{field="used_max",gauge="ca_reserve_used"} 3' in text
+    assert 'ktpu_memory_bytes{kind="rss_bytes"} 123456' in text
+    assert 'ktpu_memory_bytes{kind="slabs.feeder_ring_capacity_bytes"} 4096' in text
+    assert 'ktpu_memory_high_water_bytes{kind="rss_bytes"} 234567' in text
+    assert 'ktpu_watchdog_fired_window{kind="ca_reserve_used"} 9' in text
+    path = str(tmp_path / "metrics.prom")
+    assert write_prometheus_textfile(path, report) == path
+    assert open(path).read().strip() == text.strip()
+    assert not os.path.exists(path + ".tmp")
+
+
+def test_watchdog_without_telemetry_raises():
+    with pytest.raises(ValueError, match="watchdog"):
+        _build_composed(telemetry=False, watchdog=True)

@@ -223,6 +223,89 @@ def test_ring_drain_handles_uneven_spans():
     assert sim._ring_windows_recorded == sim.next_window_idx
 
 
+def test_drain_telemetry_rows_survive_donated_dispatches():
+    """Explicit mid-run drain (engine.drain_telemetry) vs the
+    donated-dispatch aliasing hazard: ring.snapshot forces OWNED numpy
+    copies, so rows drained now must stay bit-identical after later
+    DONATED dispatches consume (and mutate in place) the device ring
+    buffer the fetch may have aliased on CPU."""
+    sim = _build_dense_sliding(
+        telemetry=True, telemetry_ring=16, donate=True, fuse_slide=True
+    )
+    sim.step_until_time(120.0)
+    rec = sim.drain_telemetry()
+    assert rec and rec["window"] == sim.next_window_idx - 1
+    assert "occupancy" in rec and "resources" in rec
+    wins0, data0 = sim.telemetry_window_series()
+    snap = data0.copy()
+    sim.step_until_time(400.0)  # donated dispatches consume old buffers
+    wins1, data1 = sim.telemetry_window_series()
+    np.testing.assert_array_equal(wins1[: len(wins0)], wins0)
+    np.testing.assert_array_equal(data1[: len(wins0)], snap)
+    # And with telemetry off it degrades to a cheap no-op, not an error.
+    off = _build_plain()
+    assert off.drain_telemetry() == {}
+
+
+def test_single_long_call_stays_lossless_on_sliding_engine():
+    """The PR 8 known edge, fixed: ONE step_until_time call spanning far
+    more windows than the ring stays lossless on engines whose
+    steady-state loop has sync points (slides / superspan readbacks) —
+    the pressure drain now rides those existing blocks mid-call, so the
+    windows_recorded > windows_kept disclosure is reserved for a single
+    DISPATCH outrunning the ring, not a single call."""
+    sim = _build_dense_sliding(telemetry=True, telemetry_ring=16)
+    sim.step_until_time(450.0)  # ~45 windows >> ring capacity, ONE call
+    assert sim.next_window_idx > sim._telemetry_ring_size
+    assert sim.dispatch_stats["slide_syncs"] > 0  # drains had blocks to ride
+    wins, _ = sim.telemetry_window_series()
+    np.testing.assert_array_equal(
+        wins, np.arange(sim.next_window_idx, dtype=np.int32)
+    )
+    assert sim._ring_windows_recorded == sim.next_window_idx
+
+
+def test_series_cap_bounds_host_memory_and_discloses():
+    """The host-side series accumulator is BOUNDED (the endurance-run
+    guard): past telemetry_series_windows distinct windows the oldest
+    rows are pruned, newest kept, and the loss is disclosed in the
+    report — the O(T) growth the capacity observatory would otherwise
+    reintroduce through its own lossless drains."""
+    sim = _build_plain(telemetry=True, telemetry_ring=16)
+    sim.telemetry_series_windows = 10
+    for end in ENDS:
+        sim.step_until_time(end)
+    wins, _ = sim.telemetry_window_series()
+    assert len(wins) <= 10
+    assert wins[-1] == sim.next_window_idx - 1  # newest windows survive
+    rep = sim.telemetry_report()
+    assert rep["ring"]["series_dropped_windows"] > 0
+    assert rep["ring"]["windows_kept"] <= 10
+
+
+def test_readout_does_not_emit_phantom_export_records():
+    """telemetry_report()/telemetry_window_series() force a drain, but a
+    drain that re-observes only known rows (fresh_windows == 0) must not
+    reach the exporters or re-judge the watchdog — readout stays
+    side-effect-free on the JSONL stream."""
+    sim = _build_plain(telemetry=True, telemetry_ring=16)
+    records = []
+
+    class _Recorder:
+        def emit(self, record):
+            records.append(record)
+
+    sim.attach_metrics_exporter(_Recorder())
+    sim.step_until_time(150.0)
+    sim.telemetry_window_series()  # forced drain picking up any residue
+    n = len(records)
+    assert n > 0
+    assert all(r["fresh_windows"] > 0 for r in records)
+    for _ in range(3):
+        sim.telemetry_report()
+    assert len(records) == n, "readout emitted phantom export records"
+
+
 def test_staged_superspan_records_prefetch_spans(monkeypatch):
     """Over-budget (bounded RefillStage) superspan runs surface the
     staging pipeline in the trace: stage_assemble/stage_put spans for
