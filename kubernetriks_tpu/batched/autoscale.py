@@ -136,19 +136,23 @@ class AutoscaleStatics(NamedTuple):
     ca_max_nodes: jnp.ndarray  # (C,) int32 global CA node quota
     ca_slots: jnp.ndarray  # (C, S) int32 global node slot of CA slot; -1 pad
     ca_slot_group: jnp.ndarray  # (C, S) int32 owning group; -1 pad
-    # --- scalar time constants (pairs) ---
-    hpa_interval: TPair
-    hpa_tolerance: jnp.ndarray  # f64 scalar
-    ca_threshold: jnp.ndarray  # f64 scalar
-    d_hpa_up: TPair  # HPA tick -> scaled-up pod enters scheduler queue
-    d_hpa_down: TPair  # HPA tick -> pod removal effect at storage
-    d_ca_up: TPair  # CA tick -> scaled-up node schedulable
-    d_ca_down: TPair  # CA tick -> node removal effect at node
+    # --- per-lane control-law parameters: (C,) pairs / arrays -----------
+    # Scenario-vector fleet (batched/fleet.py): every leaf below is
+    # per-CLUSTER traced data composed by fleet.scenario_leaves — a fleet
+    # of heterogeneous autoscaler configs runs under ONE compiled program
+    # (scalar-config builds carry the base value replicated across C).
+    hpa_interval: TPair  # (C,) per-lane HPA scan interval
+    hpa_tolerance: jnp.ndarray  # (C,) f64 per-lane target tolerance
+    ca_threshold: jnp.ndarray  # (C,) f64 per-lane scale-down threshold
+    d_hpa_up: TPair  # (C,) HPA tick -> scaled-up pod enters scheduler queue
+    d_hpa_down: TPair  # (C,) HPA tick -> pod removal effect at storage
+    d_ca_up: TPair  # (C,) CA tick -> scaled-up node schedulable
+    d_ca_down: TPair  # (C,) CA tick -> node removal effect at node
     # --- exact-CA cadence/visibility (r4; see ca_pass docstring) ---
-    ca_period: TPair  # true cycle period: round-trip + scan (or just rt)
-    ca_snap: TPair  # cycle fire -> storage snapshot (as_to_ca + as_to_ps)
-    ca_finish_vis: TPair  # node finish -> storage visibility
-    ca_commit_vis: TPair  # scheduler commit (assign/park) -> storage visibility
+    ca_period: TPair  # (C,) true cycle period: round-trip + scan (or just rt)
+    ca_snap: TPair  # (C,) cycle fire -> storage snapshot (as_to_ca + as_to_ps)
+    ca_finish_vis: TPair  # (C,) node finish -> storage visibility
+    ca_commit_vis: TPair  # (C,) scheduler commit -> storage visibility
     pod_name_rank: jnp.ndarray  # (C, P) int32 lexicographic name rank; BIG = n/a
     node_name_rank: jnp.ndarray  # (C, N) int32 node-name rank (trace + CA slots)
     ca_sd_order: jnp.ndarray  # (C, S) CA slot indices in name order
@@ -338,7 +342,8 @@ def _hpa_pass_body(
 
     def desired_by(util, target):
         ratio = util / jnp.maximum(target, 1e-9)
-        in_band = jnp.abs(ratio - 1.0) <= st.hpa_tolerance
+        # (C,) per-lane tolerance against the (C, Gp) ratio.
+        in_band = jnp.abs(ratio - 1.0) <= st.hpa_tolerance[:, None]
         # -1e-4 guards float32 products landing epsilon above an integer
         # (the scalar path computes the formula in f64).
         d = jnp.ceil(current.astype(jnp.float32) * ratio - 1e-4).astype(jnp.int32)
@@ -769,15 +774,20 @@ def _ca_scale_down(
     col_n = jnp.arange(N, dtype=jnp.int32)[None, :]
 
     snap_p = _broadcast_pair(snap, (C, P))
+    # (C,) per-lane finish-visibility delay as a (C, 1) column against the
+    # (C, P) pod pairs.
+    finish_vis = TPair(
+        win=st.ca_finish_vis.win[:, None], off=st.ca_finish_vis.off[:, None]
+    )
     # Running pod whose finish notification reached storage by snap: gone.
     vis_gone = (phase_v == PHASE_RUNNING) & t_le(
-        t_add(pods.finish_time, st.ca_finish_vis, interval), snap_p
+        t_add(pods.finish_time, finish_vis, interval), snap_p
     )
     # Succeeded pod the storage hasn't seen finish yet: still running there.
     # (finish = start + duration; service pods never reach SUCCEEDED.)
     succ_finish = t_add(
         t_add(pods.start_time, pods.duration, interval),
-        st.ca_finish_vis,
+        finish_vis,
         interval,
     )
     vis_back = (phase_v == PHASE_SUCCEEDED) & ~t_le(succ_finish, snap_p)

@@ -62,11 +62,7 @@ from kubernetriks_tpu.batched.trace_compile import (
     compile_cluster_trace,
     pad_and_batch,
 )
-from kubernetriks_tpu.config import (
-    KubeClusterAutoscalerConfig,
-    KubeHorizontalPodAutoscalerConfig,
-    SimulationConfig,
-)
+from kubernetriks_tpu.config import SimulationConfig
 from kubernetriks_tpu import sanitize
 from kubernetriks_tpu.flags import flag_bool, flag_int, flag_str, flag_tristate
 from kubernetriks_tpu.telemetry import (
@@ -276,20 +272,35 @@ def build_autoscale_statics(
     ca_slot_multiplier: int = 2,
     pod_slot_offset: int = 0,
     sliding: bool = False,
+    scenario=None,
 ):
     """Host-side compilation of pod-group (HPA) and node-group (CA) tables.
     pod_slot_offset: global-to-device pod-slot shift for the resident
     pod-group segment under a sliding pod window (0 = full-resident); the
     HPA tables live entirely in DEVICE coordinates.
 
+    scenario: optional per-lane override vectors (fleet.SCENARIO_KEYS,
+    each (C,)) — the scenario-bearing control-law parameters (scan
+    intervals, thresholds, CA period, autoscaler-chain delays, per-lane
+    enables/quotas) are ALWAYS composed per-cluster through
+    fleet.scenario_leaves and land as (C,)-shaped traced leaves, so one
+    compiled program serves any scenario mix; with scenario=None every
+    lane carries the base config's values (value-identical to the
+    pre-fleet scalar fold).
+
     Returns (statics, extra_node_cap_cpu (S,), extra_node_cap_ram (S,),
-    extra_node_names); the extra node slots are the CA's reserved slots,
+    extra_node_names, aux); the extra node slots are the CA's reserved slots,
     appended after the trace's node slots (the batched analog of pre-sizing the
     component pool with the autoscaler max, reference: src/simulator.rs:212-230;
-    slots are never reused, hence the churn multiplier)."""
+    slots are never reused, hence the churn multiplier). aux carries the
+    host-side tables engine.update_scenario needs to recompose leaves
+    without rebuilding (pg_active_when_on: (C, Gp) f64 activation times
+    as if the HPA were on everywhere; +inf on padding groups)."""
+    from kubernetriks_tpu.batched.fleet import scenario_leaves
+
     C = len(compiled_traces)
-    hpa_on = config.horizontal_pod_autoscaler.enabled
     ca_on = config.cluster_autoscaler.enabled
+    leaves = scenario_leaves(config, C, scenario)
 
     # --- HPA pod groups -----------------------------------------------------
     Gp = max((len(c.pod_groups) for c in compiled_traces), default=0) or 1
@@ -305,6 +316,7 @@ def build_autoscale_statics(
     pg_target_cpu = np.zeros((C, Gp), np.float32)
     pg_target_ram = np.zeros((C, Gp), np.float32)
     pg_active_from = np.full((C, Gp), np.inf, np.float64)
+    pg_active_when_on = np.full((C, Gp), np.inf, np.float64)
     pg_creation_s = np.zeros((C, Gp), np.float64)
     pg_cpu_dur = np.zeros((C, Gp, U), np.float32)
     pg_cpu_load = np.zeros((C, Gp, U), np.float32)
@@ -326,10 +338,16 @@ def build_autoscale_statics(
             # api-server expansion is unconditional) but no cycle ever acts.
             # active_from = creation + register delay (the first HPA tick that
             # sees the group, reference: horizontal_pod_autoscaler.rs:187-198).
+            # Per-LANE enable (scenario vector): a disabled lane parks its
+            # groups at +inf — the data encoding of "HPA off" the fleet's
+            # lane configs use.
             pg_creation_s[ci, gi] = g.creation_time
-            pg_active_from[ci, gi] = (
+            pg_active_when_on[ci, gi] = (
                 g.creation_time + config.as_to_hpa_network_delay
-                if hpa_on
+            )
+            pg_active_from[ci, gi] = (
+                pg_active_when_on[ci, gi]
+                if leaves["hpa_enabled"][ci]
                 else np.inf
             )
             for ui, (dur, load) in enumerate(g.cpu_units):
@@ -388,16 +406,6 @@ def build_autoscale_statics(
             extra_node_names.append(f"{name}_{k + 1}")
         cursor += reserve
 
-    delays = config
-    d_pod_enqueue = delays.as_to_ps_network_delay + delays.ps_to_sched_network_delay
-    hpa_tol = (
-        config.horizontal_pod_autoscaler.kube_horizontal_pod_autoscaler_config
-        or KubeHorizontalPodAutoscalerConfig()
-    ).target_threshold_tolerance
-    ca_thresh = (
-        ca_config.kube_cluster_autoscaler or KubeClusterAutoscalerConfig()
-    ).scale_down_utilization_threshold
-
     interval = config.scheduling_cycle_interval
 
     def pair(x) -> TPair:
@@ -407,17 +415,11 @@ def build_autoscale_statics(
 
     f64 = lambda x: jnp.asarray(x, jnp.float64)  # noqa: E731
 
-    # The CA's true cadence drifts: the scalar proxy re-arms scan_interval
-    # AFTER the info round-trip returns (cluster_autoscaler.py on_response;
-    # reference cluster_autoscaler.rs:256-262 — delay 0 on overrun), so the
-    # period is round_trip + scan_interval (or just round_trip on overrun),
-    # NOT window-aligned scan_interval. ca_next carries the true fire time.
-    ca_roundtrip = 2.0 * (
-        delays.as_to_ca_network_delay + delays.as_to_ps_network_delay
-    )
-    ca_period_s = ca_roundtrip + (
-        ca_config.scan_interval if ca_roundtrip <= ca_config.scan_interval else 0.0
-    )
+    # Scenario-bearing control-law parameters (scan intervals, thresholds,
+    # the drifting CA period, the autoscaler-chain delay compositions) are
+    # composed per-LANE by fleet.scenario_leaves — the one owner of those
+    # formulas (incl. the cluster_autoscaler.rs:256-262 overrun rule) —
+    # and land below as (C,)-shaped traced leaves.
 
     # Lexicographic name ranks of the trace's pods (device slot coords):
     # the storage's unscheduled-cache snapshot is name-sorted
@@ -491,43 +493,26 @@ def build_autoscale_statics(
         ng_max_count=jnp.asarray(ng_max_count),
         ng_tmpl_cpu=jnp.asarray(ng_tmpl_cpu),
         ng_tmpl_ram=jnp.asarray(ng_tmpl_ram),
-        ca_max_nodes=jnp.full(
-            (C,), ca_config.max_node_count if ca_on else 0, jnp.int32
-        ),
+        ca_max_nodes=jnp.asarray(leaves["ca_max_nodes"], jnp.int32),
         ca_slots=jnp.asarray(ca_slots),
         ca_slot_group=jnp.asarray(ca_slot_group),
-        hpa_interval=pair(config.horizontal_pod_autoscaler.scan_interval),
-        hpa_tolerance=f64(hpa_tol),
-        ca_threshold=f64(ca_thresh),
-        d_hpa_up=pair(delays.as_to_ca_network_delay + d_pod_enqueue),
-        d_hpa_down=pair(
-            delays.as_to_ca_network_delay + delays.as_to_ps_network_delay
-        ),
-        d_ca_up=pair(
-            3.0 * delays.as_to_ca_network_delay
-            + 5.0 * delays.as_to_ps_network_delay
-            + delays.ps_to_sched_network_delay
-        ),
-        d_ca_down=pair(
-            3.0 * delays.as_to_ca_network_delay
-            + 4.0 * delays.as_to_ps_network_delay
-            + delays.as_to_node_network_delay
-        ),
-        ca_period=pair(ca_period_s),
-        ca_snap=pair(
-            delays.as_to_ca_network_delay + delays.as_to_ps_network_delay
-        ),
-        ca_finish_vis=pair(
-            delays.as_to_node_network_delay + delays.as_to_ps_network_delay
-        ),
-        ca_commit_vis=pair(
-            delays.sched_to_as_network_delay + delays.as_to_ps_network_delay
-        ),
+        hpa_interval=pair(leaves["hpa_interval_s"]),
+        hpa_tolerance=f64(leaves["hpa_tolerance"]),
+        ca_threshold=f64(leaves["ca_threshold"]),
+        d_hpa_up=pair(leaves["d_hpa_up_s"]),
+        d_hpa_down=pair(leaves["d_hpa_down_s"]),
+        d_ca_up=pair(leaves["d_ca_up_s"]),
+        d_ca_down=pair(leaves["d_ca_down_s"]),
+        ca_period=pair(leaves["ca_period_s"]),
+        ca_snap=pair(leaves["ca_snap_s"]),
+        ca_finish_vis=pair(leaves["ca_finish_vis_s"]),
+        ca_commit_vis=pair(leaves["ca_commit_vis_s"]),
         pod_name_rank=jnp.asarray(pod_name_rank),
         node_name_rank=jnp.asarray(node_name_rank),
         ca_sd_order=jnp.asarray(ca_sd_order),
     )
-    return statics, extra_cap_cpu, extra_cap_ram, extra_node_names
+    aux = {"pg_active_when_on": pg_active_when_on}
+    return statics, extra_cap_cpu, extra_cap_ram, extra_node_names, aux
 
 
 class BatchedSimulation:
@@ -564,8 +549,19 @@ class BatchedSimulation:
         window_razor: Optional[bool] = None,
         ca_descatter: Optional[bool] = None,
         scheduler_profile=None,
+        scenario=None,
     ) -> None:
         self.config = config
+        # Scenario-vector fleet (batched/fleet.py): optional per-lane
+        # override vectors for the autoscaler control-law parameters.
+        # Validated + normalized to (C,) numpy arrays here; the statics
+        # build below composes them into the (C,)-shaped traced leaves
+        # and the chaos block installs per-lane pod-fault seeds as
+        # consts.fault_seed. None = every lane runs the base config
+        # (value-identical leaves to the pre-fleet scalar fold).
+        from kubernetriks_tpu.batched.fleet import normalize_scenario
+
+        self._scenario = normalize_scenario(scenario, len(compiled_traces))
         # Compiled scheduler profile (batched/pipeline.py): the configured
         # Filter/Score plugin profile lowered to kernel statics. Resolution
         # order: explicit arg > config.scheduler_profile > KTPU_PROFILE env
@@ -928,6 +924,26 @@ class BatchedSimulation:
 
         self.fault_params = make_fault_params(config)
         self._debug_finite = flag_bool("KTPU_DEBUG_FINITE")
+        # Per-lane pod-fault seeds (scenario vector): traced (C,) data in
+        # StepConstants — each lane's attempt draws key on (seed[c],
+        # cluster 0), making its fault stream a pure function of the
+        # scenario (lane-permutation invariance; fleet re-seeds are data,
+        # not recompiles). Installed ONLY under a scenario build so
+        # scenario-less engines keep the pre-fleet consts pytree (and the
+        # per-cluster keying the chaos suite pins).
+        if (
+            self._scenario is not None
+            and self.fault_params is not None
+            and self.fault_params.fail_prob > 0
+        ):
+            from kubernetriks_tpu.batched.fleet import scenario_leaves
+
+            seeds = scenario_leaves(config, C, self._scenario)["fault_seed"]
+            self.consts = self.consts._replace(
+                fault_seed=jnp.asarray(
+                    seeds.astype(np.uint32), jnp.uint32
+                )
+            )
 
         if pod_window is not None:
             # Cross-process meshes are supported through the device-resident
@@ -1011,8 +1027,9 @@ class BatchedSimulation:
         # here at build time (cold path, before mesh placement).
         self._reserve_capacities: dict = {}
         self.pod_group_names = [[g.name for g in c.pod_groups] for c in compiled_traces]
+        self._autoscale_aux: dict = {}
         if hpa_on or ca_on:
-            statics, extra_cpu, extra_ram, extra_names = build_autoscale_statics(
+            statics, extra_cpu, extra_ram, extra_names, aux = build_autoscale_statics(
                 config,
                 compiled_traces,
                 n_pods=pod_req_cpu.shape[1],
@@ -1021,8 +1038,10 @@ class BatchedSimulation:
                 ca_slot_multiplier=ca_slot_multiplier,
                 pod_slot_offset=self._resident_shift,
                 sliding=pod_window is not None,
+                scenario=self._scenario,
             )
             self.autoscale_statics = statics
+            self._autoscale_aux = aux
             self._reserve_capacities = {
                 "hpa_reserve": [
                     int(v)
@@ -1352,6 +1371,15 @@ class BatchedSimulation:
                 "pod_window, or drop to a single-process mesh (the host "
                 "slide path needs every shard addressable)"
             )
+
+        # Scenario-vector fleets (batched/fleet.py) reset lanes against the
+        # PRISTINE build state (fleet_reset's donation-friendly select
+        # re-init). Snapshot it only for scenario builds — plain engines
+        # must not pay a second full-state copy in device memory.
+        self._pristine = None
+        self._pristine_pod_window = self.pod_window
+        if self._scenario is not None:
+            self._pristine = tree_copy(self.state)
 
     def _slide_payload_fits(self, W: int) -> bool:
         """Whether the device-resident slide payload at window width W fits
@@ -1706,6 +1734,132 @@ class BatchedSimulation:
                 n += 1
         self.tracer.end(PH_PRECOMPILE, t_warm)
         return n
+
+    # --- scenario-vector fleet support (batched/fleet.py) -------------------
+
+    def _pair_np(self, x) -> TPair:
+        """Host f64 seconds (scalar or array) -> device TPair."""
+        w, o = from_f64_np(
+            np.asarray(x, np.float64), self.config.scheduling_cycle_interval  # ktpu: sync-ok(scenario update: host numpy over per-lane config vectors, no device values)
+        )
+        return TPair(win=jnp.asarray(w), off=jnp.asarray(o))
+
+    def update_scenario(self, scenario) -> None:
+        """Install new per-lane scenario vectors into the RESIDENT engine:
+        the scenario-bearing statics leaves (scan intervals, thresholds,
+        CA period/quota, autoscaler-chain delays, per-lane HPA enables)
+        and the pod-fault seed vector are all traced (C,)-shaped DATA, so
+        this is a handful of host->device puts — never a recompile
+        (bench.py --sweep asserts exactly that via fleet.jit_cache_sizes).
+        Only legal on an engine built with scenario= (the fleet build):
+        a scenario-less build may carry a different consts pytree
+        (no fault_seed leaf), where a late update would shadow-compile."""
+        from kubernetriks_tpu.batched.fleet import (
+            normalize_scenario,
+            scenario_leaves,
+        )
+
+        if self._scenario is None:
+            raise ValueError(
+                "update_scenario requires an engine built with scenario= "
+                "(the fleet build): scenario-less engines compile the "
+                "pre-fleet consts pytree and a late scenario would "
+                "shadow-compile next to it"
+            )
+        updates = normalize_scenario(scenario, self.n_clusters) or {}
+        self._scenario.update(updates)
+        leaves = scenario_leaves(self.config, self.n_clusters, self._scenario)
+        if self.autoscale_statics is not None:
+            active_when_on = self._autoscale_aux["pg_active_when_on"]
+            pg_active_from = np.where(
+                leaves["hpa_enabled"][:, None], active_when_on, np.inf
+            )
+            st = self.autoscale_statics._replace(
+                hpa_interval=self._pair_np(leaves["hpa_interval_s"]),
+                hpa_tolerance=jnp.asarray(
+                    leaves["hpa_tolerance"], jnp.float64
+                ),
+                ca_threshold=jnp.asarray(leaves["ca_threshold"], jnp.float64),
+                ca_max_nodes=jnp.asarray(leaves["ca_max_nodes"], jnp.int32),
+                pg_active_from=self._pair_np(pg_active_from),
+                d_hpa_up=self._pair_np(leaves["d_hpa_up_s"]),
+                d_hpa_down=self._pair_np(leaves["d_hpa_down_s"]),
+                d_ca_up=self._pair_np(leaves["d_ca_up_s"]),
+                d_ca_down=self._pair_np(leaves["d_ca_down_s"]),
+                ca_period=self._pair_np(leaves["ca_period_s"]),
+                ca_snap=self._pair_np(leaves["ca_snap_s"]),
+                ca_finish_vis=self._pair_np(leaves["ca_finish_vis_s"]),
+                ca_commit_vis=self._pair_np(leaves["ca_commit_vis_s"]),
+            )
+            if self._sharding is not None:
+                put = (
+                    put_global
+                    if is_cross_process(self._sharding.mesh)
+                    else jax.device_put
+                )
+                st = put(st, self._state_shardings(self._sharding, st))
+            self.autoscale_statics = st
+        if self.consts.fault_seed is not None:
+            self.consts = self.consts._replace(
+                fault_seed=jnp.asarray(
+                    leaves["fault_seed"].astype(np.uint32), jnp.uint32
+                )
+            )
+
+    def fleet_reset(self, lanes=None) -> None:
+        """Reset cluster lanes to the PRISTINE build state in place — the
+        fleet's between-query re-init. One donated select per state leaf
+        against the build snapshot (device-buffer reuse, no recompile, no
+        re-warm; fleet._reset_lanes). lanes=None resets EVERY lane and
+        also rewinds the host-side cursors (window clock, pod-window
+        position, staging ring/feeder, telemetry bookkeeping) — the wave
+        boundary. An explicit lane list resets only those state rows and
+        leaves the clock alone (only meaningful while the clock is at a
+        wave boundary; the window clock is fleet-global)."""
+        from kubernetriks_tpu.batched.fleet import _reset_lanes
+
+        if self._pristine is None:
+            raise ValueError(
+                "fleet_reset requires an engine built with scenario= "
+                "(the fleet build keeps the pristine state snapshot)"
+            )
+        if self.pod_window != self._pristine_pod_window:
+            raise RuntimeError(
+                f"fleet_reset: the pod window grew ({self._pristine_pod_window}"
+                f" -> {self.pod_window}) during a wave, so the pristine "
+                "snapshot's shapes are stale — build the fleet with a "
+                "larger pod_window so dense waves never grow it"
+            )
+        mask = np.zeros((self.n_clusters,), bool)
+        if lanes is None:
+            mask[:] = True
+        else:
+            mask[np.asarray(list(lanes), np.int64)] = True  # ktpu: sync-ok(fleet reset: host numpy over a python lane list, no device values)
+        donated_in = self.state if self._sanitize else None
+        self.state = _reset_lanes(
+            self.state, self._pristine, jnp.asarray(mask)
+        )
+        if donated_in is not None:
+            sanitize.consume_donated(donated_in)
+        if lanes is not None:
+            return
+        # Wave boundary: rewind the host cursors to the build state.
+        self.next_window_idx = 0
+        self._pod_base = 0
+        self._pending_shift = None
+        self._refill_prefetch = None
+        self._stage_cur = None
+        self._stage_next = None
+        self._close_feeder()
+        self._refresh_name_ranks()
+        if self.state.telemetry is not None:
+            self._ring_seen.clear()
+            self._ring_series_dropped = 0
+            self._ring_windows_recorded = 0
+            self._ring_drained_at = 0
+            self._pending_flow = 0
+        if self.observatory is not None:
+            self.observatory.reset()
 
     def step_until_time(self, until_time: float) -> None:
         """Advance to `until_time`. THE steady-state dispatch region: under
@@ -3449,26 +3603,60 @@ def build_batched_from_traces(
         fault_seed = (
             fault_cfg.seed if fault_cfg.seed is not None else config.seed
         )
+        # Scenario-vector fleet: per-LANE crash-chain seeds. The chain
+        # compiler then keys every lane on cluster 0 with its own seed —
+        # a lane's crash schedule becomes a pure function of its scenario
+        # seed (same-seed lanes share one schedule; lane c with seed s
+        # matches the scalar oracle run with seed s), instead of the
+        # replicated-batch default where every lane derives a distinct
+        # schedule from (shared seed, lane index). NOTE: chain events are
+        # compiled into the trace slab, so node-fault seeds are fixed at
+        # BUILD (per wave of lanes they are config data the fleet sets
+        # once); the pod-fault seed channel stays pure traced data.
+        scenario = kwargs.get("scenario")
+        lane_seeds = None
+        if scenario is not None:
+            # ANY scenario build keys scenario-pure: the engine installs
+            # consts.fault_seed for the pod channel whenever a scenario
+            # is present (defaulting every lane to the config seed), so
+            # the node chains must follow the same rule or the two fault
+            # channels would mix per-lane and per-index keying.
+            seeds = scenario.get("fault_seed")
+            lane_seeds = np.broadcast_to(
+                np.asarray(  # ktpu: sync-ok(engine build: host numpy over the scenario seed vector, no device values)
+                    seeds if seeds is not None else fault_seed, np.int64
+                ),
+                (n_clusters,),
+            )
         horizon = chaos.fault_horizon(
             fault_cfg, cluster_events, workload_events
         )
-        compiled_list = [
-            compile_cluster_trace(
-                chaos.inject_node_faults(
-                    cluster_events,
-                    fault_cfg,
-                    fault_seed,
-                    c,
-                    horizon,
-                    config.scheduling_cycle_interval,
-                ),
-                workload_events,
-                config,
-                ram_unit=ram_unit,
-                pod_group_slot_multiplier=slot_mult,
-            )
-            for c in range(n_clusters)
-        ]
+        # Same (seed, cluster-key) -> same chain: memoize the compile so
+        # a fleet of repeated scenarios pays one chain per unique seed.
+        _chain_cache: dict = {}
+
+        def _compiled_for(c: int):
+            seed = fault_seed if lane_seeds is None else int(lane_seeds[c])
+            ckey = c if lane_seeds is None else 0
+            got = _chain_cache.get((seed, ckey))
+            if got is None:
+                got = _chain_cache[(seed, ckey)] = compile_cluster_trace(
+                    chaos.inject_node_faults(
+                        cluster_events,
+                        fault_cfg,
+                        seed,
+                        ckey,
+                        horizon,
+                        config.scheduling_cycle_interval,
+                    ),
+                    workload_events,
+                    config,
+                    ram_unit=ram_unit,
+                    pod_group_slot_multiplier=slot_mult,
+                )
+            return got
+
+        compiled_list = [_compiled_for(c) for c in range(n_clusters)]
         return BatchedSimulation(config, compiled_list, **kwargs)
 
     compiled = compile_cluster_trace(

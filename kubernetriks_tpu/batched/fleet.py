@@ -1,0 +1,545 @@
+"""Scenario-vector fleet: per-cluster config lanes over ONE compiled engine.
+
+The cluster-batch axis C steps hundreds of clusters in lockstep, but until
+this module every autoscaler parameter was a per-run scalar folded into the
+`AutoscaleStatics` / `StepConstants` leaves at engine build — a parameter
+sweep or what-if query paid a fresh engine, a full XLA compile and warm-up
+per scenario. Here the scenario-bearing control-law parameters ride as
+per-cluster (C,)-shaped TRACED arrays instead (ROADMAP #4: "per-cluster
+config vectors instead of Python scalars"), so ONE compiled window /
+superspan program serves any scenario mix, and this module supplies:
+
+- `Scenario`: the per-lane config delta a what-if query carries. The
+  vectorizable set is exactly the parameters that (a) do not shape
+  programs and (b) enter ONLY the autoscaler chains, so a lane with
+  overrides stays lane-by-lane equivalent to a scalar run with the same
+  scalars (tests/test_fleet.py pins it):
+    * HPA scan interval, target-threshold tolerance, per-lane enable
+    * CA scan interval, scale-down utilization threshold, node quota
+    * as_to_ca_network_delay (the one config delay that feeds ONLY the
+      autoscaler chains: d_hpa_up/down, d_ca_up/down, ca_period, ca_snap)
+    * the pod-fault PRNG seed (`fault_injection` already keys draws
+      per-cluster; the fleet generalizes that to per-lane seeds keyed on
+      cluster 0, making a lane's fault stream a pure function of its
+      scenario — see StepConstants.fault_seed)
+  Slot counts, reserve sizes, the scheduling interval and everything else
+  shape- or program-bearing stays a build-time static.
+- `scenario_leaves`: the ONE owner of the scalar->per-lane composition
+  rules (the delay-chain formulas previously inlined in
+  engine.build_autoscale_statics). Both the engine build and the fleet's
+  between-query updates go through it, so the two can never drift.
+- `ScenarioFleet`: a resident front-end that packs incoming what-if
+  queries (config delta + horizon) into cluster lanes, resets the lanes'
+  state columns in place (donation-friendly select re-init against the
+  pristine build snapshot — no recompile, no re-warm), runs the resident
+  composed engine, and reads per-lane results back at the horizon
+  boundaries where the host already blocks (the telemetry-ring drain
+  points — zero NEW syncs inside the dispatch loop). Compile and warm-up
+  amortize across the whole query stream.
+
+Lane reset protocol (honest scope): the engine's window clock is
+fleet-GLOBAL (every lane steps the same window index), so queries are
+packed into WAVES — all lanes reset together at a wave boundary, then the
+wave runs to its queries' horizons (per-lane results are read as each
+horizon passes; lanes whose horizon came early keep simulating idle).
+A per-lane window-clock offset (true continuous batching, a lane freed
+mid-wave re-seeding immediately) is the named follow-up; the per-lane
+config vectors landed here are exactly what it needs.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, fields
+from functools import partial
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from kubernetriks_tpu.config import (
+    KubeClusterAutoscalerConfig,
+    KubeHorizontalPodAutoscalerConfig,
+    SimulationConfig,
+)
+
+# Scenario keys accepted as per-lane overrides (the vectorizable set).
+SCENARIO_KEYS = (
+    "hpa_scan_interval",
+    "hpa_tolerance",
+    "hpa_enabled",
+    "ca_scan_interval",
+    "ca_threshold",
+    "ca_max_node_count",
+    "as_to_ca_network_delay",
+    "fault_seed",
+)
+
+
+@dataclass(frozen=True)
+class Scenario:
+    """One what-if query's config delta: every field is an override of the
+    base SimulationConfig's value for ONE cluster lane (None = keep the
+    base). `ca_max_node_count: 0` disables CA scale-up for the lane (quota
+    0 plans nothing and counts no starvation); `hpa_enabled: False` parks
+    the lane's pod groups (pg_active_from = +inf), matching a scalar run
+    with the HPA off while the initial replicas still run."""
+
+    hpa_scan_interval: Optional[float] = None
+    hpa_tolerance: Optional[float] = None
+    hpa_enabled: Optional[bool] = None
+    ca_scan_interval: Optional[float] = None
+    ca_threshold: Optional[float] = None
+    ca_max_node_count: Optional[int] = None
+    as_to_ca_network_delay: Optional[float] = None
+    fault_seed: Optional[int] = None
+
+    def overrides(self) -> Dict[str, object]:
+        return {
+            f.name: getattr(self, f.name)
+            for f in fields(self)
+            if getattr(self, f.name) is not None
+        }
+
+
+def _base_values(config: SimulationConfig) -> Dict[str, float]:
+    """The base config's value for every scenario key — the scalar the
+    per-lane vector is filled with where a lane has no override."""
+    hpa = config.horizontal_pod_autoscaler
+    ca = config.cluster_autoscaler
+    hpa_tol = (
+        hpa.kube_horizontal_pod_autoscaler_config
+        or KubeHorizontalPodAutoscalerConfig()
+    ).target_threshold_tolerance
+    ca_thresh = (
+        ca.kube_cluster_autoscaler or KubeClusterAutoscalerConfig()
+    ).scale_down_utilization_threshold
+    return {
+        "hpa_scan_interval": float(hpa.scan_interval),
+        "hpa_tolerance": float(hpa_tol),
+        "hpa_enabled": bool(hpa.enabled),
+        "ca_scan_interval": float(ca.scan_interval),
+        "ca_threshold": float(ca_thresh),
+        "ca_max_node_count": int(ca.max_node_count if ca.enabled else 0),
+        "as_to_ca_network_delay": float(config.as_to_ca_network_delay),
+        "fault_seed": int(
+            config.fault_injection.seed
+            if getattr(config, "fault_injection", None) is not None
+            and config.fault_injection.seed is not None
+            else config.seed
+        ),
+    }
+
+
+def scenario_vectors(
+    config: SimulationConfig,
+    n_lanes: int,
+    scenarios: Optional[Sequence[Optional[Scenario]]] = None,
+    base_vectors: Optional[Dict[str, np.ndarray]] = None,
+) -> Dict[str, np.ndarray]:
+    """Materialize the per-lane (C,) scenario vectors: the base config's
+    value everywhere (or a copy of `base_vectors` when given — the
+    fleet's per-wave composition starts from its BUILD vectors, so a
+    lane with no override keeps its build-time config, node-fault seeds
+    included), each lane's Scenario overrides applied on top.
+    scenarios: at most n_lanes entries (None entries keep the base)."""
+    base = _base_values(config)
+    out: Dict[str, np.ndarray] = {}
+    for key in SCENARIO_KEYS:
+        if base_vectors is not None and key in base_vectors:
+            out[key] = base_vectors[key].copy()
+        elif key == "hpa_enabled":
+            out[key] = np.full((n_lanes,), bool(base[key]), bool)
+        elif key in ("ca_max_node_count", "fault_seed"):
+            out[key] = np.full((n_lanes,), int(base[key]), np.int64)
+        else:
+            out[key] = np.full((n_lanes,), float(base[key]), np.float64)
+    if scenarios is not None:
+        if len(scenarios) > n_lanes:
+            raise ValueError(
+                f"{len(scenarios)} scenarios do not fit {n_lanes} lanes"
+            )
+        for lane, scen in enumerate(scenarios):
+            if scen is None:
+                continue
+            for key, val in scen.overrides().items():
+                if key not in out:
+                    raise KeyError(f"unknown scenario key {key!r}")
+                out[key][lane] = val
+    return out
+
+
+def normalize_scenario(
+    scenario: Optional[Dict[str, object]], n_lanes: int
+) -> Optional[Dict[str, np.ndarray]]:
+    """Validate a scenario-vector mapping: known keys only, every value
+    broadcastable to (n_lanes,). Returns owned (C,) numpy arrays."""
+    if scenario is None:
+        return None
+    out: Dict[str, np.ndarray] = {}
+    for key, val in scenario.items():
+        if key not in SCENARIO_KEYS:
+            raise KeyError(
+                f"unknown scenario key {key!r}; supported: {SCENARIO_KEYS}"
+            )
+        arr = np.asarray(val)
+        if arr.ndim == 0:
+            arr = np.full((n_lanes,), arr[()])
+        if arr.shape != (n_lanes,):
+            raise ValueError(
+                f"scenario[{key!r}] must be scalar or shape ({n_lanes},), "
+                f"got {arr.shape}"
+            )
+        out[key] = arr.copy()
+    return out
+
+
+def scenario_leaves(
+    config: SimulationConfig,
+    n_lanes: int,
+    scenario: Optional[Dict[str, np.ndarray]] = None,
+) -> Dict[str, np.ndarray]:
+    """Compose the per-lane (C,)-shaped autoscaler-parameter leaves from
+    the base config plus optional per-lane overrides — THE owner of the
+    delay-chain composition rules (mirroring the scalar event chains;
+    reference cluster_autoscaler.rs:256-262, SURVEY.md §3.2/3.4). Used by
+    engine.build_autoscale_statics at build AND by
+    engine.update_scenario between fleet queries, so the two sites can
+    never drift. All values are float64 seconds (converted to device
+    TPairs by the caller) except the bool/int control vectors."""
+    scenario = dict(scenario or {})
+    base = _base_values(config)
+    C = n_lanes
+
+    def vec(key, dtype=np.float64):
+        val = scenario.get(key)
+        out = np.full((C,), base[key], dtype)
+        if val is not None:
+            out[:] = np.asarray(val)
+        return out
+
+    hpa_scan = vec("hpa_scan_interval")
+    hpa_tol = vec("hpa_tolerance")
+    hpa_en = vec("hpa_enabled", bool) & bool(
+        config.horizontal_pod_autoscaler.enabled
+    )
+    ca_scan = vec("ca_scan_interval")
+    ca_thresh = vec("ca_threshold")
+    ca_max = vec("ca_max_node_count", np.int64)
+    if not config.cluster_autoscaler.enabled:
+        ca_max[:] = 0
+    as_to_ca = vec("as_to_ca_network_delay")
+    fault_seed = vec("fault_seed", np.int64)
+
+    as_to_ps = float(config.as_to_ps_network_delay)
+    ps_to_sched = float(config.ps_to_sched_network_delay)
+    sched_to_as = float(config.sched_to_as_network_delay)
+    as_to_node = float(config.as_to_node_network_delay)
+    d_pod_enqueue = as_to_ps + ps_to_sched
+
+    # The CA's true cadence drifts: the scalar proxy re-arms scan_interval
+    # AFTER the info round-trip returns (delay 0 on overrun), so the
+    # period is round_trip + scan_interval (or just round_trip on
+    # overrun) — composed per lane.
+    ca_roundtrip = 2.0 * (as_to_ca + as_to_ps)
+    ca_period_s = ca_roundtrip + np.where(
+        ca_roundtrip <= ca_scan, ca_scan, 0.0
+    )
+
+    return {
+        "hpa_interval_s": hpa_scan,
+        "hpa_tolerance": hpa_tol,
+        "hpa_enabled": hpa_en,
+        "ca_threshold": ca_thresh,
+        "ca_max_nodes": ca_max,
+        "fault_seed": fault_seed,
+        "d_hpa_up_s": as_to_ca + d_pod_enqueue,
+        "d_hpa_down_s": as_to_ca + as_to_ps,
+        "d_ca_up_s": 3.0 * as_to_ca + 5.0 * as_to_ps + ps_to_sched,
+        "d_ca_down_s": 3.0 * as_to_ca + 4.0 * as_to_ps + as_to_node,
+        "ca_period_s": ca_period_s,
+        "ca_snap_s": as_to_ca + as_to_ps,
+        "ca_finish_vis_s": np.full((C,), as_to_node + as_to_ps),
+        "ca_commit_vis_s": np.full((C,), sched_to_as + as_to_ps),
+    }
+
+
+# --- fleet ------------------------------------------------------------------
+
+
+@dataclass
+class FleetResult:
+    """One drained what-if query."""
+
+    query: int
+    wave: int
+    lane: int
+    horizon: float
+    scenario: Scenario
+    counters: Dict[str, float]
+    hpa_replicas: Optional[Dict[str, int]]
+    ca_nodes: Optional[List[int]]
+    # Per-lane divergence counters (the loud-readout bounds of
+    # engine.check_autoscaler_bounds, read per lane here): nonzero means
+    # the lane's trajectory diverged from the scalar semantics.
+    hpa_reserve_clamped: int = 0
+    ca_reserve_starved: int = 0
+
+
+# The per-lane counter rows a query reads back (MetricArrays fields).
+_RESULT_COUNTERS = (
+    "pods_succeeded",
+    "pods_removed",
+    "terminated_pods",
+    "scheduling_decisions",
+    "scaled_up_pods",
+    "scaled_down_pods",
+    "scaled_up_nodes",
+    "scaled_down_nodes",
+    "node_crashes",
+    "node_recoveries",
+    "pod_interruptions",
+    "pod_restarts",
+    "pods_failed",
+)
+
+
+def jit_cache_sizes() -> Dict[str, int]:
+    """Compiled-variant counts of every jit entry the dispatch loop can
+    touch — the zero-recompile observable: capture after warm-up, compare
+    after the query stream (bench.py --sweep asserts equality; a scenario
+    update that silently became a jit-static shows up here loudly)."""
+    from kubernetriks_tpu.batched import autoscale, engine, state, step
+
+    entries = {
+        "window_step": step.window_step,
+        "run_windows": step.run_windows,
+        "run_windows_donated": step.run_windows_donated,
+        "run_windows_skip": step.run_windows_skip,
+        "run_windows_skip_donated": step.run_windows_skip_donated,
+        "run_superspan": step.run_superspan,
+        "run_superspan_donated": step.run_superspan_donated,
+        "fused_chunk_slide": engine._fused_chunk_slide,
+        "fused_chunk_slide_donated": engine._fused_chunk_slide_donated,
+        "hpa_pass_donated": autoscale.hpa_pass_donated,
+        "ca_pass_donated": autoscale.ca_pass_donated,
+        "tree_copy": state.tree_copy,
+        "reset_lanes": _reset_lanes,
+    }
+    out = {}
+    for name, fn in entries.items():
+        try:
+            out[name] = int(fn._cache_size())
+        except AttributeError:  # pragma: no cover - jax version drift
+            out[name] = -1
+    return out
+
+
+def _make_reset_lanes():
+    import jax
+    import jax.numpy as jnp
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def reset(state, pristine, mask):
+        """Per-lane state re-init: lanes with mask True take the pristine
+        build state's rows, everything else keeps the current buffers —
+        donation reuses the live state's device buffers in place (no fresh
+        full-state allocation per wave). Every state leaf leads with the
+        cluster axis, so one broadcasted select covers the whole pytree."""
+
+        def leaf(cur, ini):
+            m = mask.reshape((-1,) + (1,) * (cur.ndim - 1))
+            return jnp.where(m, ini, cur)
+
+        return jax.tree.map(leaf, state, pristine)
+
+    return reset
+
+
+_reset_lanes = _make_reset_lanes()
+
+
+class ScenarioFleet:
+    """Resident what-if service over one compiled batched engine.
+
+    Build once (compile + warm-up paid once), then `submit()` scenarios
+    and `run()`: queries pack into C-lane waves; each wave resets the
+    lanes in place, installs the wave's per-lane config vectors (traced
+    data — zero recompiles), steps the resident engine to the wave's
+    horizons and drains per-lane results at those existing host-block
+    boundaries.
+    """
+
+    def __init__(
+        self,
+        config: SimulationConfig,
+        cluster_events,
+        workload_events,
+        n_lanes: int,
+        horizon: float,
+        strict_divergence: bool = True,
+        build_scenarios: Optional[Sequence[Optional[Scenario]]] = None,
+        **engine_kwargs,
+    ) -> None:
+        from kubernetriks_tpu.batched.engine import build_batched_from_traces
+
+        if n_lanes < 1:
+            raise ValueError("a fleet needs at least one lane")
+        self.config = config
+        self.n_lanes = int(n_lanes)
+        self.default_horizon = float(horizon)
+        self.strict_divergence = bool(strict_divergence)
+        # Build WITH the scenario vectors so every scenario-bearing leaf
+        # is (C,)-shaped traced data from the start (later updates are
+        # pure data; in particular consts.fault_seed's pytree presence is
+        # fixed at build — see engine.update_scenario). build_scenarios:
+        # per-lane BUILD config (the wave default a query's overrides
+        # apply on top of) — the one channel that reaches the host-
+        # compiled node-fault crash chains, which live in the trace slab
+        # and are fixed per lane at build (pod-fault seeds stay pure
+        # traced data and re-seed per wave).
+        self._vectors = scenario_vectors(config, self.n_lanes, build_scenarios)
+        self.engine = build_batched_from_traces(
+            config,
+            cluster_events,
+            workload_events,
+            n_clusters=self.n_lanes,
+            scenario=dict(self._vectors),
+            **engine_kwargs,
+        )
+        self._queue: deque = deque()
+        self._next_query = 0
+        self.results: Dict[int, FleetResult] = {}
+        self.waves_run = 0
+        # Wave 0 runs on the build-fresh engine; later waves reset first.
+        self._dirty = False
+        # Warm the lane-reset program now (an empty lane list is the same
+        # compiled program — the mask is traced data), so the first REAL
+        # reset at the wave-2 boundary is a cache hit and the sweep's
+        # zero-recompiles-after-warm-up capture covers every program the
+        # steady query stream can touch.
+        self.engine.fleet_reset(lanes=[])
+
+    # -- query intake --------------------------------------------------------
+
+    def submit(
+        self, scenario: Optional[Scenario] = None, horizon: Optional[float] = None
+    ) -> int:
+        """Queue one what-if query; returns its id (the key into
+        `results` after `run()`)."""
+        qid = self._next_query
+        self._next_query += 1
+        self._queue.append(
+            (
+                qid,
+                scenario if scenario is not None else Scenario(),
+                float(horizon) if horizon is not None else self.default_horizon,
+            )
+        )
+        return qid
+
+    @property
+    def pending(self) -> int:
+        return len(self._queue)
+
+    # -- wave machinery ------------------------------------------------------
+
+    def _lane_rows(self, lanes: Sequence[int]) -> Dict[int, Dict[str, float]]:
+        """Per-lane counter rows, fetched in ONE host block per metric
+        leaf at a horizon boundary (the engine just blocked there for the
+        step's own sync; this is the readout ride-along, not a new
+        steady-state sync)."""
+        m = self.engine.state.metrics
+        host = {
+            name: np.asarray(getattr(m, name)) for name in _RESULT_COUNTERS
+        }
+        host["hpa_reserve_clamped"] = np.asarray(m.hpa_reserve_clamped)
+        host["ca_reserve_starved"] = np.asarray(m.ca_reserve_starved)
+        return {
+            lane: {name: arr[lane].item() for name, arr in host.items()}
+            for lane in lanes
+        }
+
+    def _drain_lane(
+        self, qid: int, lane: int, horizon: float, scen: Scenario, rows: Dict
+    ) -> None:
+        row = rows[lane]
+        clamped = int(row.pop("hpa_reserve_clamped"))
+        starved = int(row.pop("ca_reserve_starved"))
+        if self.strict_divergence and (clamped > 0 or starved > 0):
+            raise RuntimeError(
+                f"fleet query {qid} (lane {lane}): autoscaler reserve "
+                f"bound crossed (hpa_reserve_clamped={clamped}, "
+                f"ca_reserve_starved={starved}) — the lane's trajectory "
+                "diverged from the scalar semantics; widen the reserves "
+                "or pass strict_divergence=False to read it anyway"
+            )
+        eng = self.engine
+        hpa = None
+        ca = None
+        if eng.state.auto is not None:
+            hpa = eng.hpa_replicas(lane)
+            ca = [int(v) for v in eng.ca_node_counts(lane)]
+        self.results[qid] = FleetResult(
+            query=qid,
+            wave=self.waves_run,
+            lane=lane,
+            horizon=horizon,
+            scenario=scen,
+            counters={k: int(v) for k, v in row.items()},
+            hpa_replicas=hpa,
+            ca_nodes=ca,
+            hpa_reserve_clamped=clamped,
+            ca_reserve_starved=starved,
+        )
+
+    def _run_wave(self, wave) -> None:
+        eng = self.engine
+        # Install the wave's per-lane config rows: base values everywhere,
+        # each assigned lane's overrides on top. Idle lanes run the base
+        # scenario (their work is discarded).
+        vectors = scenario_vectors(
+            self.config,
+            self.n_lanes,
+            [scen for _, scen, _ in wave],
+            base_vectors=self._vectors,
+        )
+        eng.update_scenario(vectors)
+        if self._dirty:
+            eng.fleet_reset()
+        self._dirty = True
+        # Step to each distinct horizon once; lanes finishing there are
+        # read back while the host is already blocked at the step exit.
+        by_horizon: Dict[float, list] = {}
+        for lane, (qid, scen, horizon) in enumerate(wave):
+            by_horizon.setdefault(horizon, []).append((qid, lane, scen))
+        for horizon in sorted(by_horizon):
+            eng.step_until_time(horizon)
+            lanes = [lane for _, lane, _ in by_horizon[horizon]]
+            rows = self._lane_rows(lanes)
+            for qid, lane, scen in by_horizon[horizon]:
+                self._drain_lane(qid, lane, horizon, scen, rows)
+        self.waves_run += 1
+
+    def run(self) -> Dict[int, FleetResult]:
+        """Drain the queue: pack pending queries into C-lane waves and run
+        each on the resident engine. Returns {query id: FleetResult} for
+        everything drained (also accumulated in `self.results`)."""
+        while self._queue:
+            wave = [
+                self._queue.popleft()
+                for _ in range(min(self.n_lanes, len(self._queue)))
+            ]
+            self._run_wave(wave)
+        return self.results
+
+    def sweep(
+        self, scenarios: Sequence[Scenario], horizon: Optional[float] = None
+    ) -> List[FleetResult]:
+        """Convenience: submit + run a whole scenario list, results in
+        submission order."""
+        qids = [self.submit(s, horizon) for s in scenarios]
+        self.run()
+        return [self.results[q] for q in qids]
+
+    def close(self) -> None:
+        self.engine.close()

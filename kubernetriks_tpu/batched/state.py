@@ -342,6 +342,16 @@ class StepConstants(NamedTuple):
     # jax_enable_x64.
     trace_pod_bound: np.int32 = np.int32(1 << 30)
     resident_shift: np.int32 = np.int32(0)
+    # Scenario-vector fleet (batched/fleet.py): per-cluster pod-fault PRNG
+    # seeds, (C,) uint32, or None (the default — programs identical to the
+    # pre-fleet build; the chaos draw then keys on the jit-static
+    # FaultParams.seed plus the cluster index). When set, each lane's
+    # draws key on (seed[c], cluster=0, slot, attempt): a lane's fault
+    # stream is then a pure function of its SCENARIO, not its lane index,
+    # which is what makes lane placement permutation-invariant and lane c
+    # bit-identical to a standalone run with that seed. Traced data — a
+    # fleet can re-seed lanes between queries without recompiling.
+    fault_seed: Optional[jnp.ndarray] = None
 
 
 def make_step_constants(config) -> StepConstants:

@@ -1316,12 +1316,22 @@ def commit_scattered_tail(
         gslot = idx + jnp.where(
             in_plain, state.pod_base[:, None], jnp.int32(consts.resident_shift)
         )
-        cid = jnp.broadcast_to(
-            jnp.arange(C, dtype=jnp.int32)[:, None], (C, P)
-        )
+        if consts.fault_seed is not None:
+            # Scenario-vector fleet: per-lane seeds ride as traced (C,)
+            # data and the cluster key pins to 0, so a lane's draws are a
+            # pure function of its scenario seed — the same keying the
+            # scalar oracle uses (PodFaultOracle keys cluster 0), which
+            # makes lane placement permutation-invariant (fleet.py).
+            seed_key = jnp.asarray(consts.fault_seed, jnp.uint32)[:, None]
+            cid = jnp.zeros((C, P), jnp.uint32)
+        else:
+            seed_key = fault_params.seed
+            cid = jnp.broadcast_to(
+                jnp.arange(C, dtype=jnp.int32)[:, None], (C, P)
+            ).astype(jnp.uint32)
         u_fail, u_frac = chaos.pod_attempt_uniforms(
-            fault_params.seed,
-            cid.astype(jnp.uint32),
+            seed_key,
+            cid,
             gslot.astype(jnp.uint32),
             pods.restarts.astype(jnp.uint32),
             xp=jnp,

@@ -218,6 +218,34 @@ _FLAGS = [
         "Unset: ktpu_metrics under the working directory.",
     ),
     Flag(
+        "KTPU_SWEEP_PATH",
+        "str",
+        None,
+        "Output path stem for bench.py --sweep's JSON record (scenario "
+        "fleet vs per-engine baseline, wave timings, recompile/cross-talk "
+        "verdicts): the sweep writes <stem>.json (CI uploads it next to "
+        "the trace artifacts). Unset: ktpu_sweep under the working "
+        "directory.",
+    ),
+    Flag(
+        "KTPU_SWEEP_LANES",
+        "int",
+        None,
+        "Cluster-lane count C of bench.py --sweep's resident scenario "
+        "fleet (batched/fleet.py): N scenarios pack into ceil(N/C) waves "
+        "over ONE compiled engine. Unset: the sweep shape default (16; "
+        "4 on --smoke).",
+    ),
+    Flag(
+        "KTPU_SWEEP_BASELINE",
+        "int",
+        None,
+        "How many independent per-scenario engines the --sweep baseline "
+        "actually builds and times (the rest of the N-engine baseline is "
+        "extrapolated from their mean and disclosed as such in the JSON). "
+        "Unset: 3.",
+    ),
+    Flag(
         "KUBERNETRIKS_PALLAS",
         "tristate",
         None,

@@ -241,7 +241,12 @@ class Observatory:
         ys = np.stack([p[idx] for p in self._points], axis=0)  # (n, C)
         slopes = fit_slope(xs, ys)  # (C,) per sim-second
         now = ys[-1]
-        worst_eta = math.inf
+        # Worst cluster = smallest ETA, higher occupancy fraction as the
+        # tie-break: with several flat-trajectory lanes past warn_frac
+        # (eta = inf for all of them), the verdict must name the MOST
+        # saturated lane, not whichever lane index came first —
+        # heterogeneous fleets are judged per lane (DESIGN §11.3).
+        worst_key = None
         worst = None
         for c in range(now.shape[0]):
             cap = float(caps[c]) if c < len(caps) else 0.0
@@ -252,8 +257,9 @@ class Observatory:
             if frac >= self.warn_frac or (
                 frac >= self.min_frac and eta <= self.horizon_s
             ):
-                if eta < worst_eta or worst is None:
-                    worst_eta = eta
+                key = (eta, -frac)
+                if worst_key is None or key < worst_key:
+                    worst_key = key
                     worst = (c, frac, eta, cap)
         if worst is not None:
             c, frac, eta, cap = worst

@@ -187,10 +187,16 @@ def test_sliding_pod_window_matches_full(tmp_path):
     )
 
 
+@pytest.mark.slow
 def test_sliding_pod_window_with_autoscaler_and_failures(tmp_path):
     """Sliding window composed with the CA and machine failures: parked pods
     (which block the shift until terminal), scale-ups into reserved slots,
-    and reschedules off failed nodes must all match the full-resident run."""
+    and reschedules off failed nodes must all match the full-resident run.
+    Slow lane (tier-1 wall-clock budget): tier-1 keeps the sliding-window
+    alibaba parity (test_sliding_pod_window_matches_full) and the
+    window x CA x faults composition through test_superspan /
+    test_streaming / test_soak's fault engines; this is the alibaba-trace
+    variant of that composition."""
     config, machines, tasks, instances = _contended_ca_setup(
         tmp_path, n_machines=8, n_tasks=160, error_fraction=0.25, seed=31,
         max_nodes=32, node_name="win_ca_node",

@@ -56,10 +56,14 @@ def test_sharded_run_matches_unsharded(mesh):
     assert sharded.metrics_summary()["counters"]["pods_succeeded"] == 16 * len(pod_names)
 
 
+@pytest.mark.slow
 def test_profiling_hooks(tmp_path, caplog):
     """profile_dir captures a jax.profiler trace; log_throughput emits the
     per-chunk decisions/s line (TPU analog of the scalar events/s log,
-    reference: src/simulator.rs:363-368)."""
+    reference: src/simulator.rs:363-368). Slow lane (tier-1 wall-clock
+    budget): instrumentation plumbing, not a correctness gate — the
+    flight recorder's tier-1 suite (test_telemetry) covers the tracing
+    path the engine actually runs in steady state."""
     import logging
     import os
 

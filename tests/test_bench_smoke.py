@@ -16,6 +16,8 @@ import json
 import os
 import sys
 
+import pytest
+
 
 def _smoke_records(capsys, args):
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -27,6 +29,12 @@ def _smoke_records(capsys, args):
     ]
     records = [json.loads(ln) for ln in lines]
     for rec in records:
+        if rec.get("unit") == "scenarios/s":
+            # The scenario-fleet sweep line: its own unit and record
+            # shape (what-if queries per second + the full sweep block).
+            assert set(rec) == {"metric", "value", "unit", "sweep"}
+            assert rec["value"] > 0
+            continue
         assert set(rec) - {"spans", "telemetry"} == {
             "metric", "value", "unit", "vs_baseline",
         }
@@ -38,17 +46,19 @@ def _smoke_records(capsys, args):
     return records
 
 
-def test_bench_smoke_emits_six_parseable_lines(capsys, tmp_path, monkeypatch):
+def test_bench_smoke_emits_seven_parseable_lines(capsys, tmp_path, monkeypatch):
     # --trace rides along (the CI smoke job runs it this way): the
     # composed lines must carry the flight-recorder summary AND write a
     # Perfetto-loadable Chrome trace per traced line.
     monkeypatch.setenv("KTPU_TRACE_PATH", str(tmp_path / "ktpu_trace"))
     monkeypatch.setenv("KTPU_METRICS_PATH", str(tmp_path / "ktpu_metrics"))
+    monkeypatch.setenv("KTPU_SWEEP_PATH", str(tmp_path / "ktpu_sweep"))
     records = _smoke_records(capsys, ["--smoke", "--trace"])
-    assert len(records) == 6, records
+    assert len(records) == 7, records
     # Line order is part of the contract: continuity, composed, superspan
-    # machinery, streaming feeder, compiled profile, north-star (the LAST
-    # line is the headline the driver reads).
+    # machinery, streaming feeder, compiled profile, north-star, scenario
+    # fleet (the sweep runs LAST: its cold-process baseline clears the
+    # jit caches, which would cold-start anything after it).
     assert "composed" in records[1]["metric"]
     assert "superspan" in records[2]["metric"]
     assert "streaming" in records[3]["metric"]
@@ -57,6 +67,22 @@ def test_bench_smoke_emits_six_parseable_lines(capsys, tmp_path, monkeypatch):
     # falls back to the default pipeline, so its presence IS the gate.
     assert "best_fit profile" in records[4]["metric"]
     assert "north-star" in records[5]["metric"]
+    assert "scenario-vector fleet" in records[6]["metric"]
+    # The scenario-fleet line: its in-bench asserts (zero recompiles
+    # after warm-up, no lane cross-talk on the duplicate-scenario probes)
+    # already ran inside run_sweep — the record's sweep block discloses
+    # what was checked, and the JSON artifact landed for CI upload.
+    sweep = records[6]["sweep"]
+    assert sweep["scenarios"] == 8 and sweep["lanes"] == 4
+    assert sweep["waves"] == 2
+    assert sweep["recompiles_after_warmup"] == 0
+    assert sweep["crosstalk_probes"]
+    assert sweep["decisions_total"] > 0
+    # Smoke keeps the jit caches warm (no cold-process baseline; the
+    # speedup gate only arms on the full --sweep) and discloses it.
+    assert sweep["baseline"]["cold_process_model"] is False
+    sweep_doc = json.loads((tmp_path / "ktpu_sweep.json").read_text())
+    assert sweep_doc == sweep
     # Composed lines report the >= 5-span median with min/max spread; the
     # plain-shape lines keep the bare single-region value.
     for rec in records[1:4]:
@@ -155,17 +181,24 @@ def test_bench_smoke_emits_six_parseable_lines(capsys, tmp_path, monkeypatch):
         assert "ktpu_memory_bytes{" in prom_text
 
 
+@pytest.mark.slow
 def test_bench_smoke_faults_adds_chaos_line(capsys, tmp_path, monkeypatch):
-    """--faults appends a fault-enabled composed smoke line (the chaos
-    engine's dispatch/throughput tracker) after the standard six.
+    """--faults inserts a fault-enabled composed smoke line (the chaos
+    engine's dispatch/throughput tracker) before the final sweep line.
     --trace rides along so the traced composed lines are jit-cache hits
     from the previous test (same programs); the chaos line itself is
-    untraced either way."""
+    untraced either way. Slow lane (tier-1 wall-clock budget): the
+    seven-line test covers every line contract including the sweep; this
+    variant only adds the chaos line's presence on top of chaos-path
+    coverage tier-1 already carries (test_superspan / test_streaming /
+    test_soak fault engines, test_chaos)."""
     monkeypatch.setenv("KTPU_TRACE_PATH", str(tmp_path / "ktpu_trace"))
     monkeypatch.setenv("KTPU_METRICS_PATH", str(tmp_path / "ktpu_metrics"))
+    monkeypatch.setenv("KTPU_SWEEP_PATH", str(tmp_path / "ktpu_sweep"))
     records = _smoke_records(capsys, ["--smoke", "--faults", "--trace"])
-    assert len(records) == 7, records
+    assert len(records) == 8, records
     assert "chaos" in records[6]["metric"]
     assert records[6]["value"] > 0
     assert records[6]["spans"]["n"] >= 5
     assert "telemetry" not in records[6]
+    assert "scenario-vector fleet" in records[7]["metric"]

@@ -6,6 +6,7 @@ state). Covers plain pods, the HPA resident-ring re-positioning, and
 checkpoint/resume across a growth."""
 
 import numpy as np
+import pytest
 
 from kubernetriks_tpu.batched.engine import build_batched_from_traces
 from kubernetriks_tpu.test_util import default_test_simulation_config
@@ -79,9 +80,14 @@ def test_window_grows_and_matches_resident():
     )
 
 
+@pytest.mark.slow
 def test_window_growth_repositions_hpa_ring():
     """Growth moves the resident pod-group ring right; HPA replica
-    accounting must survive it (same counters as the resident run)."""
+    accounting must survive it (same counters as the resident run).
+    Slow lane (tier-1 wall-clock budget): tier-1 keeps plain growth
+    parity (test_window_grows_and_matches_resident) and growth x
+    checkpoint (test_checkpoint_resume_across_growth); the HPA-ring
+    reposition composition runs here in the slow lane."""
     group = GenericWorkloadTrace.from_yaml(
         """
 events:
@@ -163,10 +169,17 @@ def test_host_slide_fallback_matches_resident():
     assert sim.metrics_summary()["counters"] == ref.metrics_summary()["counters"]
 
 
+@pytest.mark.slow
 def test_window_growth_under_mesh():
     """Growth on a C-sharded mesh: the inserted slots and the moved
     autoscale statics (HPA ring) stay shard-local on the 'clusters' axis,
-    and the grown run equals the unsharded resident run."""
+    and the grown run equals the unsharded resident run. Slow lane
+    (tier-1 wall-clock budget): tier-1 keeps growth coverage
+    (test_window_grows_and_matches_resident, the HPA-ring reposition and
+    checkpoint-resume growth cases) AND mesh parity
+    (test_batched_sharding.test_sharded_run_matches_unsharded,
+    test_flagship_compose.test_pallas_shard_map_matches_scan_on_mesh);
+    this is the growthxmesh composition double-check."""
     import jax
     from jax.sharding import Mesh
 

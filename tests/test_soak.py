@@ -192,16 +192,55 @@ def _assert_soak_gates(sim, caught, samples, first_fire_starved):
         )
 
 
-def test_soak_composed_chaos_streaming_watchdog():
+def test_soak_composed_chaos_streaming_watchdog_two_lane_heterogeneous():
     """The tier-1 soak: ~45 windows of the composed fault scenario with
     an engineered near-exhaustion CA reserve. Occupancy exact, watchdog
-    before the bound, watermarks flat."""
-    sim = _build_soak()
+    before the bound, watermarks flat.
+
+    Scenario-vector fleet follow-through (batched/fleet.py) rides the
+    SAME engine: the two lanes are HETEROGENEOUS — lane 0 runs the
+    near-exhaustion chaos scenario, lane 1 runs with the HPA parked and
+    CA quota zero (the plain Poisson load alone could otherwise open CA
+    nodes), so the capacity observatory must judge each lane against ITS
+    OWN occupancy/capacity row: every reserve verdict names cluster 0,
+    never the idle lane, while the per-lane gauges stay integer-exact
+    (the oracle check inside _run_soak_and_check is element-wise per
+    lane) and the idle lane's CA cursor stays zero. The homogeneous
+    two-saturating-lane shape keeps running in the slow-lane variant."""
+    from kubernetriks_tpu.batched.fleet import Scenario, scenario_vectors
+    from kubernetriks_tpu.test_util import default_test_simulation_config
+    from test_window_donation_dispatch import COMPOSED_CONFIG_SUFFIX
+
+    # The scenario vectors' base values must come from the SAME config
+    # the engine builds with (the composed + fault scenario).
+    soak_config = default_test_simulation_config(
+        COMPOSED_CONFIG_SUFFIX + FAULT_SUFFIX
+    )
+    sim = _build_soak(
+        scenario=dict(
+            scenario_vectors(
+                soak_config,
+                2,
+                [Scenario(), Scenario(hpa_enabled=False, ca_max_node_count=0)],
+            )
+        )
+    )
     try:
         caught, samples, first_fire_starved = _run_soak_and_check(
             sim, ends=np.arange(50.0, 451.0, 50.0)
         )
         _assert_soak_gates(sim, caught, samples, first_fire_starved)
+        # Heterogeneous-lane gates: verdicts target the saturating lane.
+        events = [
+            e
+            for e in sim.observatory.events
+            if e["kind"] in ("ca_reserve_used", "hpa_reserve_used")
+        ]
+        assert events and all(e["cluster"] == 0 for e in events), events
+        # The idle lane really was idle: no CA slot ever consumed there.
+        ca_cursor = np.asarray(sim.state.auto.ca_cursor)
+        assert ca_cursor[1].sum() == 0, ca_cursor
+        assert ca_cursor[0].sum() > 0, ca_cursor
     finally:
         sim.close()
 
@@ -267,6 +306,28 @@ def test_watchdog_fires_on_rising_reserve_trajectory():
     # occupancy 13/20 rising 1 slot / 10 sim-s -> 70 s to exhaustion.
     assert ev["eta_s"] == pytest.approx(70.0, abs=1.0)
     assert obs.report()["watchdog"]["fired"]["ca_reserve_used"] == 5
+
+
+def test_watchdog_flat_tie_names_most_saturated_cluster():
+    """Two lanes both past warn_frac with FLAT trajectories (eta = inf for
+    both): the verdict must name the more saturated lane, not the lower
+    lane index — per-lane judgment for heterogeneous fleets."""
+    obs = Observatory(
+        interval=10.0, capacities={"ca_reserve": [20, 20]}, horizon_s=1e6
+    )
+    R = 6
+    buf = np.full((2, R, len(RING_COLUMNS)), -1, np.int32)
+    for slot in range(R):
+        buf[:, slot, COL["window"]] = slot
+        buf[0, slot, COL["ca_reserve_used"]] = 17  # flat, 85%
+        buf[1, slot, COL["ca_reserve_used"]] = 19  # flat, 95%
+        buf[:, slot, COL["hpa_reserve_used"]] = 0
+        buf[:, slot, COL["pod_headroom"]] = UNBOUNDED_SENTINEL
+    obs.ingest(buf)
+    with pytest.warns(SaturationWarning, match="cluster 1"):
+        rec = obs.observe()
+    ev = [e for e in rec["watchdog"] if e["kind"] == "ca_reserve_used"][0]
+    assert ev["cluster"] == 1 and ev["used"] == 19
 
 
 def test_watchdog_quiet_on_flat_and_low_occupancy():
