@@ -40,9 +40,19 @@ Remaining bounded deviations:
 - Scale-up considers at most K_up cache pods and scale-down at most K_sd pods
   per candidate node per cycle; overflow is deferred to the next cycle
   (scale-up) or conservatively skipped (scale-down).
-- Scaled-up slots are never reused: each group reserves
-  slots ~ multiplier x max_count, mirroring the reference's pre-sized
-  component pool (src/simulator.rs:212-230) without reclaim.
+- CA slot reserve: each group reserves slots ~ multiplier x max_count,
+  mirroring the reference's pre-sized component pool
+  (src/simulator.rs:212-230). Under reclaim (KTPU_RECLAIM, the r14
+  endurance work) fully-retired slots are RETURNED to the reserve by a
+  periodic in-trace compaction (ca_reclaim_pass) the way the reference's
+  node_component_pool reuses components (node_component_pool.rs:60-77),
+  so `ca_cursor` tracks LIVE reserve occupancy instead of cumulative
+  allocations and sustained churn never exhausts the reserve; names stay
+  scalar-exact because each allocation carries the scalar's monotone
+  total_allocated index (auto.ca_alloc / ca_total — "{group}_{idx+1}")
+  and every name-ordered walk derives its order from that index
+  (ca_name_order). Without reclaim the cursor is monotone and the loud
+  bound (engine.check_autoscaler_bounds) remains the only backstop.
 - CA-cache name ORDER for HPA replicas whose slot has been ring-reused uses
   the slot's first occupant's static name rank (pod_name_rank); HPA
   scale-down victim IDENTITY is exact regardless (pods.hpa_idx stores the
@@ -156,6 +166,25 @@ class AutoscaleStatics(NamedTuple):
     pod_name_rank: jnp.ndarray  # (C, P) int32 lexicographic name rank; BIG = n/a
     node_name_rank: jnp.ndarray  # (C, N) int32 node-name rank (trace + CA slots)
     ca_sd_order: jnp.ndarray  # (C, S) CA slot indices in name order
+    # --- HPA metrics-collection cadence (staleness fix, r14) -----------
+    # The scalar HPA reads whatever the metrics collector's fixed 60 s
+    # collection cycle last pulled (metrics/collector.py
+    # COLLECTION_INTERVAL); this pair is that cadence as device time, so
+    # hpa_pass can latch collection-window snapshots (AutoscaleState
+    # col_*) instead of sampling the load curve at its own tick.
+    col_interval: Optional[TPair] = None  # (C,) the 60 s collection cadence
+    # --- reclaim name-order tables (r14; None = reclaim unsupported) ---
+    # The scalar names every allocation "{group}_{total_allocated}"; with
+    # slot reuse the name no longer equals the slot, so name-ordered
+    # walks (scale-down candidates, re-placement first-fit, same-window
+    # reschedule batches) derive their order from the occupant's
+    # allocation index. Cross-CLASS order (trace node vs group name
+    # family, family vs family) is static — verified non-interleaving at
+    # build (engine._reclaim_class_tables) — and only the within-group
+    # decimal-suffix order is dynamic.
+    ca_slot_class: Optional[jnp.ndarray] = None  # (C, S) int32 class rank of slot's group
+    ca_class_start: Optional[jnp.ndarray] = None  # (C, Gn) int32 first class-sorted slot pos
+    node_class_key: Optional[jnp.ndarray] = None  # (C, N) int32 class_rank * (S + 1)
 
 
 def statics_with_pod_rank(
@@ -173,7 +202,13 @@ def statics_with_pod_rank(
 
 
 class AutoscaleState(NamedTuple):
-    """Dynamic autoscaler state (lives inside ClusterBatchState.auto)."""
+    """Dynamic autoscaler state (lives inside ClusterBatchState.auto).
+
+    The Optional leaves are structural statics in the `auto`/`telemetry`
+    tradition: None compiles programs without the corresponding machinery
+    (reclaim off / collection latch off), arrays arm it. ca_cursor under
+    reclaim tracks LIVE reserve occupancy (compaction pulls it back);
+    without reclaim it is the classic monotone next-slot cursor."""
 
     hpa_head: jnp.ndarray  # (C, Gp) int32 first live created offset
     hpa_tail: jnp.ndarray  # (C, Gp) int32 next creation offset (== total_created)
@@ -181,11 +216,45 @@ class AutoscaleState(NamedTuple):
     ca_cursor: jnp.ndarray  # (C, Gn) int32 next reserved slot offset
     hpa_next: TPair  # (C,) next HPA tick
     ca_next: TPair  # (C,) next CA tick
+    # --- CA slot reclaim (r14; None = reclaim off) ---------------------
+    ca_alloc: Optional[jnp.ndarray] = None  # (C, S) int32 occupant's allocation
+    # index (the scalar's total_allocated - 1 at open time); -1 = free slot.
+    # INVARIANT: occupied slots are exactly the per-group prefix
+    # [ng_ca_start, ng_ca_start + ca_cursor) — allocation appends at the
+    # cursor and compaction re-packs keepers stably, so slot order among
+    # live CA nodes always equals allocation order (which keeps the
+    # scheduler's slot-order tie-break identical to the no-reclaim path).
+    ca_total: Optional[jnp.ndarray] = None  # (C, Gn) int32 monotone allocation
+    # counter (the scalar's group.total_allocated; names are "{g}_{total}").
+    ca_reclaimed: Optional[jnp.ndarray] = None  # (C,) int32 slots returned to
+    # the reserve by compaction (the "reclaim actually fired" observable).
+    # --- HPA collection latch (r14 staleness fix; None = legacy inline) ---
+    col_next: Optional[TPair] = None  # (C,) next 60 s collection tick
+    col_run: Optional[jnp.ndarray] = None  # (C, Gp) int32 running count at the
+    # last collection (0 = group absent from the sample, like the scalar's
+    # metrics dict missing the group).
+    col_util_cpu: Optional[jnp.ndarray] = None  # (C, Gp) f32 latched utilization
+    col_util_ram: Optional[jnp.ndarray] = None  # (C, Gp) f32
 
 
-def init_autoscale_state(statics: AutoscaleStatics) -> AutoscaleState:
+def init_autoscale_state(
+    statics: AutoscaleStatics,
+    reclaim: bool = False,
+    collect: bool = False,
+) -> AutoscaleState:
+    """reclaim arms the CA slot-reclaim leaves (requires the statics'
+    name-order tables); collect arms the HPA collection latch (the engine
+    sets it whenever real pod groups exist)."""
     C, Gp = statics.pg_slot_start.shape
     Gn = statics.ng_ca_start.shape[1]
+    S = statics.ca_slots.shape[1]
+    if reclaim and statics.ca_slot_class is None:
+        raise ValueError(
+            "init_autoscale_state(reclaim=True) needs the statics' reclaim "
+            "name-order tables (ca_slot_class/ca_class_start/node_class_key) "
+            "— built by engine.build_autoscale_statics when the name "
+            "classes verify non-interleaving"
+        )
     return AutoscaleState(
         hpa_head=jnp.zeros((C, Gp), jnp.int32),
         # The trace's initial pods count as created (the api-server expansion
@@ -195,6 +264,13 @@ def init_autoscale_state(statics: AutoscaleStatics) -> AutoscaleState:
         ca_cursor=jnp.zeros((C, Gn), jnp.int32),
         hpa_next=t_zeros((C,)),
         ca_next=t_zeros((C,)),
+        ca_alloc=jnp.full((C, S), -1, jnp.int32) if reclaim else None,
+        ca_total=jnp.zeros((C, Gn), jnp.int32) if reclaim else None,
+        ca_reclaimed=jnp.zeros((C,), jnp.int32) if reclaim else None,
+        col_next=t_zeros((C,)) if collect else None,
+        col_run=jnp.zeros((C, Gp), jnp.int32) if collect else None,
+        col_util_cpu=jnp.zeros((C, Gp), jnp.float32) if collect else None,
+        col_util_ram=jnp.zeros((C, Gp), jnp.float32) if collect else None,
     )
 
 
@@ -215,6 +291,77 @@ def _broadcast_pair(p: TPair, shape) -> TPair:
         win=jnp.broadcast_to(p.win[..., None], shape),
         off=jnp.broadcast_to(p.off[..., None], shape),
     )
+
+
+def decimal_string_key(idx: jnp.ndarray) -> jnp.ndarray:
+    """int32 key whose order equals the LEXICOGRAPHIC order of str(idx)
+    for 0 <= idx < 10^8 ("g_10" < "g_2"): left-align the value to 8
+    digits, tie-break shorter-first. Max key < 16 * 10^8 < 2^31. THE
+    decimal-suffix ordering primitive shared by the HPA victim selection
+    and the CA reclaim name orders — one implementation so the suffix
+    rule can't drift."""
+    idx = jnp.maximum(idx, 0)
+    digits = (
+        1
+        + (idx >= 10).astype(jnp.int32)
+        + (idx >= 100).astype(jnp.int32)
+        + (idx >= 1_000).astype(jnp.int32)
+        + (idx >= 10_000).astype(jnp.int32)
+        + (idx >= 100_000).astype(jnp.int32)
+        + (idx >= 1_000_000).astype(jnp.int32)
+        + (idx >= 10_000_000).astype(jnp.int32)
+    )
+    pow10 = jnp.asarray(
+        [0, 10_000_000, 1_000_000, 100_000, 10_000, 1_000, 100, 10, 1],
+        jnp.int32,
+    )
+    return idx * pow10[digits] * jnp.int32(16) + digits
+
+
+def ca_name_order(
+    auto: AutoscaleState, st: AutoscaleStatics
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Dynamic name orderings of the LIVE CA fleet under slot reclaim:
+    (sd_order (C, S) — CA slot indices in current node-name order, the
+    drop-in for the static st.ca_sd_order — and node_key (C, N) — an
+    int32 key whose order over alive nodes equals node-name order, the
+    drop-in for st.node_name_rank in re-placement first-fit and
+    same-window reschedule ranking).
+
+    An occupant's name is "{group}_{alloc+1}" (the scalar's
+    total_allocated naming). Cross-class order (trace singleton vs group
+    family, family vs family) is static — the build verified the classes
+    non-interleaving — so the key decomposes as class_rank * (S + 1) +
+    within-group rank, where the within-group rank comes from ONE stable
+    (C, S) 2-key sort by (class, decimal-suffix key). Free slots sort
+    after their group's occupants (suffix key BIG) and keep the class
+    base key — they are dead, so every consumer masks them by liveness
+    first. When no slot has ever been reused (alloc == slot offset) both
+    orders coincide with the static tables exactly."""
+    C, S = auto.ca_alloc.shape
+    Gn = st.ca_class_start.shape[1]
+    rows = jnp.arange(C, dtype=jnp.int32)[:, None]
+    iota_s = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (C, S))
+    occupied = auto.ca_alloc >= 0
+    suffix = jnp.where(
+        occupied, decimal_string_key(auto.ca_alloc + 1), _BIG_I32
+    )
+    _, _, sd_order = jax.lax.sort(
+        (st.ca_slot_class, suffix, iota_s), dimension=1, num_keys=2,
+        is_stable=True,
+    )
+    # Sorted position of each slot -> within-group rank (each group's
+    # slots are contiguous in class order; ca_class_start is the static
+    # first position of the group's segment).
+    pos = jnp.zeros((C, S), jnp.int32).at[rows, sd_order].set(iota_s)
+    gidc = jnp.clip(st.ca_slot_group, 0, Gn - 1)
+    within = jnp.where(
+        occupied, pos - st.ca_class_start[rows, gidc], 0
+    )
+    N = st.node_class_key.shape[1]
+    tgt = jnp.where(occupied & (st.ca_slots >= 0), st.ca_slots, N)
+    node_key = st.node_class_key.at[rows, tgt].add(within, mode="drop")
+    return sd_order, node_key
 
 
 def hpa_pass(
@@ -243,16 +390,44 @@ def hpa_pass(
     sub = (
         jax.tree.map(lambda a: a[:, lo:hi], pods) if sliced else pods
     )
-    due_any = t_le(
-        auto.hpa_next, TPair(win=W, off=jnp.zeros_like(auto.hpa_next.off))
-    ).any()
+    T0 = TPair(win=W, off=jnp.zeros_like(auto.hpa_next.off))
+    due_cycle = t_le(auto.hpa_next, T0).any()
+    due_any = due_cycle
+    if auto.col_next is not None:
+        # The 60 s metrics collection (the latch) is part of the same
+        # cond: a collection-only window updates the col_* leaves and
+        # leaves everything else untouched (delta = 0 on every lane).
+        due_any = due_any | t_le(auto.col_next, T0).any()
 
     zeros = jnp.zeros((C,), jnp.int32)
+    if auto.col_next is None:
+        body = lambda: _hpa_pass_body(
+            sub, state.queue_seq_counter, auto, st, W, consts, lo
+        )
+    else:
+        # Collection-only windows (scan_interval > 60: the 60 s tick fires
+        # between HPA cycles) latch the sample WITHOUT paying the cycle
+        # body — desired-replica math, the (C, P) victim sort and the
+        # activation scatters all have delta 0 when no lane's cycle is
+        # due, so the light branch is trajectory-exact by construction
+        # (same sample expressions, same col_* writes).
+        body = lambda: jax.lax.cond(
+            due_cycle,
+            lambda: _hpa_pass_body(
+                sub, state.queue_seq_counter, auto, st, W, consts, lo
+            ),
+            lambda: (
+                sub,
+                _hpa_collect_only(sub, auto, st, W, consts, lo),
+                zeros,
+                zeros,
+                zeros,
+                zeros,
+            ),
+        )
     sub2, auto2, up_s, down_s, clamp_s, n_up = jax.lax.cond(
         due_any,
-        lambda: _hpa_pass_body(
-            sub, state.queue_seq_counter, auto, st, W, consts, lo
-        ),
+        body,
         lambda: (sub, auto, zeros, zeros, zeros, zeros),
     )
     if sliced:
@@ -273,6 +448,106 @@ def hpa_pass(
         queue_seq_counter=state.queue_seq_counter + n_up,
     )
     return state, auto2
+
+
+def _hpa_metrics_sample(pods, st: AutoscaleStatics, W, consts, lo):
+    """The metrics collector's per-group sample at window W over the pod
+    slice [lo, lo+P): (run_per_group (C,Gp) int32, util_cpu, util_ram
+    (C,Gp) float32). ONE expression source for the cycle body and the
+    collection-only latch branch, so the latched values can never depend
+    on which branch took the sample."""
+    C, P = pods.phase.shape
+    Gp = st.pg_slot_start.shape[1]
+    rows = jnp.arange(C, dtype=jnp.int32)[:, None]
+    # Group membership and running counts (running = bound AND started by T,
+    # mirroring node_component.running_pods at collection time).
+    gid = st.pod_group_id[:, lo : lo + P]
+    gid_c = jnp.where(gid >= 0, gid, Gp)
+    started = t_le(
+        pods.start_time,
+        TPair(
+            win=jnp.broadcast_to(W[:, None], (C, P)),
+            off=jnp.zeros((C, P), jnp.float32),
+        ),
+    )
+    running = (pods.phase == PHASE_RUNNING) & started
+    run_per_group = (
+        jnp.zeros((C, Gp + 1), jnp.int32)
+        .at[rows, gid_c]
+        .add(running.astype(jnp.int32))[:, :Gp]
+    )
+    runf = jnp.maximum(run_per_group, 1).astype(jnp.float32)
+
+    # Elapsed time since group creation, float64 (curves cycle over arbitrary
+    # periods; f32 elapsed at large absolute t would blur the curve position).
+    T_s = W.astype(jnp.float64) * jnp.float64(consts.scheduling_interval)
+    elapsed = T_s[:, None] - st.pg_creation_s
+    cpu_load = _curve_load(st.pg_cpu_dur, st.pg_cpu_load, st.pg_cpu_total, elapsed)
+    ram_load = _curve_load(st.pg_ram_dur, st.pg_ram_load, st.pg_ram_total, elapsed)
+    util_cpu = jnp.where(
+        st.pg_cpu_total > 0,
+        jnp.where(st.pg_cpu_const, cpu_load, jnp.minimum(1.0, cpu_load / runf)),
+        0.0,
+    )
+    util_ram = jnp.where(
+        st.pg_ram_total > 0,
+        jnp.where(st.pg_ram_const, ram_load, jnp.minimum(1.0, ram_load / runf)),
+        0.0,
+    )
+    return run_per_group, util_cpu, util_ram
+
+
+def _latch_collection(
+    auto: AutoscaleState, st: AutoscaleStatics, W, interval,
+    run_per_group, util_cpu, util_ram,
+):
+    """The collection-window latch writes — col_next advance + sample
+    snapshot, gated on the collection being due — as (col_due, (col_next',
+    col_run', col_util_cpu', col_util_ram')). ONE implementation consumed
+    by both the cycle body and the collection-only branch, so the latched
+    values cannot depend on which branch took the sample."""
+    col_due = t_le(
+        auto.col_next, TPair(win=W, off=jnp.zeros(W.shape, jnp.float32))
+    )
+    return col_due, (
+        t_where(
+            col_due,
+            t_add(auto.col_next, st.col_interval, interval),
+            auto.col_next,
+        ),
+        jnp.where(col_due[:, None], run_per_group, auto.col_run),
+        jnp.where(col_due[:, None], util_cpu, auto.col_util_cpu),
+        jnp.where(col_due[:, None], util_ram, auto.col_util_ram),
+    )
+
+
+def _hpa_collect_only(
+    pods,
+    auto: AutoscaleState,
+    st: AutoscaleStatics,
+    W: jnp.ndarray,
+    consts: StepConstants,
+    lo: int = 0,
+) -> AutoscaleState:
+    """The 60 s collection tick WITHOUT a due HPA cycle on any lane: latch
+    the sample into the col_* leaves and advance col_next — exactly the
+    col_state writes _hpa_pass_body would make (shared _hpa_metrics_sample
+    + _latch_collection), skipping the cycle machinery (desired-replica
+    math, the (C, P) victim sort, activation scatters) that is all
+    delta-0 when no cycle is due."""
+    interval = jnp.float32(consts.scheduling_interval)
+    run_per_group, util_cpu, util_ram = _hpa_metrics_sample(
+        pods, st, W, consts, lo
+    )
+    _, (col_next2, col_run2, col_ucpu2, col_uram2) = _latch_collection(
+        auto, st, W, interval, run_per_group, util_cpu, util_ram
+    )
+    return auto._replace(
+        col_next=col_next2,
+        col_run=col_run2,
+        col_util_cpu=col_ucpu2,
+        col_util_ram=col_uram2,
+    )
 
 
 def _hpa_pass_body(
@@ -301,42 +576,51 @@ def _hpa_pass_body(
     due = t_le(auto.hpa_next, T)
     active = due[:, None] & t_le(st.pg_active_from, Tg)
 
-    # Group membership and running counts (running = bound AND started by T,
-    # mirroring node_component.running_pods at collection time).
     gid = st.pod_group_id[:, lo : lo + P]
     gid_c = jnp.where(gid >= 0, gid, Gp)
-    started = t_le(
-        pods.start_time,
-        TPair(
-            win=jnp.broadcast_to(W[:, None], (C, P)),
-            off=jnp.zeros((C, P), jnp.float32),
-        ),
-    )
-    running = (pods.phase == PHASE_RUNNING) & started
-    run_per_group = (
-        jnp.zeros((C, Gp + 1), jnp.int32)
-        .at[rows, gid_c]
-        .add(running.astype(jnp.int32))[:, :Gp]
+    run_per_group, util_cpu, util_ram = _hpa_metrics_sample(
+        pods, st, W, consts, lo
     )
     present = run_per_group > 0  # group absent from metrics when nothing runs
-    runf = jnp.maximum(run_per_group, 1).astype(jnp.float32)
 
-    # Elapsed time since group creation, float64 (curves cycle over arbitrary
-    # periods; f32 elapsed at large absolute t would blur the curve position).
-    T_s = W.astype(jnp.float64) * jnp.float64(consts.scheduling_interval)
-    elapsed = T_s[:, None] - st.pg_creation_s
-    cpu_load = _curve_load(st.pg_cpu_dur, st.pg_cpu_load, st.pg_cpu_total, elapsed)
-    ram_load = _curve_load(st.pg_ram_dur, st.pg_ram_load, st.pg_ram_total, elapsed)
-    util_cpu = jnp.where(
-        st.pg_cpu_total > 0,
-        jnp.where(st.pg_cpu_const, cpu_load, jnp.minimum(1.0, cpu_load / runf)),
-        0.0,
-    )
-    util_ram = jnp.where(
-        st.pg_ram_total > 0,
-        jnp.where(st.pg_ram_const, ram_load, jnp.minimum(1.0, ram_load / runf)),
-        0.0,
-    )
+    # HPA metrics-staleness fix (r14): the scalar HPA reads the metrics
+    # collector's LAST 60 s collection sample, not a fresh evaluation at
+    # its own tick (metrics/collector.py COLLECTION_INTERVAL; the
+    # collection event precedes a same-instant HPA cycle, so a cycle at a
+    # collection instant sees the fresh sample). With the latch armed
+    # (col_* leaves present), a due collection snapshots (running count,
+    # utilization) at this window, and the cycle consumes the latched
+    # sample — the NEW one only when the collection time does not exceed
+    # the cycle's fire time (both due in one window with the collection
+    # later: the cycle still reads the previous sample, like the scalar).
+    # At the default scan_interval 60 both cadences tick at the same
+    # windows and the latched values equal the inline evaluation — the
+    # pre-latch trajectories bit-exactly. Sub-window collection cadences
+    # (interval > 60 s) degrade to one collection per window, mirroring
+    # the documented CA cadence bound.
+    col_state = None
+    if auto.col_next is not None:
+        col_due, col_state = _latch_collection(
+            auto, st, W, interval, run_per_group, util_cpu, util_ram
+        )
+        # A cycle and a collection at the SAME instant order by the event
+        # kernel's FIFO ids — i.e. by EMISSION time: the collection was
+        # emitted 60 s before, the cycle scan_interval before, so the
+        # collection fires first iff scan_interval <= 60 (at exactly 60
+        # the tie breaks to the collection: its handler ran first at the
+        # shared emission instant, all the way back to t = 0 where the
+        # collector starts before the HPA).
+        same_t = t_le(auto.col_next, auto.hpa_next) & t_le(
+            auto.hpa_next, auto.col_next
+        )
+        col_first = t_le(st.hpa_interval, st.col_interval)
+        use_new = col_due & (
+            t_lt(auto.col_next, auto.hpa_next) | (same_t & col_first)
+        )
+        run_eff = jnp.where(use_new[:, None], run_per_group, auto.col_run)
+        util_cpu = jnp.where(use_new[:, None], util_cpu, auto.col_util_cpu)
+        util_ram = jnp.where(use_new[:, None], util_ram, auto.col_util_ram)
+        present = run_eff > 0
 
     current = auto.hpa_tail - auto.hpa_head
 
@@ -444,24 +728,9 @@ def _hpa_pass_body(
         & is_inf(pods.removal_time)
         & ~activate
     )
-    occ_idx = jnp.maximum(pods.hpa_idx, 0)
-    # Decimal-string order key for idx < 10^8: left-align to 8 digits,
-    # tie-break shorter-first. Fits int32: key < 10^8 * 16.
-    digits = (
-        1
-        + (occ_idx >= 10).astype(jnp.int32)
-        + (occ_idx >= 100).astype(jnp.int32)
-        + (occ_idx >= 1_000).astype(jnp.int32)
-        + (occ_idx >= 10_000).astype(jnp.int32)
-        + (occ_idx >= 100_000).astype(jnp.int32)
-        + (occ_idx >= 1_000_000).astype(jnp.int32)
-        + (occ_idx >= 10_000_000).astype(jnp.int32)
-    )
-    pow10 = jnp.asarray(
-        [0, 10_000_000, 1_000_000, 100_000, 10_000, 1_000, 100, 10, 1],
-        jnp.int32,
-    )
-    name_key = occ_idx * pow10[digits] * jnp.int32(16) + digits
+    # Decimal-string order of "{group}_{idx}" names (shared primitive;
+    # loud i32 bound at idx >= 10^8 via engine.check_autoscaler_bounds).
+    name_key = decimal_string_key(pods.hpa_idx)
     big = jnp.int32(1 << 30)
     sort_gid = jnp.where(live, gid_c, Gp)
     sort_key = jnp.where(live, name_key, big)
@@ -496,6 +765,14 @@ def _hpa_pass_body(
             due, t_add(auto.hpa_next, st.hpa_interval, interval), auto.hpa_next
         ),
     )
+    if col_state is not None:
+        col_next2, col_run2, col_ucpu2, col_uram2 = col_state
+        auto = auto._replace(
+            col_next=col_next2,
+            col_run=col_run2,
+            col_util_cpu=col_ucpu2,
+            col_util_ram=col_uram2,
+        )
     pods = pods._replace(
         phase=phase,
         queue_ts=queue_ts,
@@ -738,6 +1015,8 @@ def _ca_scale_down(
     pallas_mesh=None,
     pallas_axis: str = "clusters",
     descatter: bool = True,
+    sd_order=None,
+    node_rank=None,
 ):
     """Threshold + simulated-re-placement scale-down
     (reference: kube_cluster_autoscaler.rs:242-290). Returns
@@ -772,6 +1051,13 @@ def _ca_scale_down(
     rows1 = jnp.arange(C, dtype=jnp.int32)
     rows = rows1[:, None]
     col_n = jnp.arange(N, dtype=jnp.int32)[None, :]
+    # Name orderings: the static build tables, or — under slot reclaim —
+    # the dynamic orders derived from the occupants' allocation indices
+    # (ca_name_order; bit-identical orders while no slot was ever reused).
+    if sd_order is None:
+        sd_order = st.ca_sd_order
+    if node_rank is None:
+        node_rank = st.node_name_rank
 
     snap_p = _broadcast_pair(snap, (C, P))
     # (C,) per-lane finish-visibility delay as a (C, 1) column against the
@@ -902,7 +1188,7 @@ def _ca_scale_down(
     # Candidate walk order and liveness, shared by both paths: CA slots in
     # node-name order, alive where allocated (the kernel derives its walk
     # bound from cand_alive; the XLA path bounds its while_loop the same way).
-    slot_perm = jnp.take_along_axis(st.ca_slots, st.ca_sd_order, axis=1)
+    slot_perm = jnp.take_along_axis(st.ca_slots, sd_order, axis=1)
     slotc_perm = jnp.clip(slot_perm, 0, N - 1)
     cand_alive = (slot_perm >= 0) & nodes.alive[rows, slotc_perm]
 
@@ -955,7 +1241,7 @@ def _ca_scale_down(
             nodes.cap_ram,
             alloc_cpu_v,
             alloc_ram_v,
-            st.node_name_rank,
+            node_rank,
             slot_perm,
             cand_alive,
             cnt_perm,
@@ -966,7 +1252,7 @@ def _ca_scale_down(
         # Back from name-order positions to CA-slot indices (ca_sd_order is
         # a permutation, so .set() touches each slot exactly once).
         removed = (
-            jnp.zeros((C, S), bool).at[rows, st.ca_sd_order].set(removed_perm)
+            jnp.zeros((C, S), bool).at[rows, sd_order].set(removed_perm)
         )
         return _per_group(removed, st, rows, Gn)
 
@@ -976,7 +1262,7 @@ def _ca_scale_down(
         # name-sorted) and earlier candidates' committed re-placements are
         # visible to later ones — iterate CA slots through the name-order
         # permutation, (C,) per cluster.
-        sidx = jax.lax.dynamic_index_in_dim(st.ca_sd_order, s, 1, keepdims=False)
+        sidx = jax.lax.dynamic_index_in_dim(sd_order, s, 1, keepdims=False)
         # (C,) global node slot of this candidate.
         slot = st.ca_slots[rows1, sidx]
         slot_ok = (slot >= 0) & branch
@@ -1032,7 +1318,7 @@ def _ca_scale_down(
             # First-fit in NODE-NAME order (the scalar iterates the
             # name-sorted info.nodes list; _node_fits_pod first match).
             tgt = jax.lax.argmin(
-                jnp.where(fit, st.node_name_rank, _BIG_I32), 1, jnp.int32
+                jnp.where(fit, node_rank, _BIG_I32), 1, jnp.int32
             )
             place = pv & any_fit
             vcpu = vcpu.at[rows1, jnp.where(place, tgt, N)].add(-rcpu, mode="drop")
@@ -1055,7 +1341,7 @@ def _ca_scale_down(
     def loop_body(carry):
         s, valloc_cpu, valloc_ram, removed = carry
         valloc_cpu, valloc_ram, success = outer((valloc_cpu, valloc_ram), s)
-        sidx = jax.lax.dynamic_index_in_dim(st.ca_sd_order, s, 1, keepdims=False)
+        sidx = jax.lax.dynamic_index_in_dim(sd_order, s, 1, keepdims=False)
         removed = removed.at[rows1, sidx].max(success)
         return (s + jnp.int32(1), valloc_cpu, valloc_ram, removed)
 
@@ -1105,6 +1391,7 @@ def ca_pass(
     pallas_axis: str = "clusters",
     nodes_lane_major: bool = False,
     descatter: bool = True,
+    reclaim: bool = False,
 ) -> Tuple[ClusterBatchState, AutoscaleState]:
     """One masked cluster-autoscaler cycle (scalar equivalent:
     cluster_autoscaler.py cycle; AUTO info policy: scale up iff the
@@ -1197,11 +1484,15 @@ def ca_pass(
             jnp.zeros((C,), jnp.int32),
         ),
     )
-    removed, removed_per_group = jax.lax.cond(
-        # ca_count (live CA nodes) rather than ca_cursor (ever allocated):
-        # once everything scaled back down there is nothing to remove.
-        down_branch.any() & (auto.ca_count.sum() > 0),
-        lambda: _ca_scale_down(
+    def _down_branch():
+        # Under reclaim the candidate-walk and re-placement orders are
+        # derived from the live occupants' allocation indices (the static
+        # tables describe slot-index names, stale once a slot is reused);
+        # computed inside the cond so quiet windows never pay the sort.
+        sd_order = node_rank = None
+        if reclaim and auto.ca_alloc is not None:
+            sd_order, node_rank = ca_name_order(auto, st)
+        return _ca_scale_down(
             state_row, auto, st, down_branch, K_sd,
             phase_v, alloc_cpu_v, alloc_ram_v, snap, interval,
             use_pallas=use_pallas,
@@ -1209,7 +1500,15 @@ def ca_pass(
             pallas_mesh=pallas_mesh,
             pallas_axis=pallas_axis,
             descatter=descatter,
-        ),
+            sd_order=sd_order,
+            node_rank=node_rank,
+        )
+
+    removed, removed_per_group = jax.lax.cond(
+        # ca_count (live CA nodes) rather than ca_cursor (ever allocated):
+        # once everything scaled back down there is nothing to remove.
+        down_branch.any() & (auto.ca_count.sum() > 0),
+        _down_branch,
         lambda: (jnp.zeros((C, S), bool), jnp.zeros((C, Gn), jnp.int32)),
     )
 
@@ -1241,16 +1540,264 @@ def ca_pass(
         scaled_down_nodes=metrics.scaled_down_nodes + removed.sum(axis=1, dtype=jnp.int32),
         ca_reserve_starved=metrics.ca_reserve_starved + up_starved,
     )
-    auto = auto._replace(
+    new_auto = auto._replace(
         ca_count=auto.ca_count + planned_per_group - removed_per_group,
         ca_cursor=auto.ca_cursor + planned_per_group,
         ca_next=t_where(
             due, t_add(c_k, st.ca_period, interval), c_k
         ),
     )
+    if reclaim and auto.ca_alloc is not None:
+        # Stamp each opened slot's allocation index (the scalar's
+        # total_allocated at open time; names are "{group}_{alloc+1}").
+        # Scale-up opens the offsets [cursor, cursor + planned) of each
+        # group's reserve in slot order, which is also allocation order,
+        # so the index is cursor-relative arithmetic — no carry needed
+        # through the bin-pack loop or the Pallas kernel.
+        gidc = jnp.clip(st.ca_slot_group, 0, st.ng_ca_start.shape[1] - 1)
+        iota_s = jnp.broadcast_to(
+            jnp.arange(S, dtype=jnp.int32)[None, :], planned.shape
+        )
+        off_in_g = iota_s - st.ng_ca_start[rows, gidc]
+        alloc_new = (
+            auto.ca_total[rows, gidc]
+            + off_in_g
+            - auto.ca_cursor[rows, gidc]
+        )
+        new_auto = new_auto._replace(
+            ca_alloc=jnp.where(planned, alloc_new, auto.ca_alloc),
+            ca_total=auto.ca_total + planned_per_group,
+        )
+    auto = new_auto
     state = state._replace(
         nodes=nodes._replace(create_time=create_time, remove_time=remove_time),
         metrics=metrics,
+    )
+    return state, auto
+
+
+def ca_reclaim_pass(
+    state: ClusterBatchState,
+    auto: AutoscaleState,
+    st: AutoscaleStatics,
+    W: jnp.ndarray,
+    consts: StepConstants,
+    period: int = 1,
+    nodes_lane_major: bool = False,
+) -> Tuple[ClusterBatchState, AutoscaleState]:
+    """CA slot reclaim: return fully-RETIRED reserve slots to their group
+    by a stable in-trace compaction, so ca_cursor tracks live occupancy
+    and sustained churn never exhausts the reserve (the batched analog of
+    the reference's node_component_pool reuse, node_component_pool.rs:60-77).
+
+    Runs at the START of the window body — a clean state boundary, and it
+    guarantees a scale-up later in the same window sees every slot that
+    was reclaimable, so the loud starvation bound can only fire when the
+    reserve is truly exhausted by LIVE demand.
+
+    A slot is retired when its node's removal has fully drained:
+    - the node is dead with no pending create/remove effect, and
+    - no pod still binds it as RUNNING, and no SUCCEEDED pod's finish
+      visibility is still in flight (a future CA cycle's storage snapshot
+      lands at or after this window's start, so a finish visible by
+      (W, 0) can never be resurrected by the scale-down's vis_back
+      reconstruction; terminal pods past that horizon contribute nothing
+      to any later pass and their stale slot pointers are remapped along
+      with the move).
+
+    Compaction is STABLE per group (keepers pack to the group's reserve
+    prefix in slot order), which preserves the two orderings exactness
+    rests on: slot order among live CA nodes stays allocation order (the
+    scheduler's slot-order tie-break is untouched), and names ride the
+    occupants' allocation indices (ca_alloc), so every name-ordered walk
+    (ca_name_order) is invariant under the move. When nothing retires the
+    permutation is the identity and the pass is a bit-exact no-op; the
+    whole body sits behind a cond on the cheap (C, S) dead-slot predicate
+    so quiet windows pay only the predicate.
+
+    period > 1 additionally gates compaction to windows with
+    (W + 1) % period == 0 (batching the (C, P) safety sweep); retired
+    slots then wait, which is semantically invisible but can starve a
+    scale-up the immediate cadence would have served — the default is the
+    immediate cadence.
+    """
+    if auto is None or auto.ca_alloc is None:
+        return state, auto
+    nodes, pods = state.nodes, state.pods
+    C, P = pods.phase.shape
+    S = auto.ca_alloc.shape[1]
+    Gn = st.ng_ca_start.shape[1]
+    rows1 = jnp.arange(C, dtype=jnp.int32)
+    rows = rows1[:, None]
+    alive_row = nodes.alive.T if nodes_lane_major else nodes.alive
+    N = alive_row.shape[1]
+    n_trace = N - S
+    interval = jnp.float32(consts.scheduling_interval)
+    slots = st.ca_slots
+    slotc = jnp.clip(slots, 0, N - 1)
+    occupied = auto.ca_alloc >= 0
+
+    # Cheap per-window predicate: an occupied slot whose node is dead
+    # with no pending effects ((C, S) gathers only).
+    dead = (
+        occupied
+        & (slots >= 0)
+        & ~alive_row[rows, slotc]
+        & is_inf(
+            TPair(
+                win=nodes.create_time.win[rows, slotc],
+                off=nodes.create_time.off[rows, slotc],
+            )
+        )
+        & is_inf(
+            TPair(
+                win=nodes.remove_time.win[rows, slotc],
+                off=nodes.remove_time.off[rows, slotc],
+            )
+        )
+    )
+    do = dead.any()
+    if period > 1:
+        do = do & ((W + jnp.int32(1)) % jnp.int32(period) == 0).all()
+
+    iota_s = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (C, S))
+    grp = jnp.where(st.ca_slot_group >= 0, st.ca_slot_group, Gn)
+
+    def _compact():
+        # Row-major views of the hot node leaves (transposes only inside
+        # this rare branch; the pending pairs are row-major by contract).
+        alive_r = nodes.alive.T if nodes_lane_major else nodes.alive
+        acpu_r = nodes.alloc_cpu.T if nodes_lane_major else nodes.alloc_cpu
+        aram_r = nodes.alloc_ram.T if nodes_lane_major else nodes.alloc_ram
+        capc_r = nodes.cap_cpu.T if nodes_lane_major else nodes.cap_cpu
+        capr_r = nodes.cap_ram.T if nodes_lane_major else nodes.cap_ram
+
+        # Retirement safety: pods still binding the node. RUNNING blocks
+        # outright; a SUCCEEDED pod blocks until its finish visibility
+        # (finish + ca_finish_vis) reaches this window's start — after
+        # that no future storage snapshot can reconstruct it (vis_back).
+        Tp = TPair(
+            win=jnp.broadcast_to(W[:, None], (C, P)),
+            off=jnp.zeros((C, P), jnp.float32),
+        )
+        finish_vis = TPair(
+            win=st.ca_finish_vis.win[:, None],
+            off=st.ca_finish_vis.off[:, None],
+        )
+        succ_vis = t_add(
+            t_add(pods.start_time, pods.duration, interval),
+            finish_vis,
+            interval,
+        )
+        blocking = (
+            (pods.phase == PHASE_RUNNING)
+            | ((pods.phase == PHASE_SUCCEEDED) & ~t_le(succ_vis, Tp))
+        ) & (pods.node >= 0)
+        tgt_b = jnp.where(blocking, pods.node, N)
+        node_blocked = (
+            jnp.zeros((C, N), bool).at[rows, tgt_b].set(True, mode="drop")
+        )
+        retired = dead & ~node_blocked[rows, slotc]
+        keep = occupied & ~retired
+
+        # Stable per-group partition: keepers first in slot order (slot
+        # ranges per group are contiguous by construction).
+        _, _, order = jax.lax.sort(
+            (grp, jnp.where(keep, 0, 1).astype(jnp.int32), iota_s),
+            dimension=1,
+            num_keys=2,
+            is_stable=True,
+        )
+        inv = jnp.zeros((C, S), jnp.int32).at[rows, order].set(iota_s)
+        take = lambda a: jnp.take_along_axis(a, order, axis=1)  # noqa: E731
+
+        # Permute the CA node segment (caps and crash payload are uniform
+        # within a group / zero on CA slots — permutation-invariant, not
+        # rewritten). Retired slots reset to pristine allocatable.
+        seg = lambda a: a[:, n_trace:]  # noqa: E731
+        retired_n = take(retired)
+        alive_seg = take(seg(alive_r))
+        acpu_seg = jnp.where(
+            retired_n, seg(capc_r), take(seg(acpu_r))
+        )
+        aram_seg = jnp.where(
+            retired_n, seg(capr_r), take(seg(aram_r))
+        )
+        ctw_seg = take(seg(nodes.create_time.win))
+        cto_seg = take(seg(nodes.create_time.off))
+        rtw_seg = take(seg(nodes.remove_time.win))
+        rto_seg = take(seg(nodes.remove_time.off))
+
+        cat = lambda full, s_: jnp.concatenate(  # noqa: E731
+            [full[:, :n_trace], s_], axis=1
+        )
+        alive2 = cat(alive_r, alive_seg)
+        acpu2 = cat(acpu_r, acpu_seg)
+        aram2 = cat(aram_r, aram_seg)
+        if nodes_lane_major:
+            alive2, acpu2, aram2 = alive2.T, acpu2.T, aram2.T
+
+        # Stale or live slot pointers follow the move (terminal pods past
+        # the visibility horizon keep pointing at their retired slot's
+        # new position; nothing ever reads them again).
+        pn = pods.node
+        ca_ptr = pn >= n_trace
+        pn2 = jnp.where(
+            ca_ptr,
+            n_trace + inv[rows, jnp.clip(pn - n_trace, 0, S - 1)],
+            pn,
+        )
+
+        keep_cnt = (
+            jnp.zeros((C, Gn + 1), jnp.int32)
+            .at[rows, grp]
+            .add(keep.astype(jnp.int32))[:, :Gn]
+        )
+        return (
+            alive2,
+            acpu2,
+            aram2,
+            cat(nodes.create_time.win, ctw_seg),
+            cat(nodes.create_time.off, cto_seg),
+            cat(nodes.remove_time.win, rtw_seg),
+            cat(nodes.remove_time.off, rto_seg),
+            pn2,
+            jnp.where(retired_n, -1, take(auto.ca_alloc)),
+            keep_cnt,
+            auto.ca_reclaimed + retired.sum(axis=1, dtype=jnp.int32),
+        )
+
+    def _identity():
+        return (
+            nodes.alive,
+            nodes.alloc_cpu,
+            nodes.alloc_ram,
+            nodes.create_time.win,
+            nodes.create_time.off,
+            nodes.remove_time.win,
+            nodes.remove_time.off,
+            pods.node,
+            auto.ca_alloc,
+            auto.ca_cursor,
+            auto.ca_reclaimed,
+        )
+
+    (
+        alive2, acpu2, aram2, ctw2, cto2, rtw2, rto2, pn2,
+        alloc2, cursor2, reclaimed2,
+    ) = jax.lax.cond(do, _compact, _identity)
+    state = state._replace(
+        nodes=nodes._replace(
+            alive=alive2,
+            alloc_cpu=acpu2,
+            alloc_ram=aram2,
+            create_time=TPair(win=ctw2, off=cto2),
+            remove_time=TPair(win=rtw2, off=rto2),
+        ),
+        pods=pods._replace(node=pn2),
+    )
+    auto = auto._replace(
+        ca_alloc=alloc2, ca_cursor=cursor2, ca_reclaimed=reclaimed2
     )
     return state, auto
 
@@ -1280,7 +1827,7 @@ def hpa_pass_donated(
     jax.jit,
     static_argnames=(
         "K_up", "K_sd", "use_pallas", "pallas_interpret", "pallas_mesh",
-        "pallas_axis", "descatter",
+        "pallas_axis", "descatter", "reclaim",
     ),
     donate_argnums=(0,),
 )
@@ -1297,11 +1844,12 @@ def ca_pass_donated(
     pallas_mesh=None,
     pallas_axis: str = "clusters",
     descatter: bool = True,
+    reclaim: bool = False,
 ) -> ClusterBatchState:
     state2, auto2 = ca_pass(
         state, state.auto, st, W, consts, K_up, K_sd, pre=pre,
         use_pallas=use_pallas, pallas_interpret=pallas_interpret,
         pallas_mesh=pallas_mesh, pallas_axis=pallas_axis,
-        descatter=descatter,
+        descatter=descatter, reclaim=reclaim,
     )
     return state2._replace(auto=auto2)

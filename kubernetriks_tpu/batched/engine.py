@@ -135,6 +135,8 @@ def _fused_chunk_slide_impl(
     lane_major: bool = False,
     window_razor: bool = True,
     ca_descatter: bool = True,
+    reclaim: bool = False,
+    reclaim_period: int = 1,
     profile=None,
     W: int = 0,
 ):
@@ -179,6 +181,8 @@ def _fused_chunk_slide_impl(
             lane_major=lane_major,
             window_razor=window_razor,
             ca_descatter=ca_descatter,
+            reclaim=reclaim,
+            reclaim_period=reclaim_period,
             profile=profile,
         )
         return new, None
@@ -261,6 +265,103 @@ def _lex_name_ranks(names) -> np.ndarray:  # ktpu: sync-ok(host-side name-rank t
     out = np.empty(len(names), np.int32)
     out[order] = np.arange(len(names), dtype=np.int32)
     return out
+
+
+def _reclaim_class_tables(
+    compiled_traces,
+    group_names,
+    reserves,
+    n_trace_nodes: int,
+    S: int,
+):
+    """Static name-CLASS tables for the CA slot-reclaim orders
+    (autoscale.ca_name_order): one class per trace node (a singleton
+    name) and one per CA node group (the decimal name FAMILY
+    "{group}_{d}", d >= 1 — the scalar's total_allocated naming, which
+    occupies the lexicographic interval ["{group}_1", "{group}_:") since
+    every suffix starts with a digit 1-9 and ':' is the character after
+    '9'). The global name order then decomposes into a static cross-class
+    order plus the dynamic decimal-suffix order within a group — but ONLY
+    if no class interleaves another. This verifies exactly that, per
+    cluster, and returns (ca_slot_class (C, S), ca_class_start (C, Gn),
+    node_class_key (C, N_total), None) on success or (None, None, None,
+    reason) when the name sets make reclaim's order decomposition
+    unsound (the engine then refuses or falls back, loudly).
+    """
+    C = len(compiled_traces)
+    Gn = len(group_names)
+    fams = [(f"{name}_1", f"{name}_:") for name in group_names]
+    for i in range(Gn):
+        for j in range(i + 1, Gn):
+            lo_i, hi_i = fams[i]
+            lo_j, hi_j = fams[j]
+            if lo_i < hi_j and lo_j < hi_i:
+                return None, None, None, (
+                    f"CA node-group name families {group_names[i]!r} and "
+                    f"{group_names[j]!r} interleave lexicographically"
+                )
+    PAD_KEY = np.int32(1 << 30)
+    ca_slot_class = np.zeros((C, S), np.int32)
+    ca_class_start = np.zeros((C, Gn), np.int32)
+    node_class_key = np.full((C, n_trace_nodes + S), PAD_KEY, np.int32)
+    memo: dict = {}
+    for ci, trace in enumerate(compiled_traces):
+        names = list(trace.node_names[:n_trace_nodes])
+        key = id(trace)
+        got = memo.get(key)
+        if got is None:
+            for t in names:
+                for gi, (lo, hi) in enumerate(fams):
+                    if lo <= t < hi:
+                        return None, None, None, (
+                            f"trace node name {t!r} falls inside CA "
+                            f"group {group_names[gi]!r}'s name family"
+                        )
+            # Total class order: singletons by their name, families by
+            # their interval start (disjoint intervals make this the
+            # global lexicographic order of every current & future name).
+            entries = [(t, ("t", slot)) for slot, t in enumerate(names)]
+            entries += [
+                (fams[gi][0], ("f", gi)) for gi in range(Gn)
+            ]
+            entries.sort(key=lambda e: e[0])
+            n_classes = len(entries)
+            if n_classes * (S + 1) >= (1 << 31) - (S + 1):
+                return None, None, None, (
+                    f"{n_classes} name classes x (S + 1 = {S + 1}) "
+                    "overflows the int32 name-key space"
+                )
+            trace_rank = np.full(n_trace_nodes, -1, np.int64)
+            fam_rank = np.zeros(Gn, np.int64)
+            for rank, (_, tag) in enumerate(entries):
+                if tag[0] == "t":
+                    trace_rank[tag[1]] = rank
+                else:
+                    fam_rank[tag[1]] = rank
+            got = memo[key] = (trace_rank, fam_rank)
+        trace_rank, fam_rank = got
+        nk = node_class_key[ci]
+        named = trace_rank >= 0
+        nk[:n_trace_nodes][named] = (trace_rank[named] * (S + 1)).astype(
+            np.int32
+        )
+        cursor = 0
+        for gi, reserve in enumerate(reserves):
+            ca_slot_class[ci, cursor : cursor + reserve] = np.int32(
+                fam_rank[gi]
+            )
+            nk[n_trace_nodes + cursor : n_trace_nodes + cursor + reserve] = (
+                np.int32(fam_rank[gi] * (S + 1))
+            )
+            cursor += reserve
+        # First class-sorted slot position of each group's reserve: the
+        # groups in family-class order, cumulative reserve widths.
+        order = np.argsort(fam_rank, kind="stable")
+        pos = 0
+        for gi in order:
+            ca_class_start[ci, gi] = pos
+            pos += reserves[gi]
+    return ca_slot_class, ca_class_start, node_class_key, None
 
 
 def build_autoscale_statics(
@@ -470,6 +571,30 @@ def build_autoscale_statics(
                 np.int32
             )
 
+    # Reclaim name-order tables (r14): built whenever a CA reserve exists
+    # and the name classes verify non-interleaving; otherwise None with
+    # the reason in aux — the engine falls back (or raises on an explicit
+    # reclaim=True) instead of running an unsound order decomposition.
+    rc_slot_class = rc_class_start = rc_node_key = None
+    if ca_on and extra_node_names:
+        rc_slot_class, rc_class_start, rc_node_key, reclaim_reason = (
+            _reclaim_class_tables(
+                compiled_traces,
+                [g.node_template.metadata.name for g in groups],
+                reserves,
+                n_trace_nodes,
+                S,
+            )
+        )
+    elif ca_on:
+        reclaim_reason = "the CA reserve is empty (no named node groups)"
+    else:
+        reclaim_reason = "the cluster autoscaler is disabled"
+
+    # The scalar metrics collector's fixed pod-utilization pull cadence
+    # (60 s), as device time for the HPA collection latch.
+    from kubernetriks_tpu.metrics.collector import MetricsCollector
+
     statics = AutoscaleStatics(
         pg_slot_start=jnp.asarray(pg_slot_start),
         pg_slot_count=jnp.asarray(pg_slot_count),
@@ -510,8 +635,23 @@ def build_autoscale_statics(
         pod_name_rank=jnp.asarray(pod_name_rank),
         node_name_rank=jnp.asarray(node_name_rank),
         ca_sd_order=jnp.asarray(ca_sd_order),
+        col_interval=pair(
+            np.full((C,), MetricsCollector.COLLECTION_INTERVAL, np.float64)
+        ),
+        ca_slot_class=(
+            None if rc_slot_class is None else jnp.asarray(rc_slot_class)
+        ),
+        ca_class_start=(
+            None if rc_class_start is None else jnp.asarray(rc_class_start)
+        ),
+        node_class_key=(
+            None if rc_node_key is None else jnp.asarray(rc_node_key)
+        ),
     )
-    aux = {"pg_active_when_on": pg_active_when_on}
+    aux = {
+        "pg_active_when_on": pg_active_when_on,
+        "reclaim_unsupported": reclaim_reason,
+    }
     return statics, extra_cap_cpu, extra_cap_ram, extra_node_names, aux
 
 
@@ -548,6 +688,8 @@ class BatchedSimulation:
         lane_major: Optional[bool] = None,
         window_razor: Optional[bool] = None,
         ca_descatter: Optional[bool] = None,
+        reclaim: Optional[bool] = None,
+        reclaim_period: Optional[int] = None,
         scheduler_profile=None,
         scenario=None,
     ) -> None:
@@ -778,6 +920,30 @@ class BatchedSimulation:
             if ca_descatter is not None
             else flag_bool("KTPU_CA_DESCATTER")
         )
+        # CA slot reclaim (KTPU_RECLAIM / reclaim arg): a periodic
+        # in-trace compaction returns fully-retired CA reserve slots, so
+        # ca_cursor tracks LIVE occupancy and sustained churn never
+        # exhausts the reserve (ROADMAP #2 — the endurance blocker).
+        # Trajectories are scalar-exact: allocations carry the scalar's
+        # total_allocated naming index and name-ordered walks derive
+        # their order from it (autoscale.ca_name_order). Tristate like
+        # the other perf statics: unset means on for accelerator
+        # backends, off on CPU hosts (the compaction cond + dynamic
+        # orders are extra program text on every window program; tests
+        # and endurance runs opt in explicitly). An explicit reclaim=True
+        # on a trace whose node-name classes interleave (the order
+        # decomposition would be unsound) raises at build; the tristate
+        # default falls back off with a warning. Finalized after the
+        # autoscale statics are built below.
+        self._reclaim_requested = (
+            bool(reclaim) if reclaim is not None else None
+        )
+        if self._reclaim_requested is None:
+            self._reclaim_requested = flag_tristate("KTPU_RECLAIM")
+        if reclaim_period is None:
+            reclaim_period = flag_int("KTPU_RECLAIM_PERIOD")
+        self.reclaim_period = max(1, int(reclaim_period))
+        self.reclaim = False
         # (lo, RefillStage) staging buffers for the superspan executor when
         # the whole-trace payload exceeds the device budget: the stage the
         # next dispatch reads, and the double-buffered successor assembled
@@ -889,6 +1055,7 @@ class BatchedSimulation:
         self.pod_window = pod_window
         self._pod_base = 0
         self._full_pods = None
+        self._payload_source = None
         self._resident_shift = 0
 
         # Full-resident runs 128-align the pod axis: the Pallas wrapper pads
@@ -915,6 +1082,38 @@ class BatchedSimulation:
             pod_duration,
             node_crash_downtime,
         ) = pad_and_batch(compiled_traces, n_pods=n_pods_aligned)
+
+        # Host-side node-event schedule for point-in-time readouts
+        # (node_count_at): a slab event applies only when its WINDOW
+        # executes, so a trace/chaos node transition earlier in the
+        # current (unexecuted) window is visible in neither the alive
+        # flags nor the pending effect pairs — the readout resolves it
+        # from this table. Node events only: O(nodes + crash chains),
+        # never O(T).
+        from kubernetriks_tpu.batched.state import (
+            EV_CREATE_NODE,
+            EV_NODE_CRASH,
+            EV_NODE_RECOVER,
+            EV_REMOVE_NODE,
+        )
+
+        _node_kind = np.isin(
+            ev_kind,
+            (EV_CREATE_NODE, EV_REMOVE_NODE, EV_NODE_CRASH, EV_NODE_RECOVER),
+        )
+        _ev_win_all, _ = from_f64_np(ev_time, config.scheduling_cycle_interval)
+        self._node_event_table = [
+            (
+                ev_time[ci][_node_kind[ci]],
+                np.isin(
+                    ev_kind[ci][_node_kind[ci]],
+                    (EV_CREATE_NODE, EV_NODE_RECOVER),
+                ),
+                ev_slot[ci][_node_kind[ci]],
+                _ev_win_all[ci][_node_kind[ci]],
+            )
+            for ci in range(C)
+        ]
 
         # Chaos engine: static fault constants (None = off, identical
         # programs) and the KTPU_DEBUG_FINITE guard mode (host-side NaN/inf
@@ -982,6 +1181,16 @@ class BatchedSimulation:
                 "req_ram": pod_req_ram[:, :T],
                 "duration": pod_duration[:, :T],
             }
+            # Payload seam (ROADMAP #2 host-memory bound): every refill /
+            # staging consumer reads request+duration columns through
+            # this source. The build default wraps the resident arrays;
+            # attach_payload_source swaps in a bounded segment reader and
+            # RELEASES them, making steady-state host RSS O(stage width).
+            from kubernetriks_tpu.batched.trace_compile import (
+                ArrayPayloadSource,
+            )
+
+            self._payload_source = ArrayPayloadSource(self._full_pods)
             # Lexicographic pod-name ranks over the WHOLE trace (global pod
             # coords): the window's device slice is refreshed on every slide
             # (statics are traced arguments, so no recompile), keeping the
@@ -1042,6 +1251,35 @@ class BatchedSimulation:
             )
             self.autoscale_statics = statics
             self._autoscale_aux = aux
+            # Finalize the reclaim decision now that the name-order
+            # tables' verification outcome is known.
+            want = self._reclaim_requested
+            if want is None:
+                want = jax.default_backend() != "cpu"
+            supported = ca_on and statics.ca_slot_class is not None
+            if want and not supported:
+                reason = aux.get("reclaim_unsupported") or "unsupported"
+                if self._reclaim_requested:
+                    raise ValueError(
+                        "reclaim=True (KTPU_RECLAIM) is unsupported for "
+                        f"this build: {reason} — the allocation-name "
+                        "order decomposition would be unsound; rename "
+                        "the conflicting nodes/groups or run without "
+                        "reclaim"
+                    )
+                if ca_on:
+                    import warnings as _warnings
+
+                    _warnings.warn(
+                        "KTPU_RECLAIM default-on disabled: "
+                        f"{reason}; the CA reserve stays monotone "
+                        "(engine.check_autoscaler_bounds remains the "
+                        "only backstop)",
+                        RuntimeWarning,
+                        stacklevel=2,
+                    )
+                want = False
+            self.reclaim = bool(want and supported)
             self._reserve_capacities = {
                 "hpa_reserve": [
                     int(v)
@@ -1192,7 +1430,14 @@ class BatchedSimulation:
                     (seg_lo, seg_hi) if seg_hi > seg_lo else (0, 0)
                 )
         if self.autoscale_statics is not None:
-            auto = init_autoscale_state(self.autoscale_statics)
+            # collect: arm the HPA collection latch (the 60 s staleness
+            # fix) whenever the HPA can actually act; reclaim: arm the CA
+            # slot-reclaim leaves (allocation indices + counters).
+            auto = init_autoscale_state(
+                self.autoscale_statics,
+                reclaim=self.reclaim,
+                collect=self._hpa_seg != (0, 0),
+            )
             # When the step skips hpa_pass (seg == (0, 0)), park its tick at
             # +inf so everything that reads hpa_next (fast-forward's
             # _next_interesting_window, _catch_up_bookkeeping) agrees the
@@ -1424,7 +1669,7 @@ class BatchedSimulation:
         # segment — stage_segment owns the padding rules, so this payload
         # and the bounded RefillStage slabs (_make_stage) cannot drift.
         seg = stage_segment(
-            full,
+            self._payload_source,
             self._pod_create_win,
             self._pod_name_rank_full[:, :T] if has_rank else None,
             0,
@@ -1536,6 +1781,8 @@ class BatchedSimulation:
             lane_major=self.lane_major,
             window_razor=self.window_razor,
             ca_descatter=self.ca_descatter,
+            reclaim=self.reclaim,
+            reclaim_period=self.reclaim_period,
             profile=self.profile,
         )
 
@@ -2027,7 +2274,7 @@ class BatchedSimulation:
         from kubernetriks_tpu.batched.trace_compile import stage_segment
 
         return stage_segment(
-            self._full_pods,
+            self._payload_source,
             self._pod_create_win,
             (
                 self._pod_name_rank_full[:, : int(self.consts.trace_pod_bound)]
@@ -2087,6 +2334,95 @@ class BatchedSimulation:
         return stage
 
     # --- streaming feeder lifecycle ----------------------------------------
+
+    def attach_payload_source(self, source) -> None:
+        """Swap the resident whole-trace payload arrays (req/ram/duration,
+        ~16 B/pod host memory) for a bounded segment-at-a-time
+        PayloadSource (trace_compile.FeederPayloadSource over the native
+        feeder's WorkloadSegmentReader, or any source honoring the
+        contract) and RELEASE them — the host-memory half of the
+        endurance work (ROADMAP #2): steady-state host RSS then holds
+        only O(stage width) payload plus the disclosed small per-pod
+        int32 tables (create windows for the O(1) capacity lookup, name
+        ranks when autoscalers are on — 4 B/pod each, reported by
+        _slab_accounting as host_payload_bytes).
+
+        Requires the streaming superspan pipeline (the device-resident
+        slide payload and the host slide path both want the whole trace
+        resident) and a pure plain-pod payload axis (pod groups renumber
+        it). The feeder is re-seeked so its producer thread never reads a
+        released array."""
+        from kubernetriks_tpu.batched.trace_compile import (
+            ArrayPayloadSource,
+            PayloadSource,
+        )
+
+        if not isinstance(source, PayloadSource):
+            raise TypeError(
+                f"attach_payload_source wants a trace_compile."
+                f"PayloadSource, got {type(source).__name__}"
+            )
+        if self.pod_window is None or not self._stream_on():
+            raise ValueError(
+                "attach_payload_source requires the streaming superspan "
+                "pipeline (pod_window + stream=True/KTPU_STREAM + "
+                "superspan): the non-streaming paths keep the whole "
+                "payload resident by design"
+            )
+        T = int(self.consts.trace_pod_bound)
+        if source.total_rows < T:
+            raise ValueError(
+                f"payload source covers {source.total_rows} plain pod "
+                f"columns; this trace has {T}"
+            )
+        if any(len(names) for names in self.pod_group_names):
+            raise ValueError(
+                "attach_payload_source does not support pod-group "
+                "workloads: the resident group ring renumbers the "
+                "payload axis past the plain segment, so payload column "
+                "i would no longer be workload row i"
+            )
+        # Fidelity gate BEFORE releasing anything: the new source must
+        # reproduce the engine's compiled payload bit-exactly over the
+        # whole trace (chunked, one cold-path host pass). This is what
+        # makes the swap safe at all — it catches a single-workload
+        # FeederPayloadSource broadcast onto a HETEROGENEOUS fleet
+        # (per-cluster traces differ; the reader would silently serve
+        # cluster 0's pods to every lane), mismatched unit conversions,
+        # or plain wrong-trace attachment, all of which would otherwise
+        # produce wrong trajectories with no error.
+        reference = (
+            ArrayPayloadSource(self._full_pods)
+            if self._full_pods is not None
+            else self._payload_source
+        )
+        if reference is not None:
+            chunk = 1 << 16
+            for lo_v in range(0, T, chunk):
+                w = min(chunk, T - lo_v)
+                want = reference.segment(lo_v, w)
+                got = source.segment(lo_v, w)
+                for k in ("req_cpu", "req_ram", "duration"):
+                    if not np.array_equal(want[k], got[k]):
+                        diff = np.argwhere(want[k] != got[k])
+                        c_bad, j_bad = (int(v) for v in diff[0])
+                        raise ValueError(
+                            f"attach_payload_source: source disagrees "
+                            f"with the compiled trace payload at {k}"
+                            f"[cluster {c_bad}, column {lo_v + j_bad}] "
+                            f"({want[k][c_bad, j_bad]} != "
+                            f"{got[k][c_bad, j_bad]}) — a payload source "
+                            "serves the workload of EVERY cluster lane; "
+                            "heterogeneous per-cluster traces need a "
+                            "per-cluster-aware source (or keep the "
+                            "resident payload)"
+                        )
+        self._close_feeder()
+        self._payload_source = source
+        self._full_pods = None
+        self._stage_cur = None
+        self._stage_next = None
+        self._refill_prefetch = None
 
     def _stream_on(self) -> bool:
         """Whether the streaming pipeline stages this engine's slabs: the
@@ -2370,7 +2706,7 @@ class BatchedSimulation:
         beyond the device window (slots are created in event order, so the
         first overflow create's window bounds every cluster)."""
         L = self._pod_base + self.pod_window
-        if L >= self._full_pods["req_cpu"].shape[1]:
+        if L >= self._pod_create_win.shape[1]:
             return 1 << 30
         return int(self._pod_create_win[:, L].min())
 
@@ -2378,7 +2714,7 @@ class BatchedSimulation:
         """Re-slice the windowed pod-name ranks into the autoscale statics
         after a window slide (device layout: [window over plain slots |
         resident rings])."""
-        if self.autoscale_statics is None or self._full_pods is None:
+        if self.autoscale_statics is None or self._payload_source is None:
             return
         W = self.pod_window
         T = int(self.consts.trace_pod_bound)
@@ -2556,25 +2892,15 @@ class BatchedSimulation:
             fresh_pod_arrays,
         )
 
-        full = self._full_pods
         C = self._pod_create_win.shape[0]
-
-        def seg(arr, fill):
-            out = arr[:, start : start + width]
-            if out.shape[1] < width:
-                pad = np.full(
-                    (arr.shape[0], width - out.shape[1]), fill, arr.dtype
-                )
-                out = np.concatenate([out, pad], axis=1)
-            return out
-
+        cols = self._payload_source.segment(start, width)
         refill = fresh_pod_arrays(
             C,
             width,
-            seg(full["req_cpu"], 0),
-            seg(full["req_ram"], 0),
+            cols["req_cpu"],
+            cols["req_ram"],
             duration_pair_np(
-                seg(full["duration"], -1.0),
+                cols["duration"],
                 self.config.scheduling_cycle_interval,
             ),
         )
@@ -2854,6 +3180,8 @@ class BatchedSimulation:
             lane_major=self.lane_major,
             window_razor=self.window_razor,
             ca_descatter=self.ca_descatter,
+            reclaim=self.reclaim,
+            reclaim_period=self.reclaim_period,
             profile=self.profile,
         )
         if self.collect_gauges:
@@ -2941,19 +3269,51 @@ class BatchedSimulation:
         starved = np.asarray(to_host(self.state.metrics.ca_reserve_starved))
         if starved.sum() > 0:
             worst = int(starved.argmax())
+            if self.reclaim:
+                hint = (
+                    "slot reclaim is ON, so every fully-retired slot was "
+                    "already returned — the reserve is exhausted by LIVE "
+                    "demand (plus removals still inside their visibility "
+                    "horizon). Raise ca_slot_multiplier (build arg) to "
+                    "widen the reserve"
+                )
+            else:
+                hint = (
+                    "scaled-up slots are never reclaimed on this build — "
+                    "raise ca_slot_multiplier (build arg) to widen the "
+                    "reserve, or enable slot reclaim (reclaim=True / "
+                    "KTPU_RECLAIM=1) so retired slots return to it"
+                )
             raise RuntimeError(
                 f"CA slot reserve exhausted: {int(starved.sum())} "
                 f"scale-up attempt(s) across {int((starved > 0).sum())} "
                 f"cluster(s) (worst: cluster {worst}, "
                 f"{int(starved[worst])}) found quota headroom and a "
                 "fitting node-group template but no reserved slot left — "
-                "scaled-up slots are never reclaimed, so the demand "
-                "silently starved where the scalar path would have "
-                "provisioned a node. Raise ca_slot_multiplier (build arg) "
-                "to widen the reserve, or set "
+                "the demand silently starved where the scalar path would "
+                f"have provisioned a node. {hint}; or set "
                 "strict_autoscaler_bounds=False to accept the starved "
                 "trajectory."
             )
+        # Decimal-suffix name keys (autoscale.decimal_string_key) order
+        # "{prefix}_{idx}" names exactly for idx < 10^8; past that the
+        # int32 key saturates its digit bands and name-ordered walks
+        # would silently drift. Endurance runs approach this only after
+        # ~10^8 allocations per group — raise loudly instead of drifting.
+        auto = self.state.auto
+        if auto is not None:
+            tail_max = int(np.asarray(to_host(auto.hpa_tail)).max())
+            total_max = 0
+            if auto.ca_total is not None:
+                total_max = int(np.asarray(to_host(auto.ca_total)).max())
+            if max(tail_max, total_max) >= 10**8:
+                raise RuntimeError(
+                    f"allocation-name counter overflow: hpa_tail max "
+                    f"{tail_max}, ca_total max {total_max} reached the "
+                    "10^8 bound of the decimal-suffix name keys "
+                    "(autoscale.decimal_string_key) — name-ordered "
+                    "victim/walk selection is no longer exact past it"
+                )
 
     def metrics_summary(self) -> Dict:  # ktpu: sync-ok(readout: one-shot cross-cluster metric reduction after the run)
         """Cross-cluster reduction into the scalar printer's shape. On a
@@ -3027,6 +3387,15 @@ class BatchedSimulation:
         names = self.pod_group_names[cluster]
         return {name: int(tail[i] - head[i]) for i, name in enumerate(names)}
 
+    def ca_slots_reclaimed(self) -> np.ndarray:  # ktpu: sync-ok(readout: reclaim counter after the run)
+        """(C,) CA reserve slots returned by the reclaim compaction
+        (zeros when reclaim is off) — the 'reclaim actually fired'
+        observable the endurance gates assert on."""
+        auto = self.state.auto
+        if auto is None or auto.ca_reclaimed is None:
+            return np.zeros(self.n_clusters, np.int32)
+        return np.asarray(to_host(auto.ca_reclaimed))
+
     def ca_node_counts(self, cluster: int) -> np.ndarray:  # ktpu: sync-ok(readout: node counts after the run)
         """Current cluster-autoscaler node count per node group."""
         auto = self.state.auto
@@ -3040,10 +3409,45 @@ class BatchedSimulation:
         implementation detail of the lazy window application — so a faithful
         'how many nodes exist at t' read must resolve the scheduled effects
         the state already carries (the batched equivalent of the scalar
-        api_server.node_count() sampled mid-window)."""
+        api_server.node_count() sampled mid-window).
+
+        CA-slot effects carry a readout correction (r14, surfaced by the
+        endurance gates at drift phases no short run reaches): the device
+        pairs are the SCHEDULER/NODE-side visibility times the simulation
+        semantics need (create d_ca_up = fire + 3*as_to_ca + 5*as_to_ps +
+        ps_to_sched, the PS->scheduler notification; remove d_ca_down =
+        fire + 3*as_to_ca + 4*as_to_ps + as_to_node, the node component
+        going down), while the scalar oracle `api_server.node_count()`
+        flips at the AS bookkeeping instants — `_handle_create_node` runs
+        one (as_to_ps + ps_to_sched) BEFORE the scheduler hears, and
+        `on_node_removed_from_cluster` one as_to_node AFTER the component
+        died. Chaos never targets CA slots (their crash payload is zero
+        padding), so every pending CA-slot effect is a CA-cycle effect
+        and the constant shifts are exact. Effects a window already
+        resolved can no longer be shifted, so boundary-exact samples keep
+        a sub-delay edge — sample mid-window (the suite's boundary+5
+        convention) for exact trajectories.
+
+        Trace/chaos node events carry the complementary correction: a
+        slab event earlier in the CURRENT (unexecuted) window is visible
+        in neither the alive flags nor the pending pairs, so the readout
+        replays the host-side node-event schedule
+        (self._node_event_table) over the unapplied suffix with the same
+        AS-bookkeeping shifts — a mid-window sample right after a chaos
+        crash agrees with the scalar count (the r14 endurance gates
+        sample exactly there)."""
         interval = self.config.scheduling_cycle_interval
         win = int(t // interval)
         off = t - win * interval
+        cfg = self.config
+        # The same AS-bookkeeping shifts for CA-slot pending pairs and
+        # unapplied slab node events: the device times are scheduler/
+        # node-side visibility, the scalar count flips at the AS
+        # bookkeeping instants.
+        up_shift = float(
+            cfg.as_to_ps_network_delay + cfg.ps_to_sched_network_delay
+        )
+        down_shift = float(cfg.as_to_node_network_delay)
         nodes = self.state.nodes
         alive = to_host(nodes.alive)[cluster]
         cw = to_host(nodes.create_time.win)[cluster]
@@ -3052,7 +3456,37 @@ class BatchedSimulation:
         ro = to_host(nodes.remove_time.off)[cluster]
         due_create = (cw < win) | ((cw == win) & (co <= off))
         due_remove = (rw < win) | ((rw == win) & (ro <= off))
-        return int(((alive | due_create) & ~due_remove).sum())
+        st = self.autoscale_statics
+        if st is not None and st.ca_slots.shape[1] > 0:
+            slots = np.asarray(st.ca_slots)[cluster]
+            slots = slots[slots >= 0]
+            if slots.size:
+                ca = np.zeros(alive.shape[0], bool)
+                ca[slots] = True
+                abs_c = cw.astype(np.float64) * interval + co - up_shift
+                abs_r = rw.astype(np.float64) * interval + ro + down_shift
+                due_create = np.where(ca, abs_c <= t, due_create)
+                due_remove = np.where(ca, abs_r <= t, due_remove)
+        count = (alive | due_create) & ~due_remove
+        # Trace/chaos slab node events the step has not APPLIED yet (their
+        # window never executed — the r14 endurance gates sample mid-window
+        # while a crash sits earlier in the same window): resolve them from
+        # the host-side schedule, last transition at or before t wins.
+        # Events in executed windows already live in the flags/pairs above.
+        applied_win = int(to_host(self.state.time)[cluster])
+        et, is_create, es, ew = self._node_event_table[cluster]
+        eff = np.where(is_create, et - up_shift, et + down_shift)
+        sel = (ew >= applied_win) & (eff <= t)
+        # "Last transition wins" is defined on the EFFECTIVE (shifted)
+        # times, not the slab order: a short-downtime crash/recover pair
+        # inverts under the shifts (recover's -up_shift lands before
+        # crash's +down_shift when downtime < up_shift + down_shift), and
+        # the scalar's AS bookkeeping then processed the removal last.
+        # Stable sort keeps slab FIFO order at equal effective instants.
+        idx = np.nonzero(sel)[0]
+        for i in idx[np.argsort(eff[idx], kind="stable")]:
+            count[es[i]] = bool(is_create[i])
+        return int(count.sum())
 
     # --- telemetry readout --------------------------------------------------
 
@@ -3194,12 +3628,28 @@ class BatchedSimulation:
                 total += int(getattr(leaf, "nbytes", 0) or 0)
             return total
 
+        host_payload = 0
+        if self._full_pods is not None:
+            host_payload += sum(
+                int(a.nbytes) for a in self._full_pods.values()
+            )
+        for small in (
+            getattr(self, "_pod_create_win", None),
+            getattr(self, "_pod_name_rank_full", None),
+        ):
+            if small is not None:
+                host_payload += int(small.nbytes)
         acct = {
             "device_slide_bytes": (
                 nbytes(self._device_slide)
                 if self._device_slide is not None
                 else 0
             ),
+            # Resident host payload: the whole-trace request/duration
+            # arrays (released by attach_payload_source) plus the small
+            # per-pod int32 tables the engine keeps for O(1) lookups —
+            # the observable behind the bounded-host-memory claim.
+            "host_payload_bytes": host_payload,
             "stage_bytes": nbytes(
                 [s for s in (self._stage_cur, self._stage_next) if s is not None]
             ),
@@ -3390,6 +3840,13 @@ class BatchedSimulation:
                 # restore template must carry a matching ring, so record
                 # its capacity for load_checkpoint's loud guard.
                 meta["telemetry_ring"] = int(self._telemetry_ring_size)
+            if self.reclaim:
+                # Slot-reclaim leaves (ca_alloc/ca_total/...) ride the
+                # state pytree; record the mode so a mismatched restore
+                # raises the actionable message instead of an opaque
+                # manifest diff. Reclaim-off saves write nothing,
+                # keeping older checkpoints loadable.
+                meta["reclaim"] = True
             from kubernetriks_tpu.batched.pipeline import DEFAULT_PROFILE
 
             if self.profile != DEFAULT_PROFILE:
@@ -3441,6 +3898,63 @@ class BatchedSimulation:
         # gets below). Runs with meta absent too: a plain save writes no
         # meta at all, and restoring it into a telemetry-armed engine is
         # exactly the mismatch.
+        saved_reclaim = bool(meta.get("reclaim", False))
+        if saved_reclaim != self.reclaim:
+            # Tristate-defaulted engines FOLLOW the checkpoint instead of
+            # raising: KTPU_RECLAIM defaults on for accelerator backends,
+            # so every pre-reclaim checkpoint would otherwise refuse to
+            # restore on TPU/GPU until the user dug up KTPU_RECLAIM=0.
+            # The swap is a cold-path mode flip: reclaim is a per-call
+            # jit static (next dispatch compiles the other program) and
+            # the slot-reclaim leaves are presence-only in the auto
+            # pytree, so matching the TEMPLATE to the saved structure is
+            # all the restore needs. Explicit reclaim=/KTPU_RECLAIM
+            # requests still raise — the user asked for a specific mode.
+            followable = self._reclaim_requested is None and (
+                not saved_reclaim
+                or (
+                    self.autoscale_statics is not None
+                    and self.autoscale_statics.ca_slot_class is not None
+                )
+            )
+            if followable:
+                import warnings as _warnings
+
+                _warnings.warn(
+                    f"checkpoint saved with reclaim={saved_reclaim} but "
+                    f"this engine defaulted to {self.reclaim} "
+                    f"(KTPU_RECLAIM tristate): following the checkpoint "
+                    f"— continuing with reclaim={saved_reclaim}",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+                self.reclaim = saved_reclaim
+                auto_t = self.state.auto
+                if saved_reclaim:
+                    fresh = init_autoscale_state(
+                        self.autoscale_statics,
+                        reclaim=True,
+                        collect=auto_t.col_next is not None,
+                    )
+                    auto_t = auto_t._replace(
+                        ca_alloc=fresh.ca_alloc,
+                        ca_total=fresh.ca_total,
+                        ca_reclaimed=fresh.ca_reclaimed,
+                    )
+                else:
+                    auto_t = auto_t._replace(
+                        ca_alloc=None, ca_total=None, ca_reclaimed=None
+                    )
+                self.state = self.state._replace(auto=auto_t)
+            else:
+                raise ValueError(
+                    f"checkpoint reclaim mismatch: saved with reclaim="
+                    f"{saved_reclaim}, this engine built with "
+                    f"{self.reclaim} — the slot-reclaim leaves are part "
+                    "of the state pytree; build the restoring engine "
+                    f"with reclaim={saved_reclaim} (KTPU_RECLAIM) to "
+                    "continue the run"
+                )
         saved_ring = meta.get("telemetry_ring")
         have_ring = (
             self._telemetry_ring_size

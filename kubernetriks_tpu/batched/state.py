@@ -234,7 +234,9 @@ TELEM_ALIVE_NODES = 7  # alive node count after the window
 # reductions over the trace slab or pod axis beyond what the record
 # already pays, zeros when autoscaling is off.
 TELEM_HPA_RESERVE = 8  # live HPA replicas across groups (hpa_tail - hpa_head)
-TELEM_CA_RESERVE = 9  # CA slots consumed across groups (ca_cursor, monotone)
+TELEM_CA_RESERVE = 9  # CA reserve slots consumed across groups (ca_cursor:
+# monotone without reclaim; LIVE occupancy under KTPU_RECLAIM, where the
+# compaction pulls the cursor back — the watchdog fits the NET slope)
 # Plain-trace refill columns the device pod window has NOT yet covered
 # (trace_pod_bound - pod_base - plain window width). Values at or above
 # telemetry/observatory.UNBOUNDED_SENTINEL mean "no sliding window /

@@ -216,6 +216,7 @@ def _apply_window_events(
     fault_params=None,
     lane_major: bool = False,
     window_razor: bool = True,
+    node_key_fn=None,
 ):
     """Event application + finish resolution, behind the window-cost razor
     (KTPU_WINDOW_RAZOR): when the due-ness predicate proves the window has
@@ -239,6 +240,7 @@ def _apply_window_events(
         pod_name_rank,
         fault_params,
         lane_major,
+        node_key_fn,
     )
     if not window_razor:
         return _apply_window_events_work(state, slab, W, *args)
@@ -284,6 +286,7 @@ def _apply_window_events_work(
     pod_name_rank=None,
     fault_params=None,
     lane_major: bool = False,
+    node_key_fn=None,
 ) -> ClusterBatchState:
     """Apply every trace event with effect time STRICTLY before the cycle time
     W * interval, and resolve all pod finishes due in the window.
@@ -771,7 +774,12 @@ def _apply_window_events_work(
     def _resched_rank_exact():
         big = jnp.int32(1 << 30)
         node_c2 = jnp.clip(pods.node, 0, N - 1)
-        if node_name_rank is not None:
+        if node_key_fn is not None:
+            # Slot reclaim: removed CA nodes order by their occupants'
+            # CURRENT names (allocation-index keys, autoscale.ca_name_order)
+            # — the static table describes the slots' first occupants.
+            nr = node_key_fn()[jnp.arange(C, dtype=jnp.int32)[:, None], node_c2]
+        elif node_name_rank is not None:
             nr = node_name_rank[jnp.arange(C, dtype=jnp.int32)[:, None], node_c2]
         else:
             nr = node_c2
@@ -1841,12 +1849,31 @@ def _window_body(
     lane_major: bool = False,
     window_razor: bool = True,
     ca_descatter: bool = True,
+    reclaim: bool = False,
+    reclaim_period: int = 1,
     profile=None,
 ) -> ClusterBatchState:
     W = jnp.broadcast_to(jnp.asarray(W, jnp.int32), state.time.shape)
     # Telemetry ring (flight recorder): the window's incoming metric
     # counters, diffed at the end of the body into one per-window record.
     m0 = state.metrics
+    # CA slot reclaim (KTPU_RECLAIM): compaction runs FIRST — a clean
+    # state boundary, and a scale-up later in this window then sees every
+    # reclaimable slot (the loud starvation bound can only fire on true
+    # live-demand exhaustion). See autoscale.ca_reclaim_pass.
+    if reclaim and autoscale_statics is not None and state.auto is not None:
+        from kubernetriks_tpu.batched.autoscale import ca_reclaim_pass
+
+        state, auto_r = ca_reclaim_pass(
+            state,
+            state.auto,
+            autoscale_statics,
+            W,
+            consts,
+            period=reclaim_period,
+            nodes_lane_major=lane_major,
+        )
+        state = state._replace(auto=auto_r)
     # Same-time reschedule/retry ordering needs lexicographic name ranks to
     # match the scalar's sorted-name walks; they come from the autoscale
     # statics when autoscalers are on, else from the engine's standalone
@@ -1859,6 +1886,26 @@ def _window_body(
         node_name_rank, pod_name_rank = name_ranks
     else:
         node_name_rank = pod_name_rank = None
+    node_key_fn = None
+    if (
+        reclaim
+        and autoscale_statics is not None
+        and state.auto is not None
+        and state.auto.ca_alloc is not None
+    ):
+        # Under reclaim the same-window reschedule batches order removed
+        # CA nodes by their occupants' CURRENT names, not the slots'
+        # static first-occupant names; the key derives from the
+        # allocation indices and is only computed inside the (rare)
+        # reschedule cond. auto is captured here — event application
+        # never mutates it.
+        from kubernetriks_tpu.batched.autoscale import ca_name_order
+
+        auto0 = state.auto
+        node_key_fn = lambda: ca_name_order(  # noqa: E731
+            auto0, autoscale_statics
+        )[1]
+
     state, wake = _apply_window_events(
         state,
         slab,
@@ -1876,6 +1923,7 @@ def _window_body(
         fault_params=fault_params,
         lane_major=lane_major,
         window_razor=window_razor,
+        node_key_fn=node_key_fn,
     )
     # Pre-cycle shadows for the CA's early-snapshot case (a CA storage
     # snapshot landing before this window's commit-visibility time must not
@@ -1933,6 +1981,7 @@ def _window_body(
             pallas_axis=pallas_axis,
             nodes_lane_major=lane_major,
             descatter=ca_descatter,
+            reclaim=reclaim,
         )
         state = state._replace(auto=auto)
     if state.telemetry is not None:
@@ -2014,6 +2063,12 @@ _STEP_STATICS = (
     "lane_major",
     "window_razor",
     "ca_descatter",
+    # CA slot reclaim (KTPU_RECLAIM, r14): the compaction pass at the top
+    # of the window body + allocation-index name orders in the CA passes.
+    # Off compiles the pre-reclaim programs (the A/B bit-identity gate);
+    # reclaim_period > 1 batches the compaction's (C, P) safety sweep.
+    "reclaim",
+    "reclaim_period",
     # pipeline.CompiledProfile (hashable NamedTuple of plugin names +
     # weights) or None; the compiled scheduler profile whose filter/score
     # expressions the decision core runs. None compiles programs identical
@@ -2048,6 +2103,8 @@ def window_step(
     lane_major: bool = False,
     window_razor: bool = True,
     ca_descatter: bool = True,
+    reclaim: bool = False,
+    reclaim_period: int = 1,
     profile=None,
 ) -> ClusterBatchState:
     """Advance every cluster through scheduling-cycle window index W.
@@ -2080,6 +2137,8 @@ def window_step(
         lane_major=lane_major,
         window_razor=window_razor,
         ca_descatter=ca_descatter,
+        reclaim=reclaim,
+        reclaim_period=reclaim_period,
         profile=profile,
     )
     if lane_major:
@@ -2160,6 +2219,12 @@ def _next_interesting_window(
         # HPA ticks are interesting whenever a group could be active (the
         # engine parks hpa_next at +inf otherwise, making this a no-op).
         cand = jnp.minimum(cand, hpa_tick)
+        if auto.col_next is not None:
+            # HPA collection latch (r14 staleness fix): the 60 s metrics
+            # collection snapshots the load curve AT its window — a skipped
+            # collection would latch a different utilization later, so its
+            # tick is a trigger like the HPA's own.
+            cand = jnp.minimum(cand, amin(auto.col_next.win))
 
     return jnp.maximum(W + jnp.int32(1), cand)
 
@@ -2258,6 +2323,8 @@ def _run_windows_skip_impl(
     lane_major: bool = False,
     window_razor: bool = True,
     ca_descatter: bool = True,
+    reclaim: bool = False,
+    reclaim_period: int = 1,
     profile=None,
 ):
     """run_windows with FAST-FORWARD over provably no-op windows: a dynamic
@@ -2302,6 +2369,8 @@ def _run_windows_skip_impl(
             lane_major=lane_major,
             window_razor=window_razor,
             ca_descatter=ca_descatter,
+            reclaim=reclaim,
+            reclaim_period=reclaim_period,
             profile=profile,
         )
         W_next = jnp.minimum(
@@ -2366,6 +2435,8 @@ def _run_windows_impl(
     lane_major: bool = False,
     window_razor: bool = True,
     ca_descatter: bool = True,
+    reclaim: bool = False,
+    reclaim_period: int = 1,
     profile=None,
 ):
     """Scan a whole sequence of scheduling-cycle windows on-device (the hot
@@ -2402,6 +2473,8 @@ def _run_windows_impl(
             lane_major=lane_major,
             window_razor=window_razor,
             ca_descatter=ca_descatter,
+            reclaim=reclaim,
+            reclaim_period=reclaim_period,
             profile=profile,
         )
         return new, (
@@ -2569,6 +2642,8 @@ def _run_superspan_impl(
     lane_major: bool = False,
     window_razor: bool = True,
     ca_descatter: bool = True,
+    reclaim: bool = False,
+    reclaim_period: int = 1,
     profile=None,
     W: int = 0,
     K: int = 16,
@@ -2665,6 +2740,8 @@ def _run_superspan_impl(
                 lane_major=lane_major,
                 window_razor=window_razor,
                 ca_descatter=ca_descatter,
+                reclaim=reclaim,
+                reclaim_period=reclaim_period,
                 profile=profile,
             )
             return new, None

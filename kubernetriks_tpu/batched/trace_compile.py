@@ -524,8 +524,95 @@ def compile_from_arrays(
     )
 
 
+def _pad_cols(arr: np.ndarray, lo: int, width: int, fill, dtype) -> np.ndarray:
+    """arr[:, lo:lo+width], right-padded with `fill` — the one padding
+    rule of the staging column layout (see stage_segment)."""
+    C = arr.shape[0]
+    out = np.full((C, width), fill, dtype)
+    src = arr[:, lo : lo + width]
+    out[:, : src.shape[1]] = src
+    return out
+
+
+class PayloadSource:
+    """Provider of the slide/staging PAYLOAD columns (pod requests +
+    durations) for global plain-pod columns [lo, lo + width) — the seam
+    that bounds the engine's steady-state host memory (ROADMAP #2):
+    `segment` returns {"req_cpu", "req_ram", "duration"} (C, width)
+    numpy arrays with the fresh-slot padding past the trace end (request
+    0, duration -1.0 — the long-running-service sentinel the pair
+    conversion encodes). ArrayPayloadSource wraps the resident
+    whole-trace arrays (the build default, O(T) host); FeederPayloadSource
+    materializes only the requested rows from a segment reader
+    (trace.feeder.WorkloadSegmentReader), so after
+    engine.attach_payload_source the resident payload drops to
+    O(stage width) regardless of trace length. Thread-safety contract:
+    `segment` is called from the streaming feeder's producer thread —
+    implementations must be safe for one concurrent reader."""
+
+    total_rows: int  # plain pod columns the source covers
+
+    def segment(self, lo: int, width: int) -> Dict[str, np.ndarray]:
+        raise NotImplementedError
+
+
+class ArrayPayloadSource(PayloadSource):
+    """Whole-trace arrays ({"req_cpu","req_ram","duration"} of shape
+    (C, T)) — the resident default."""
+
+    def __init__(self, full_pods: Dict[str, np.ndarray]) -> None:
+        self.full_pods = full_pods
+        self.total_rows = int(full_pods["req_cpu"].shape[1])
+
+    def segment(self, lo: int, width: int) -> Dict[str, np.ndarray]:
+        full = self.full_pods
+        return {
+            "req_cpu": _pad_cols(full["req_cpu"], lo, width, 0, np.int32),
+            "req_ram": _pad_cols(full["req_ram"], lo, width, 0, np.int32),
+            "duration": _pad_cols(
+                full["duration"], lo, width, -1.0, np.float64
+            ),
+        }
+
+
+class FeederPayloadSource(PayloadSource):
+    """Bounded host payload over a row-range workload reader (native
+    trace.feeder.WorkloadSegmentReader or the python-oracle
+    WorkloadArraysReader): pod slots of a pure-workload trace are
+    assigned in row order, so payload column i IS sorted workload row i,
+    and a segment materializes exactly the requested rows. Conversions
+    mirror compile_from_arrays (int32 millicores, ceil-div RAM
+    quantization, float64 seconds) so a feeder-sourced slab is
+    bit-identical to the resident arrays' slice. The compiled trace must
+    carry no pod groups (group ring slots renumber the payload axis);
+    the engine validates that at attach time."""
+
+    def __init__(self, reader, n_clusters: int, ram_unit: int) -> None:
+        self.reader = reader
+        self.n_clusters = int(n_clusters)
+        self.ram_unit = int(ram_unit)
+        self.total_rows = len(reader)
+
+    def segment(self, lo: int, width: int) -> Dict[str, np.ndarray]:
+        C = self.n_clusters
+        out = {
+            "req_cpu": np.zeros((C, width), np.int32),
+            "req_ram": np.zeros((C, width), np.int32),
+            "duration": np.full((C, width), -1.0, np.float64),
+        }
+        n = max(0, min(width, self.total_rows - lo))
+        if n:
+            wa = self.reader.read(lo, n)
+            out["req_cpu"][:, :n] = wa.cpu_millicores.astype(np.int32)[None, :]
+            out["req_ram"][:, :n] = (
+                -(-wa.ram_bytes // self.ram_unit)
+            ).astype(np.int32)[None, :]
+            out["duration"][:, :n] = wa.duration.astype(np.float64)[None, :]
+        return out
+
+
 def stage_segment(
-    full_pods: Dict[str, np.ndarray],
+    payload,
     create_win: np.ndarray,
     rank_full: Optional[np.ndarray],
     lo: int,
@@ -545,23 +632,20 @@ def stage_segment(
     both assemble through here, so padding rules can never drift apart.
     Duration stays float64 SECONDS here; the caller converts to the device
     pair (duration_pair_np) after padding, exactly like the initial build.
+
+    `payload` is a PayloadSource (or a bare {"req_cpu","req_ram",
+    "duration"} whole-trace dict, wrapped on the fly): the request/
+    duration columns come from it, while the create-window and name-rank
+    tables — small int32 per-pod arrays the engine keeps resident for
+    O(1) capacity lookups — are sliced here.
     """
     no_create = np.iinfo(np.int32).max
     BIG_RANK = np.int32(1 << 30)
 
-    def seg(arr: np.ndarray, fill, dtype) -> np.ndarray:
-        C = arr.shape[0]
-        out = np.full((C, width), fill, dtype)
-        src = arr[:, lo : lo + width]
-        out[:, : src.shape[1]] = src
-        return out
-
-    out = {
-        "req_cpu": seg(full_pods["req_cpu"], 0, np.int32),
-        "req_ram": seg(full_pods["req_ram"], 0, np.int32),
-        "duration": seg(full_pods["duration"], -1.0, np.float64),
-        "create_win": seg(create_win, no_create, np.int32),
-    }
+    if not isinstance(payload, PayloadSource):
+        payload = ArrayPayloadSource(payload)
+    out = payload.segment(lo, width)
+    out["create_win"] = _pad_cols(create_win, lo, width, no_create, np.int32)
     if rank_full is not None:
-        out["rank"] = seg(rank_full, BIG_RANK, np.int32)
+        out["rank"] = _pad_cols(rank_full, lo, width, BIG_RANK, np.int32)
     return out

@@ -127,6 +127,33 @@ _FLAGS = [
         "0 selects the r5 two-sort path for A/B measurement.",
     ),
     Flag(
+        "KTPU_RECLAIM",
+        "tristate",
+        None,
+        "CA slot reclaim (batched/autoscale.py ca_reclaim_pass): a "
+        "periodic in-trace compaction returns fully-retired CA reserve "
+        "slots to their group, so ca_cursor tracks LIVE occupancy and "
+        "sustained churn never exhausts the reserve (the ROADMAP #2 "
+        "endurance blocker). Trajectories stay scalar-exact: allocations "
+        "carry the scalar's total_allocated naming index and every "
+        "name-ordered walk derives its order from it. 0 compiles the "
+        "pre-reclaim programs (the A/B bit-identity gate; the loud "
+        "reserve bound is then the only backstop). Unset: on for "
+        "accelerator backends, off on CPU hosts — tests and endurance "
+        "runs opt in explicitly. Forced off (warning) when the trace's "
+        "node-name classes interleave; an explicit 1 raises there.",
+    ),
+    Flag(
+        "KTPU_RECLAIM_PERIOD",
+        "int",
+        1,
+        "Reclaim compaction cadence in windows: 1 (default) compacts in "
+        "any window with a retired slot (a scale-up can then never "
+        "starve while reclaimable slots exist); larger values batch the "
+        "compaction's (C, P) retirement-safety sweep to every Nth "
+        "window, trading a transiently tighter reserve for less work.",
+    ),
+    Flag(
         "KTPU_ALIGN_PODS",
         "bool",
         True,

@@ -291,6 +291,11 @@ def rollout(
                 st, auto, autoscale_statics, w_arr, consts,
                 max_ca_pods_per_cycle, max_pods_per_scale_down,
                 pre=pre_cycle,
+                # Reclaim-armed states (ca_alloc present — the accelerator
+                # KTPU_RECLAIM default) must stamp allocation indices at
+                # scale-up, or the cursor drifts past the ca_alloc>=0
+                # prefix and a later compaction under-counts occupancy.
+                reclaim=auto.ca_alloc is not None,
             )
             st = st._replace(auto=auto)
         return (st, rng), transition

@@ -7,9 +7,11 @@ makes the two things that actually kill a long run visible *before* they
 do:
 
 - **Reserve occupancy.** The batched path consumes bounded reserves that
-  churn can exhaust (ROADMAP #2): the CA node-slot reserve (`ca_cursor`
-  is monotone — slots are never reclaimed), the HPA pod-group slot
-  reserve, and the sliding pod window's plain-trace headroom. The window
+  churn can exhaust (ROADMAP #2): the CA node-slot reserve (`ca_cursor`:
+  LIVE occupancy under slot reclaim (KTPU_RECLAIM), where compaction
+  pulls it back and the watchdog fits the NET slope; monotone cumulative
+  allocations without reclaim), the HPA pod-group slot reserve, and the
+  sliding pod window's plain-trace headroom. The window
   body appends these as gauge columns of the device telemetry ring
   (batched/state.py TELEM_HPA_RESERVE / TELEM_CA_RESERVE /
   TELEM_POD_HEADROOM), so they ride the existing per-window record
@@ -154,6 +156,7 @@ class Observatory:
         watchdog: bool = True,
         warn_frac: float = 0.8,
         min_frac: float = 0.3,
+        recover_frac: Optional[float] = None,
         horizon_s: Optional[float] = None,
         min_points: int = 4,
         fit_window: int = 64,
@@ -165,6 +168,15 @@ class Observatory:
         self.watchdog = bool(watchdog)
         self.warn_frac = float(warn_frac)
         self.min_frac = float(min_frac)
+        # Hysteresis floor for clearing a fired reserve verdict (reserve
+        # occupancy is non-monotone under slot reclaim): recover when
+        # every lane is at or below this fraction with no near-horizon
+        # trajectory. Default: half the warning fraction.
+        self.recover_frac = (
+            float(recover_frac)
+            if recover_frac is not None
+            else self.warn_frac / 2.0
+        )
         self.horizon_s = (
             float(horizon_s) if horizon_s is not None else 500.0 * self.interval
         )
@@ -223,12 +235,18 @@ class Observatory:
 
     # -- watchdog -----------------------------------------------------------
 
-    def _warn(self, kind: str, message: str, **info) -> Dict:
+    def _event(self, kind: str, message: str, **info) -> Dict:
+        """Record a watchdog event (bounded trail) WITHOUT warning —
+        recoveries are good news; verdicts go through _warn."""
         event = {"kind": kind, "window": self._last_window, "message": message}
         event.update(info)
         self.events.append(event)
         if len(self.events) > self.max_events:
             del self.events[: len(self.events) - self.max_events]
+        return event
+
+    def _warn(self, kind: str, message: str, **info) -> Dict:
+        event = self._event(kind, message, **info)
         self.fired.setdefault(kind, self._last_window)
         warnings.warn(message, SaturationWarning, stacklevel=3)
         return event
@@ -241,6 +259,17 @@ class Observatory:
         ys = np.stack([p[idx] for p in self._points], axis=0)  # (n, C)
         slopes = fit_slope(xs, ys)  # (C,) per sim-second
         now = ys[-1]
+        # Non-monotone-gauge semantics (r14): under slot reclaim the
+        # occupancy oscillates 0 -> peak -> 0 per churn cycle, and a
+        # least-squares fit over a partial cycle reads the up-ramp as a
+        # trend with a finite eta. The eta branch therefore also requires
+        # the window MINIMUM to sit above the firing floor — a reserve
+        # that fully drained inside the fit window is being recycled, not
+        # leaked, while a genuine leak ratchets the minimum up until the
+        # branch re-arms. The frac >= warn_frac branch stays
+        # unconditional: 80% occupancy NOW is worth a verdict regardless
+        # of trajectory shape.
+        mins = ys.min(axis=0)
         # Worst cluster = smallest ETA, higher occupancy fraction as the
         # tie-break: with several flat-trajectory lanes past warn_frac
         # (eta = inf for all of them), the verdict must name the MOST
@@ -255,7 +284,9 @@ class Observatory:
             frac = float(now[c]) / cap
             eta = time_to_exhaustion(float(now[c]), float(slopes[c]), cap)
             if frac >= self.warn_frac or (
-                frac >= self.min_frac and eta <= self.horizon_s
+                frac >= self.min_frac
+                and float(mins[c]) / cap >= self.min_frac
+                and eta <= self.horizon_s
             ):
                 key = (eta, -frac)
                 if worst_key is None or key < worst_key:
@@ -283,6 +314,30 @@ class Observatory:
                     eta_s=None if math.isinf(eta) else round(eta, 1),
                 )
             )
+        elif name in self.fired:
+            # Recovery (reclaim-era semantics): reserve occupancy is
+            # NON-monotone under slot reclaim, so a previously-fired
+            # verdict must CLEAR once every lane drops below the
+            # hysteresis fraction with no near-horizon trajectory — a
+            # later saturation then re-fires (recover -> re-warn cycle)
+            # instead of the first verdict shadowing the whole run.
+            worst_frac = 0.0
+            for c in range(now.shape[0]):
+                cap = float(caps[c]) if c < len(caps) else 0.0
+                if cap > 0.0:
+                    worst_frac = max(worst_frac, float(now[c]) / cap)
+            if worst_frac <= self.recover_frac:
+                del self.fired[name]
+                warnings_out.append(
+                    self._event(
+                        f"{name}_recovered",
+                        f"saturation watchdog: {name} recovered — "
+                        f"occupancy down to {worst_frac:.0%} of the "
+                        "reserve on every lane (slot reclaim / churn "
+                        "trough); the verdict re-arms",
+                        frac=round(worst_frac, 4),
+                    )
+                )
 
     def _check_headroom(self, warnings_out: list) -> None:
         # One verdict per run: approaching the trace end is expected and

@@ -282,6 +282,35 @@ class WorkloadSegmentReader:
             pass
 
 
+class WorkloadArraysReader:
+    """Python-oracle random-access reader over a materialized
+    WorkloadArrays: the same (lo, n) -> WorkloadArrays contract as
+    WorkloadSegmentReader.read, for callers (payload sources, tests)
+    that need row ranges without the native toolchain. Views, no copies."""
+
+    def __init__(self, arrays: WorkloadArrays) -> None:
+        self.arrays = arrays
+        self._count = len(arrays.start_ts)
+
+    def __len__(self) -> int:
+        return self._count
+
+    def read(self, lo: int, n: int) -> WorkloadArrays:
+        if lo < 0:
+            raise ValueError(f"segment lo must be >= 0, got {lo}")
+        hi = min(lo + max(n, 0), self._count)
+        a = self.arrays
+        return WorkloadArrays(
+            start_ts=a.start_ts[lo:hi],
+            cpu_millicores=a.cpu_millicores[lo:hi],
+            ram_bytes=a.ram_bytes[lo:hi],
+            duration=a.duration[lo:hi],
+            job_id=a.job_id[lo:hi],
+            task_id=a.task_id[lo:hi],
+            pod_no=a.pod_no[lo:hi],
+        )
+
+
 def iter_workload_segments(
     arrays: WorkloadArrays, rows_per_segment: int
 ):

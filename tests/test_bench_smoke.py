@@ -1,13 +1,16 @@
 """bench.py --smoke: the CPU-safe plumbing check for the tracked bench
 lines (continuity shape, composed flagship, superspan machinery,
-streaming feeder, north-star stand-in). Asserts every line builds, RUNS
-its full machinery — the composed lines include real window slides, HPA
-scale-ups and CA provisioning, the same in-bench asserts the flagship
-line enforces on hardware; the superspan line additionally asserts the
-SCANNED executor dispatched (so CI catches a silent fallback to the
-ladder path), and the streaming line asserts the FEEDER ring staged the
-run (so CI catches a silent fallback to whole-trace staging) — and
-emits parseable JSON with the headline fields. Composed lines time >= 5
+streaming feeder, endurance churn, north-star stand-in). Asserts every
+line builds, RUNS its full machinery — the composed lines include real
+window slides, HPA scale-ups and CA provisioning, the same in-bench
+asserts the flagship line enforces on hardware; the superspan line
+additionally asserts the SCANNED executor dispatched (so CI catches a
+silent fallback to the ladder path), the streaming line asserts the
+FEEDER ring staged the run (so CI catches a silent fallback to
+whole-trace staging), and the endurance line asserts CA slot RECLAIM
+fired with flat RSS/slab watermarks and zero recompiles (so CI catches
+a reclaim regression before the slow endurance gate does) — and emits
+parseable JSON with the headline fields. Composed lines time >= 5
 repeated spans and carry the median + min/max spread. Values are not
 performance numbers; tier-1 runs this under JAX_PLATFORMS=cpu (conftest
 pins it)."""
@@ -35,7 +38,7 @@ def _smoke_records(capsys, args):
             assert set(rec) == {"metric", "value", "unit", "sweep"}
             assert rec["value"] > 0
             continue
-        assert set(rec) - {"spans", "telemetry"} == {
+        assert set(rec) - {"spans", "telemetry", "endurance"} == {
             "metric", "value", "unit", "vs_baseline",
         }
         assert rec["unit"] == "decisions/s"
@@ -46,7 +49,7 @@ def _smoke_records(capsys, args):
     return records
 
 
-def test_bench_smoke_emits_seven_parseable_lines(capsys, tmp_path, monkeypatch):
+def test_bench_smoke_emits_eight_parseable_lines(capsys, tmp_path, monkeypatch):
     # --trace rides along (the CI smoke job runs it this way): the
     # composed lines must carry the flight-recorder summary AND write a
     # Perfetto-loadable Chrome trace per traced line.
@@ -54,25 +57,44 @@ def test_bench_smoke_emits_seven_parseable_lines(capsys, tmp_path, monkeypatch):
     monkeypatch.setenv("KTPU_METRICS_PATH", str(tmp_path / "ktpu_metrics"))
     monkeypatch.setenv("KTPU_SWEEP_PATH", str(tmp_path / "ktpu_sweep"))
     records = _smoke_records(capsys, ["--smoke", "--trace"])
-    assert len(records) == 7, records
+    assert len(records) == 8, records
     # Line order is part of the contract: continuity, composed, superspan
-    # machinery, streaming feeder, compiled profile, north-star, scenario
-    # fleet (the sweep runs LAST: its cold-process baseline clears the
-    # jit caches, which would cold-start anything after it).
+    # machinery, streaming feeder, endurance churn, compiled profile,
+    # north-star, scenario fleet (the sweep runs LAST: its cold-process
+    # baseline clears the jit caches, which would cold-start anything
+    # after it).
     assert "composed" in records[1]["metric"]
     assert "superspan" in records[2]["metric"]
     assert "streaming" in records[3]["metric"]
+    assert "endurance churn" in records[4]["metric"]
     # The compiled-profile line ran under the second (best_fit) scheduler
     # profile — its in-bench asserts fail loudly when the engine silently
     # falls back to the default pipeline, so its presence IS the gate.
-    assert "best_fit profile" in records[4]["metric"]
-    assert "north-star" in records[5]["metric"]
-    assert "scenario-vector fleet" in records[6]["metric"]
+    assert "best_fit profile" in records[5]["metric"]
+    assert "north-star" in records[6]["metric"]
+    assert "scenario-vector fleet" in records[7]["metric"]
+    # The ENDURANCE line (r14): run_endurance's in-bench gates (reclaim
+    # actually fired, flat RSS/slab watermarks, zero recompiles after
+    # warm-up, no reserve saturation verdict) already ran — the record's
+    # endurance block discloses what was checked; pin the disclosure so a
+    # gate that silently stops running fails here.
+    endur = records[4]["endurance"]
+    assert endur["allocations"] >= 3 * endur["reserve_slots"]
+    assert endur["reclaimed"] >= endur["allocations"] - endur["reserve_slots"]
+    assert endur["recompiles_after_warmup"] == 0
+    # Reserve verdicts are the hard gate inside run_endurance; pipeline
+    # verdicts (feeder stalls at toy shapes) are disclosed, not asserted.
+    assert not any(
+        k.endswith("_reserve_used") for k in endur["watchdog_fired"]
+    )
+    assert endur["rss_end_mb"] <= endur["rss_after_warm_mb"] * 1.5 + 256
+    assert records[4]["spans"]["n"] >= 4
+    assert records[4]["spans"]["min"] > 0
     # The scenario-fleet line: its in-bench asserts (zero recompiles
     # after warm-up, no lane cross-talk on the duplicate-scenario probes)
     # already ran inside run_sweep — the record's sweep block discloses
     # what was checked, and the JSON artifact landed for CI upload.
-    sweep = records[6]["sweep"]
+    sweep = records[7]["sweep"]
     assert sweep["scenarios"] == 8 and sweep["lanes"] == 4
     assert sweep["waves"] == 2
     assert sweep["recompiles_after_warmup"] == 0
@@ -94,12 +116,15 @@ def test_bench_smoke_emits_seven_parseable_lines(capsys, tmp_path, monkeypatch):
         # committed decisions — spans.min == 0 can no longer happen.
         assert spans["dropped"] >= 0
         assert spans["min"] > 0
-    for rec in (records[0], records[4], records[5]):
+    for rec in (records[0], records[5], records[6]):
         assert "spans" not in rec
     # Telemetry summary embedded in (exactly) the traced composed lines:
     # per-phase wall time, the observed-vs-expected sync budget, dispatch
     # stats with the ladder_fallbacks observable, device-ring totals.
-    for rec in (records[0], records[4], records[5]):
+    # The endurance line (records[4]) writes its trace/metrics artifacts
+    # but keeps the flight-recorder summary out of the record — its
+    # disclosure is the endurance block.
+    for rec in (records[0], records[4], records[5], records[6]):
         assert "telemetry" not in rec
     for rec in records[1:4]:
         tel = rec["telemetry"]
@@ -162,7 +187,9 @@ def test_bench_smoke_emits_seven_parseable_lines(capsys, tmp_path, monkeypatch):
     res = records[3]["telemetry"]["resources"]
     assert res["slabs"]["device_slide_bytes"] == 0
     assert res["slabs"].get("feeder_ring_capacity_bytes", 0) > 0
-    for label in ("smoke_composed", "smoke_superspan", "smoke_stream"):
+    for label in (
+        "smoke_composed", "smoke_superspan", "smoke_stream", "smoke_endurance",
+    ):
         path = tmp_path / f"ktpu_trace_{label}.json"
         assert path.exists(), f"missing Chrome trace {path}"
         doc = json.loads(path.read_text())
@@ -188,7 +215,7 @@ def test_bench_smoke_faults_adds_chaos_line(capsys, tmp_path, monkeypatch):
     --trace rides along so the traced composed lines are jit-cache hits
     from the previous test (same programs); the chaos line itself is
     untraced either way. Slow lane (tier-1 wall-clock budget): the
-    seven-line test covers every line contract including the sweep; this
+    eight-line test covers every line contract including the sweep; this
     variant only adds the chaos line's presence on top of chaos-path
     coverage tier-1 already carries (test_superspan / test_streaming /
     test_soak fault engines, test_chaos)."""
@@ -196,9 +223,9 @@ def test_bench_smoke_faults_adds_chaos_line(capsys, tmp_path, monkeypatch):
     monkeypatch.setenv("KTPU_METRICS_PATH", str(tmp_path / "ktpu_metrics"))
     monkeypatch.setenv("KTPU_SWEEP_PATH", str(tmp_path / "ktpu_sweep"))
     records = _smoke_records(capsys, ["--smoke", "--faults", "--trace"])
-    assert len(records) == 8, records
-    assert "chaos" in records[6]["metric"]
-    assert records[6]["value"] > 0
-    assert records[6]["spans"]["n"] >= 5
-    assert "telemetry" not in records[6]
-    assert "scenario-vector fleet" in records[7]["metric"]
+    assert len(records) == 9, records
+    assert "chaos" in records[7]["metric"]
+    assert records[7]["value"] > 0
+    assert records[7]["spans"]["n"] >= 5
+    assert "telemetry" not in records[7]
+    assert "scenario-vector fleet" in records[8]["metric"]

@@ -251,8 +251,9 @@ def test_heterogeneous_hpa_scan_fleet_matches_independent_builds():
     """Per-lane hpa_scan_interval: every fleet lane is bit-identical to
     an INDEPENDENT scalar-config batched build with that scan interval —
     the vectorized cadence is exactly the scalar-config cadence, lane by
-    lane (the scalar-oracle comparison at non-default scans is blocked
-    by the pre-existing metrics-staleness deviation; see PARITY.md)."""
+    lane (the scalar-ORACLE comparison lives in
+    test_heterogeneous_hpa_scan_fleet_matches_scalar below, unblocked by
+    the r14 collection latch)."""
     scans = [60.0, 30.0, 120.0]
     workload = make_hpa_workload(17)
     base = default_test_simulation_config()
@@ -302,6 +303,75 @@ def test_heterogeneous_hpa_scan_fleet_matches_independent_builds():
     assert len({tuple(t) for t in trajs_fleet}) > 1, (
         "scan intervals did not diverge the trajectories (vacuous)"
     )
+
+
+def test_heterogeneous_hpa_scan_fleet_matches_scalar():
+    """Lane-by-lane SCALAR-oracle equivalence at non-default HPA scan
+    intervals — the case the per-lane scan vectors surfaced and the
+    documented metrics-staleness deviation used to block (PARITY.md): the
+    scalar HPA reads the collector's 60 s sample, not a fresh evaluation
+    at its own tick. With the r14 collection latch (AutoscaleState
+    col_*), every fleet lane's replica trajectory must now equal an
+    independent scalar run at that lane's scan interval — including the
+    same-instant FIFO rule (a scan-120 cycle at a shared collection
+    instant fires BEFORE the collection, its event id is older)."""
+    from kubernetriks_tpu.sim.simulator import KubernetriksSimulation
+
+    scans = [30.0, 90.0, 120.0]
+    workload = make_hpa_workload(17)
+    base = default_test_simulation_config()
+    base.horizontal_pod_autoscaler.enabled = True
+    cluster_ev = GenericClusterTrace.from_yaml(
+        HPA_CLUSTER_TRACE
+    ).convert_to_simulator_events()
+    workload_ev = GenericWorkloadTrace.from_yaml(
+        workload
+    ).convert_to_simulator_events()
+    fleet = build_batched_from_traces(
+        base,
+        cluster_ev,
+        workload_ev,
+        n_clusters=len(scans),
+        scenario=dict(
+            scenario_vectors(
+                base,
+                len(scans),
+                [Scenario(hpa_scan_interval=s) for s in scans],
+            )
+        ),
+    )
+    scalars = []
+    for s in scans:
+        cfg = default_test_simulation_config()
+        cfg.horizontal_pod_autoscaler.enabled = True
+        cfg.horizontal_pod_autoscaler.scan_interval = s
+        sim = KubernetriksSimulation(cfg)
+        sim.initialize(
+            GenericClusterTrace.from_yaml(HPA_CLUSTER_TRACE),
+            GenericWorkloadTrace.from_yaml(workload),
+        )
+        scalars.append(sim)
+
+    trajs_fleet = [[] for _ in scans]
+    trajs_scalar = [[] for _ in scans]
+    for t in np.arange(61.0, 660.0, 30.0):
+        fleet.step_until_time(float(t))
+        for lane, sim in enumerate(scalars):
+            sim.step_until_time(float(t))
+            trajs_fleet[lane].append(fleet.hpa_replicas(lane)["pod_group_1"])
+            trajs_scalar[lane].append(
+                len(
+                    sim.horizontal_pod_autoscaler.pod_groups[
+                        "pod_group_1"
+                    ].created_pods
+                )
+            )
+    for lane, s in enumerate(scans):
+        assert trajs_fleet[lane] == trajs_scalar[lane], (
+            f"lane {lane} (scan {s}):\n"
+            f"scalar {trajs_scalar[lane]}\nfleet  {trajs_fleet[lane]}"
+        )
+        assert len(set(trajs_scalar[lane])) > 1, "trajectory never moved"
 
 
 def test_heterogeneous_ca_fleet_matches_scalar_oracles():

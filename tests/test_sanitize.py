@@ -27,12 +27,18 @@ def _run(sim, ends=(150.0, 300.0, 450.0)):
     return sim
 
 
+@pytest.mark.slow
 def test_sanitized_composed_bit_identical():
-    """Tier-1 sanitizer smoke: one composed span (HPA + CA + superspan +
-    chaos) under the sanitizer on CPU — zero unwaived transfers (the guard
-    would raise), donated inputs consumed after every donated call, finite
-    sweep at each superspan boundary — with results bit-identical to the
-    unsanitized path."""
+    """Sanitizer smoke: one composed span (HPA + CA + superspan + chaos)
+    under the sanitizer on CPU — zero unwaived transfers (the guard would
+    raise), donated inputs consumed after every donated call, finite sweep
+    at each superspan boundary — with results bit-identical to the
+    unsanitized path. Slow lane (tier-1 wall-clock budget): KTPU_SANITIZE
+    is an opt-in debug mode, not a simulation path — the guard-raise /
+    consume-donated / NaN-sweep unit gates below stay tier-1, and the
+    composed machinery itself is covered bit-exactly by test_superspan's
+    chaos-on tier-1 gate; this composed-under-sanitizer variant runs in
+    the slow lane."""
     kwargs = dict(
         config_suffix=FAULT_SUFFIX,
         superspan=True,

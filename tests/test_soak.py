@@ -308,6 +308,48 @@ def test_watchdog_fires_on_rising_reserve_trajectory():
     assert obs.report()["watchdog"]["fired"]["ca_reserve_used"] == 5
 
 
+def test_watchdog_recovers_and_rewarns_after_reclaim():
+    """Non-monotone-gauge semantics (r14): under slot reclaim the reserve
+    occupancy FALLS when retired slots return, so (1) the trajectory fit
+    sees the NET slope — a post-reclaim trough must not keep an old
+    verdict alive — and (2) a previously-fired verdict CLEARS below the
+    hysteresis fraction (a recovery event on the trail, good news, no
+    warning) and a later saturation RE-fires instead of being shadowed by
+    the first verdict."""
+    obs = Observatory(
+        interval=10.0,
+        capacities={"hpa_reserve": [100], "ca_reserve": [20]},
+        horizon_s=1e6,
+    )
+    obs.ingest(_ring_buf([(w, 0, 17, UNBOUNDED_SENTINEL) for w in range(6)]))
+    with pytest.warns(SaturationWarning, match="ca_reserve_used"):
+        obs.observe()
+    assert "ca_reserve_used" in obs.fired
+    # Reclaim returns the retired slots: occupancy drops to 3/20, below
+    # the recover fraction (warn_frac / 2 by default) — the verdict
+    # clears WITHOUT warning and the recovery lands on the event trail.
+    obs.ingest(
+        _ring_buf([(6 + w, 0, 3, UNBOUNDED_SENTINEL) for w in range(6)])
+    )
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        rec = obs.observe()
+    assert not [x for x in w if issubclass(x.category, SaturationWarning)]
+    assert "ca_reserve_used" not in obs.fired
+    recovered = [
+        e for e in rec["watchdog"] if e["kind"] == "ca_reserve_used_recovered"
+    ]
+    assert recovered and recovered[-1]["frac"] == pytest.approx(3 / 20)
+    # The next churn peak saturates the reserve again: the re-armed
+    # verdict RE-fires (recover -> re-warn cycle).
+    obs.ingest(
+        _ring_buf([(12 + w, 0, 18, UNBOUNDED_SENTINEL) for w in range(6)])
+    )
+    with pytest.warns(SaturationWarning, match="ca_reserve_used"):
+        obs.observe()
+    assert "ca_reserve_used" in obs.fired
+
+
 def test_watchdog_flat_tie_names_most_saturated_cluster():
     """Two lanes both past warn_frac with FLAT trajectories (eta = inf for
     both): the verdict must name the more saturated lane, not the lower

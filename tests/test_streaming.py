@@ -299,6 +299,130 @@ def test_streaming_long_trace_bounded_memory_vs_scalar_oracle():
     sim.close()
 
 
+def test_payload_source_seam_releases_host_arrays_bit_identical():
+    """Host O(T) bound (r14, ROADMAP #2): attach_payload_source swaps the
+    resident whole-trace request/duration arrays for a bounded
+    segment-at-a-time source (trace.feeder reader contract) and RELEASES
+    them. The feeder-sourced run must be BIT-identical to the resident
+    run — FeederPayloadSource mirrors compile_from_arrays' conversions
+    (int32 millicores, ceil-div RAM quantization, float64 seconds), so a
+    staged slab cannot differ — and host_payload_bytes must drop by the
+    released arrays' size while the small int32 tables stay disclosed."""
+    from kubernetriks_tpu.batched.engine import build_batched_from_traces
+    from kubernetriks_tpu.batched.trace_compile import FeederPayloadSource
+    from kubernetriks_tpu.test_util import default_test_simulation_config
+    from kubernetriks_tpu.trace.feeder import WorkloadArrays, WorkloadArraysReader
+    from kubernetriks_tpu.trace.generator import UniformClusterTrace
+    from kubernetriks_tpu.trace.generic import GenericWorkloadTrace
+
+    N_PODS, END = 220, 520.0
+    specs = [
+        (1.0 + i, 100 + (i % 4) * 50, (100 + (i % 3) * 37) * 1024**2,
+         20.0 + (i % 5) * 5.0)
+        for i in range(N_PODS)
+    ]
+
+    def workload_yaml():
+        return GenericWorkloadTrace.from_yaml(
+            "events:"
+            + "".join(
+                f"""
+- timestamp: {ts}
+  event_type:
+    !CreatePod
+      pod:
+        metadata:
+          name: pod_{i:04d}
+        spec:
+          resources:
+            requests: {{cpu: {cpu}, ram: {ram}}}
+            limits: {{cpu: {cpu}, ram: {ram}}}
+          running_duration: {dur}
+"""
+                for i, (ts, cpu, ram, dur) in enumerate(specs)
+            )
+        )
+
+    def build():
+        return build_batched_from_traces(
+            default_test_simulation_config(),
+            UniformClusterTrace(6, cpu=16000, ram=32 * 1024**3)
+            .convert_to_simulator_events(),
+            workload_yaml().convert_to_simulator_events(),
+            n_clusters=1,
+            max_pods_per_cycle=16,
+            pod_window=64,
+            fast_forward=False,
+            superspan=True,
+            superspan_k=8,
+            superspan_chunk=4,
+            stream=True,
+            stream_segment=96,
+            stream_depth=2,
+        )
+
+    resident = build()
+    fed = build()
+    # The reader rows ARE the payload columns of a pure-workload trace
+    # (pod slots assigned in row order); WorkloadArraysReader is the
+    # python-oracle stand-in for the native WorkloadSegmentReader.
+    rows = sorted(specs, key=lambda s: s[0])
+    arrays = WorkloadArrays(
+        start_ts=np.asarray([r[0] for r in rows], np.float64),
+        cpu_millicores=np.asarray([r[1] for r in rows], np.int64),
+        ram_bytes=np.asarray([r[2] for r in rows], np.int64),
+        duration=np.asarray([r[3] for r in rows], np.float64),
+        job_id=np.full(N_PODS, -1, np.int64),
+        task_id=np.zeros(N_PODS, np.int64),
+        pod_no=np.arange(N_PODS, dtype=np.int64),
+    )
+    before = fed._slab_accounting()["host_payload_bytes"]
+    fed.attach_payload_source(
+        FeederPayloadSource(
+            WorkloadArraysReader(arrays), n_clusters=1, ram_unit=fed.ram_unit
+        )
+    )
+    after = fed._slab_accounting()["host_payload_bytes"]
+    assert fed._full_pods is None, "resident payload arrays must be released"
+    assert after < before, (before, after)
+
+    # The attach-time fidelity gate: a source whose rows disagree with the
+    # compiled payload (wrong trace, broken conversions, or a single
+    # workload broadcast onto a heterogeneous fleet) must raise LOUDLY
+    # before anything is released — silent wrong trajectories are the
+    # failure mode the gate exists for. The failed attach must leave the
+    # engine on its previous (verified) source.
+    bad = WorkloadArrays(
+        start_ts=arrays.start_ts,
+        cpu_millicores=arrays.cpu_millicores,
+        ram_bytes=arrays.ram_bytes,
+        duration=arrays.duration + np.float64(1.0),
+        job_id=arrays.job_id,
+        task_id=arrays.task_id,
+        pod_no=arrays.pod_no,
+    )
+    with pytest.raises(ValueError, match="disagrees with the compiled"):
+        fed.attach_payload_source(
+            FeederPayloadSource(
+                WorkloadArraysReader(bad), n_clusters=1, ram_unit=fed.ram_unit
+            )
+        )
+
+    resident.step_until_time(END)
+    fed.step_until_time(END)
+    _assert_streamed(resident)
+    _assert_streamed(fed)
+    assert fed.dispatch_stats == resident.dispatch_stats
+    mismatches = compare_states(
+        strip_telemetry(resident.state), strip_telemetry(fed.state)
+    )
+    assert mismatches == [], mismatches
+    # The seam really fed segments (not a vacuous pass-through).
+    assert fed.dispatch_stats["stage_refills"] >= 3
+    resident.close()
+    fed.close()
+
+
 # --- unit-level ring semantics (fake slabs, no jax) -----------------------
 
 

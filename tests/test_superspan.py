@@ -190,13 +190,19 @@ def test_superspan_composed_bit_identical_under_faults(tmp_path):
     validate_chrome_trace(path, expect_flows=True)
 
 
+@pytest.mark.slow
 def test_superspan_bounded_stage_and_exhaustion_exit(monkeypatch):
     """Over-budget traces stage refill columns through bounded RefillStage
     slabs. A minimal-width stage (W + W/2) exhausts after a single max
     slide, forcing SUPERSPAN_STAGE exits and restages mid-run — the end
     state must still match the ladder, and the engine must never spin on an
     exhausted buffer (the regression this test pins: _stage_covers accepts
-    a stage with zero slide headroom left)."""
+    a stage with zero slide headroom left). Slow lane (tier-1 wall-clock
+    budget): restage-under-exhaustion coverage stays tier-1 through
+    test_superspan_capacity_edge_restages_instead_of_growing (the exact
+    zero-headroom edge) and test_streaming's run-ahead-restage / K=1-ring
+    / demand-mode gates over the same stage machinery; this ladder-parity
+    variant remains for diagnosis when those trip."""
     monkeypatch.setattr(engine_mod, "_DEVICE_SLIDE_BUDGET_BYTES", 0)
     ss = _build_composed(
         superspan=True,
@@ -242,10 +248,15 @@ def test_superspan_grow_exit_matches_resident():
     )
 
 
+@pytest.mark.slow
 def test_precompile_warms_superspan_program():
     """A superspan engine warms exactly ONE program shape (the scanned loop
     serves every span/target); the warm dispatch must not perturb state or
-    host mirrors."""
+    host mirrors. Slow lane (tier-1 wall-clock budget): warm-up plumbing,
+    not simulation semantics — a precompile regression that let the
+    superspan fall back to the ladder fails tier-1 loudly anyway via
+    test_bench_smoke's superspan line (in-bench scanned-executor assert)
+    and the dispatch-count gate in test_window_donation_dispatch."""
     ss = _build_composed(superspan=True, superspan_k=4, superspan_chunk=4)
     before = (ss.next_window_idx, ss._pod_base)
     snap = {
