@@ -614,6 +614,16 @@ def run_endurance(
         + _endurance_churn_events(n_waves, spacing),
         key=lambda e: e[0],
     )
+    # Recompile sentinel (KTPU_EXPLAIN_RECOMPILES): names the jit entry
+    # if anything compiles in the measured region; the cache-count
+    # equality assert below stays as the count-level cross-check.
+    from kubernetriks_tpu.recompile import RecompileSentinel, sentinel_mode
+
+    sentinel = (
+        RecompileSentinel("raise").install()
+        if sentinel_mode() is not False
+        else None
+    )
     sim = build_batched_from_traces(
         config,
         cluster.convert_to_simulator_events(),
@@ -669,6 +679,8 @@ def run_endurance(
             "half — raise rate_per_second or shrink pod_window"
         )
     cache_after_warm = jit_cache_sizes()
+    if sentinel is not None:
+        sentinel.seal("endurance warm-up (build + first churn waves)")
     rss_after_warm = sim._sample_resources()["rss_bytes"]
 
     # One timed span per remaining wave (each span carries plain load
@@ -737,6 +749,10 @@ def run_endurance(
         "endurance bench: dispatch-loop jit entries recompiled after "
         f"warm-up: {cache_after_warm} -> {jit_cache_sizes()}"
     )
+    if sentinel is not None:
+        # Names the entry where the count diff above can only count.
+        sentinel.check("the endurance measured region")
+        sentinel.uninstall()
     rss_end = sim._sample_resources()["rss_bytes"]
     assert rss_end < rss_after_warm * 1.5 + 256e6, (
         "endurance bench: host RSS trended after warm-up "
@@ -954,6 +970,19 @@ cluster_autoscaler:
     )
     scenarios, probe_positions = _sweep_scenarios(n_scenarios)
 
+    # Recompile sentinel: the in-bench zero-recompile assert below
+    # compares jit-cache COUNTS; the sentinel additionally NAMES the
+    # entry on any post-warm-up compilation (KTPU_EXPLAIN_RECOMPILES=0
+    # disarms it; unset arms it here, where the contract is the line's
+    # whole point).
+    from kubernetriks_tpu.recompile import RecompileSentinel, sentinel_mode
+
+    sentinel = (
+        RecompileSentinel("raise").install()
+        if sentinel_mode() is not False
+        else None
+    )
+
     # --- the fleet: ONE engine, N scenarios as per-lane config data -----
     t0 = _time.perf_counter()
     fleet = ScenarioFleet(
@@ -973,11 +1002,20 @@ cluster_autoscaler:
     ]
     fleet._run_wave(first_wave)
     sizes_after_warm = jit_cache_sizes()
+    if sentinel is not None:
+        sentinel.seal("sweep warm-up (build + first wave)")
     fleet.run()
     fleet_s = _time.perf_counter() - t0
     sizes_after_sweep = jit_cache_sizes()
     results = [fleet.results[q] for q in qids]
     fleet.close()
+    sentinel_events = 0
+    if sentinel is not None:
+        # In-bench assert: raises RecompileError NAMING the jit entry if
+        # anything compiled during the post-warm-up query stream.
+        sentinel.check("the --sweep post-warm-up query stream")
+        sentinel_events = len(sentinel.post_seal_events())
+        sentinel.uninstall()
 
     recompiled = {
         name: (sizes_after_sweep[name], sizes_after_warm[name])
@@ -1065,6 +1103,10 @@ cluster_autoscaler:
             },
             "speedup": round(speedup, 2),
             "recompiles_after_warmup": 0,
+            "recompile_sentinel": {
+                "armed": sentinel is not None,
+                "post_warmup_events": sentinel_events,
+            },
             "crosstalk_probes": probe_positions,
             "decisions_total": int(decisions),
         },

@@ -96,6 +96,28 @@ from kubernetriks_tpu.telemetry.tracer import (
 # Above this, the engine keeps the host slide path (payloads stay in RAM).
 _DEVICE_SLIDE_BUDGET_BYTES = 2 << 30
 
+# Checkpoint-meta coverage of the STRUCTURAL state leaves (= None default:
+# their presence is part of the compiled program's identity, so a restore
+# into a template missing them dies deep inside orbax). The stateleaf
+# lint pass proves every structural ClusterBatchState/AutoscaleState leaf
+# has an entry here — the value is the coverage story save_checkpoint /
+# load_checkpoint implement (see those methods' guards).
+CKPT_COVERED_LEAVES = {
+    "auto": "presence derived from config at build; the restoring engine's "
+    "own state template supplies the structure (same-config contract)",
+    "telemetry": "meta['telemetry_ring'] + the armed/unarmed ring-size "
+    "guard in load_checkpoint (both directions, meta-absent included)",
+    "ca_alloc": "meta['reclaim'] — the follow-or-raise reclaim guard "
+    "rebuilds/drops the leaf to match the checkpoint",
+    "ca_total": "meta['reclaim'] (see ca_alloc)",
+    "ca_reclaimed": "meta['reclaim'] (see ca_alloc)",
+    "col_next": "config-derived: the collection latch arms exactly when "
+    "real pod groups exist, so a same-config restore template matches",
+    "col_run": "config-derived (see col_next)",
+    "col_util_cpu": "config-derived (see col_next)",
+    "col_util_ram": "config-derived (see col_next)",
+}
+
 # Power-of-two dispatch chunk ladder for the sliding path: any span is its
 # binary decomposition (popcount(span) dispatches), and at most this many
 # program shapes ever compile (engine.step_until_time; precompile_chunks
@@ -3064,7 +3086,7 @@ class BatchedSimulation:
         "maximum",
     )
 
-    def _check_finite(self) -> None:  # ktpu: sync-ok(guard-mode state sweep: KTPU_DEBUG_FINITE / KTPU_SANITIZE readback, off on the production hot path)
+    def _check_finite(self) -> None:
         """KTPU_DEBUG_FINITE=1 guard mode: sweep every float leaf of the
         state after a dispatched chunk — NaN anywhere, or inf outside the
         documented sentinel fields, raises with the offending field name.

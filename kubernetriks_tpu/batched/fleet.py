@@ -418,6 +418,15 @@ class ScenarioFleet:
         # zero-recompiles-after-warm-up capture covers every program the
         # steady query stream can touch.
         self.engine.fleet_reset(lanes=[])
+        # KTPU_EXPLAIN_RECOMPILES=1: guard every post-warm-up wave with
+        # the recompile sentinel — the runtime cross-check of the
+        # scenariotrace lint pass's static compile-once guarantee. Wave 1
+        # is warm-up (the window/superspan programs legitimately compile
+        # there); any compilation inside a later wave raises, naming the
+        # jit entry.
+        from kubernetriks_tpu.recompile import maybe_sentinel
+
+        self._sentinel = maybe_sentinel()
 
     # -- query intake --------------------------------------------------------
 
@@ -493,6 +502,15 @@ class ScenarioFleet:
         )
 
     def _run_wave(self, wave) -> None:
+        if self._sentinel is not None and self.waves_run >= 1:
+            with self._sentinel.expect_none(
+                f"fleet wave {self.waves_run + 1} (post-warm-up)"
+            ):
+                self._run_wave_inner(wave)
+        else:
+            self._run_wave_inner(wave)
+
+    def _run_wave_inner(self, wave) -> None:
         eng = self.engine
         # Install the wave's per-lane config rows: base values everywhere,
         # each assigned lane's overrides on top. Idle lanes run the base
@@ -542,4 +560,7 @@ class ScenarioFleet:
         return [self.results[q] for q in qids]
 
     def close(self) -> None:
+        if self._sentinel is not None:
+            self._sentinel.uninstall()
+            self._sentinel = None
         self.engine.close()

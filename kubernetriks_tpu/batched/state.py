@@ -529,6 +529,98 @@ def swap_node_layout(state: "ClusterBatchState") -> "ClusterBatchState":
     )
 
 
+# --- state-leaf & axis registries (ktpu-lint contract-prover passes) ---------
+# THE "how to add a state leaf" anchor (DESIGN §7.7): the stateleaf lint
+# pass proves these manifests equal the NamedTuple fields exactly, so a
+# new leaf that skips the checklist fails at commit time, naming the
+# registry it missed. Checklist for a new ClusterBatchState/AutoscaleState
+# leaf: (1) it rides the pytree (fleet lane resets, checkpoints,
+# compare_states and the sanitizer then cover it automatically — the
+# PR 14 reclaim-counter lesson); (2) structural (= None default) leaves
+# record their coverage story in engine.CKPT_COVERED_LEAVES; (3)
+# allocation-index leaves are documented in DESIGN §12; (4) add the name
+# here (and its axis signature below if it is per-cluster-shaped).
+CLUSTER_STATE_LEAVES = (
+    "time",
+    "queue_seq_counter",
+    "event_cursor",
+    "pod_base",
+    "last_flush_win",
+    "requeue_signal",
+    "nodes",
+    "pods",
+    "metrics",
+    "auto",
+    "telemetry",
+)
+TELEMETRY_RING_LEAVES = ("buf", "cursor")
+
+# StepConstants leaves that are per-lane TRACED scenario data (the
+# scenariotrace lint pass forbids them from flowing into Python control
+# flow, host casts, jit statics or shape expressions — the fleet's
+# compile-once guarantee; `is None` presence checks stay legal).
+SCENARIO_TRACED_CONSTS = ("fault_seed",)
+
+# Declared axis signatures of state leaves (the shapecontract lint pass):
+# "C" = per-cluster lane vector, "C,P"/"C,N" = per-object planes, "C,*" =
+# leading-C with an unspecified second axis (PodArrays (C, P) vs
+# RefillStage (C, L) share these names), "@node" = the lane-major hot
+# node leaves (NODE_HOT_LEAVES below: (C, N) at rest, (N, C) inside
+# lane-major programs — mixes with (C,) lane vectors must go through the
+# axis-parameterized helpers, never a bare broadcast).
+AXIS_SIGNATURES = {
+    "time": "C",
+    "queue_seq_counter": "C",
+    "event_cursor": "C",
+    "pod_base": "C",
+    "last_flush_win": "C",
+    "requeue_signal": "C",
+    # PodArrays
+    "phase": "C,P",
+    "req_cpu": "C,*",
+    "req_ram": "C,*",
+    "duration": "C,P",
+    "queue_ts": "C,P",
+    "queue_seq": "C,P",
+    "initial_attempt_ts": "C,P",
+    "attempts": "C,P",
+    "hpa_idx": "C,P",
+    "restarts": "C,P",
+    "will_fail": "C,P",
+    "start_time": "C,P",
+    "finish_time": "C,P",
+    "removal_time": "C,P",
+    # NodeArrays: pending-effect pairs stay row-major by contract; the
+    # hot leaves are lane-major-ambiguous inside window programs.
+    "create_time": "C,N",
+    "remove_time": "C,N",
+    "alive": "@node",
+    "cap_cpu": "@node",
+    "cap_ram": "@node",
+    "alloc_cpu": "@node",
+    "alloc_ram": "@node",
+    "crash_downtime": "@node",
+    # MetricArrays per-cluster counters
+    "pods_succeeded": "C",
+    "pods_removed": "C",
+    "terminated_pods": "C",
+    "processed_nodes": "C",
+    "scheduling_decisions": "C",
+    "scaled_up_pods": "C",
+    "scaled_down_pods": "C",
+    "scaled_up_nodes": "C",
+    "scaled_down_nodes": "C",
+    "hpa_reserve_clamped": "C",
+    "ca_reserve_starved": "C",
+    "node_crashes": "C",
+    "node_recoveries": "C",
+    "node_downtime_s": "C",
+    "pod_interruptions": "C",
+    "pod_restarts": "C",
+    "pods_failed": "C",
+}
+
+
 @jax.jit
 def tree_copy(tree):
     """Fresh device buffers carrying the inputs' shardings (jit outputs

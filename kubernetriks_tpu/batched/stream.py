@@ -1,4 +1,5 @@
 # ktpu: hot-path
+# ktpu: threaded
 """Streaming trace-ingestion pipeline: a bounded-memory feeder for the
 superspan executor's staging slabs.
 
@@ -248,7 +249,9 @@ class StreamFeeder:
                 # (the upload-wait half of the stall split).
                 if self._settle is not None:
                     self._settle(slot.stage)
-                    self.settle_ns += time.perf_counter_ns() - t2
+                    settle_ns = time.perf_counter_ns() - t2
+                    with self._cond:
+                        self.settle_ns += settle_ns
                 slot.ready.set()
                 if done:
                     return

@@ -201,6 +201,19 @@ _FLAGS = [
         "config's scheduler_profile, else the reference default.",
     ),
     Flag(
+        "KTPU_EXPLAIN_RECOMPILES",
+        "tristate",
+        None,
+        "Recompile sentinel (kubernetriks_tpu/recompile.py): a "
+        "jax.log_compiles-based monitor that raises RecompileError "
+        "naming the jit entry on any post-warm-up XLA compilation — the "
+        "runtime cross-check of the fleet's compile-once guarantee (the "
+        "scenariotrace lint pass is the static half). Unset: armed only "
+        "by the bench.py --sweep/--endurance in-bench asserts; 1: "
+        "ScenarioFleet guards every post-warm-up wave; 0: forced off "
+        "everywhere, including the benches.",
+    ),
+    Flag(
         "KTPU_TRACE",
         "bool",
         False,

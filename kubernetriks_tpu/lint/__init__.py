@@ -25,12 +25,45 @@ checks; this package turns them into machine-checked AST passes
    KUBERNETRIKS_* name resolves against the central registry
    (`kubernetriks_tpu/flags.py`) and happens inside it.
 
+The contract-prover passes (v2) turn the batched rebuild's CROSS-MODULE
+contracts — enforced until now only by whichever test happened to
+exercise them — into commit-time checks:
+
+6. stateleaf     — every leaf of the state NamedTuples
+   (`ClusterBatchState` / `AutoscaleState` / `TelemetryRing`) is provably
+   handled in each registered consumer (fleet lane reset, checkpoint
+   meta, compare_states, strip_telemetry, sanitize's donated sweep, the
+   DESIGN §12 allocation-index list), by name or by a pytree-generic
+   traversal; a new leaf that misses any registry is an error naming the
+   leaf and the registry (the PR 14 "reclaim counters must ride the
+   pytree" lesson, machine-checked).
+7. scenariotrace — per-lane scenario leaves (`fleet.scenario_leaves`'s
+   composition targets, `StepConstants.fault_seed`) never flow into
+   Python control flow, `int()`/`.item()` casts, jit statics or shape
+   expressions: the fleet's compile-once guarantee, statically.
+8. shapecontract — per-cluster `(C,)` leaves carry declared axis
+   signatures; mixing one with a `(C,G)`/`(C,P)`/node-layout expression
+   without an explicit `[:, None]` / transpose / broadcast is flagged
+   (the PR 13 `tolerance` broadcast bug class, lane-major aware).
+9. feederlock    — in threaded modules (`batched/stream.py`, or a
+   `# ktpu: threaded` pragma), attributes mutated off-thread are only
+   touched under the ring lock/condvar (or sit in an explicit
+   `_LOCK_FREE` handoff list), and blocking waits are forbidden while
+   holding the lock.
+
 Waiver syntax (same line as the violation, or on the `def` line to waive a
-whole function for hostsync): `# ktpu: <pass>-ok(<reason>)` with a
+whole function for hostsync): `# ktpu: <tag>-ok(<reason>)` with a
 non-empty reason, e.g. `# ktpu: sync-ok(async 4-byte shift readback)`.
+Tags: donation, sync, static, prng, flag, leaf, scenario, shape, lock.
+A waiver that no longer suppresses anything is itself reported stale
+(`--strict-waivers` promotes that to an error) — the waiver inventory
+can only shrink with the violations it excuses.
 File pragmas: `# ktpu: hot-path` opts a module into the hostsync pass,
-`# ktpu: sim-path` into the prng pass (the built-in module lists cover the
-real package; pragmas serve the self-test fixtures and future modules).
+`# ktpu: sim-path` into the prng/scenariotrace/shapecontract passes,
+`# ktpu: threaded` into the feederlock pass, and `# ktpu: state-module`
+marks a self-contained state-leaf fixture (classes + consumers in one
+file). The built-in module lists cover the real package; pragmas serve
+the self-test fixtures and future modules.
 """
 
 from __future__ import annotations
@@ -41,7 +74,32 @@ import re
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
-PASS_IDS = ("donation", "hostsync", "jitstatic", "prng", "envflags")
+PASS_IDS = (
+    "donation",
+    "hostsync",
+    "jitstatic",
+    "prng",
+    "envflags",
+    "stateleaf",
+    "scenariotrace",
+    "shapecontract",
+    "feederlock",
+)
+
+# pass id -> waiver tag (`# ktpu: <tag>-ok(reason)`); the reverse map
+# drives stale-waiver detection.
+WAIVER_TAGS: Dict[str, str] = {
+    "donation": "donation",
+    "hostsync": "sync",
+    "jitstatic": "static",
+    "prng": "prng",
+    "envflags": "flag",
+    "stateleaf": "leaf",
+    "scenariotrace": "scenario",
+    "shapecontract": "shape",
+    "feederlock": "lock",
+}
+TAG_TO_PASS: Dict[str, str] = {tag: pid for pid, tag in WAIVER_TAGS.items()}
 
 # Modules whose steady-state dispatch regions are hot: a stray host sync
 # here undoes the dispatch-overhaul work (ROADMAP item 1 — the composed
@@ -72,7 +130,9 @@ DEFAULT_EXCLUDE = ("tests/lint_fixtures/",)
 # parentheses ("(4,)-i32 readback") survive intact; convention is one
 # waiver per line.
 _WAIVER_RE = re.compile(r"#\s*ktpu:\s*([a-z]+)-ok\((.*)\)")
-_PRAGMA_RE = re.compile(r"#\s*ktpu:\s*(hot-path|sim-path)\b")
+_PRAGMA_RE = re.compile(
+    r"#\s*ktpu:\s*(hot-path|sim-path|threaded|state-module)\b"
+)
 
 
 @dataclass(frozen=True)
@@ -84,6 +144,38 @@ class Violation:
 
     def render(self) -> str:
         return f"{self.path}:{self.line}: [{self.pass_id}] {self.message}"
+
+    def as_json(self) -> Dict[str, object]:
+        return {
+            "file": self.path,
+            "line": self.line,
+            "pass": self.pass_id,
+            "message": self.message,
+        }
+
+
+@dataclass(frozen=True)
+class StaleWaiver:
+    """A `# ktpu: <tag>-ok(reason)` whose line/def no longer triggers its
+    pass — dead weight that silently re-licenses a future violation."""
+
+    path: str
+    line: int
+    tag: str
+    reason: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [stale-waiver] {self.message}"
+
+    def as_json(self) -> Dict[str, object]:
+        return {
+            "file": self.path,
+            "line": self.line,
+            "pass": "stale-waiver",
+            "waiver": f"{self.tag}-ok({self.reason})",
+            "message": self.message,
+        }
 
 
 @dataclass
@@ -109,12 +201,25 @@ class SourceFile:
     tree: ast.AST
     waivers: Dict[int, List[Tuple[str, str]]]  # line -> [(pass tag, reason)]
     pragmas: frozenset
+    # (line, tag) pairs that actually suppressed a violation this run —
+    # the live half of the waiver inventory; declared-minus-used is the
+    # stale set (find_stale_waivers).
+    used_waivers: set = field(default_factory=set)
+
+    def has_waiver(self, line: int, pass_id: str) -> bool:
+        """Non-recording query: is there a waiver for pass_id on `line`?"""
+        tag = WAIVER_TAGS.get(pass_id, pass_id)
+        return any(t == tag and r.strip() for t, r in self.waivers.get(line, []))
 
     def waived(self, line: int, pass_id: str) -> bool:
-        tag = {"hostsync": "sync", "envflags": "flag", "jitstatic": "static"}.get(
-            pass_id, pass_id
-        )
-        return any(t == tag and r.strip() for t, r in self.waivers.get(line, []))
+        """Recording query: like has_waiver, but a True result marks the
+        waiver USED (it suppressed a real violation). Passes must call
+        this exactly when they are about to flag."""
+        tag = WAIVER_TAGS.get(pass_id, pass_id)
+        if self.has_waiver(line, pass_id):
+            self.used_waivers.add((line, tag))
+            return True
+        return False
 
 
 @dataclass
@@ -174,18 +279,37 @@ def local_entry_aliases(scope: ast.AST, entries) -> Dict[str, set]:
     return aliases
 
 
-def _scan_waivers(lines: Sequence[str]) -> Dict[int, List[Tuple[str, str]]]:
-    out: Dict[int, List[Tuple[str, str]]] = {}
-    for i, line in enumerate(lines, start=1):
-        for m in _WAIVER_RE.finditer(line):
-            out.setdefault(i, []).append((m.group(1), m.group(2)))
+def _comment_tokens(text: str) -> List[Tuple[int, str]]:
+    """(line, comment text) for every REAL comment token — waiver/pragma
+    syntax quoted inside docstrings or message strings must not count as
+    a declaration (the stale-waiver detector would otherwise chase its
+    own documentation)."""
+    import io
+    import tokenize
+
+    out: List[Tuple[int, str]] = []
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(text).readline):
+            if tok.type == tokenize.COMMENT:
+                out.append((tok.start[0], tok.string))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        # Unterminated constructs: ast.parse will report the real error.
+        pass
     return out
 
 
-def _scan_pragmas(lines: Sequence[str]) -> frozenset:
+def _scan_waivers(text: str) -> Dict[int, List[Tuple[str, str]]]:
+    out: Dict[int, List[Tuple[str, str]]] = {}
+    for line_no, comment in _comment_tokens(text):
+        for m in _WAIVER_RE.finditer(comment):
+            out.setdefault(line_no, []).append((m.group(1), m.group(2)))
+    return out
+
+
+def _scan_pragmas(text: str) -> frozenset:
     found = set()
-    for line in lines:
-        for m in _PRAGMA_RE.finditer(line):
+    for _, comment in _comment_tokens(text):
+        for m in _PRAGMA_RE.finditer(comment):
             found.add(m.group(1))
     return frozenset(found)
 
@@ -201,8 +325,8 @@ def load_file(abspath: str, root: str) -> SourceFile:
         text=text,
         lines=lines,
         tree=ast.parse(text, filename=rel),
-        waivers=_scan_waivers(lines),
-        pragmas=_scan_pragmas(lines),
+        waivers=_scan_waivers(text),
+        pragmas=_scan_pragmas(text),
     )
 
 
@@ -244,6 +368,15 @@ def is_sim_path(sf: SourceFile) -> bool:
     return "sim-path" in sf.pragmas or any(
         sf.path.startswith(m) for m in SIM_MODULES
     )
+
+
+# Modules owning threads that share mutable attributes with the engine
+# thread — the feederlock pass patrols them.
+THREADED_MODULES = ("kubernetriks_tpu/batched/stream.py",)
+
+
+def is_threaded(sf: SourceFile) -> bool:
+    return "threaded" in sf.pragmas or sf.path in THREADED_MODULES
 
 
 # --- phase 1: jit-entry and module-constant tables ---------------------------
@@ -437,18 +570,33 @@ def build_context(files: List[SourceFile]) -> LintContext:
 # --- driver ------------------------------------------------------------------
 
 
-def run_lint(
+@dataclass
+class LintReport:
+    """run_lint_report's full result: violations plus the stale-waiver
+    inventory (only meaningful when every pass ran — a waiver for an
+    unselected pass is never stale)."""
+
+    violations: List[Violation]
+    stale_waivers: List[StaleWaiver]
+    root: str = ""
+
+
+def _run_passes(
     paths: Sequence[str],
     root: str,
-    passes: Optional[Sequence[str]] = None,
-    exclude: Sequence[str] = DEFAULT_EXCLUDE,
-) -> List[Violation]:
+    passes: Optional[Sequence[str]],
+    exclude: Sequence[str],
+) -> Tuple[List[Violation], LintContext, Tuple[str, ...]]:
     from kubernetriks_tpu.lint import (
         donation,
         envflags,
+        feederlock,
         hostsync,
         jitstatic,
         prng,
+        scenariotrace,
+        shapecontract,
+        stateleaf,
     )
 
     selected = tuple(passes) if passes else PASS_IDS
@@ -463,6 +611,10 @@ def run_lint(
         "jitstatic": jitstatic.check,
         "prng": prng.check,
         "envflags": envflags.check,
+        "stateleaf": stateleaf.check,
+        "scenariotrace": scenariotrace.check,
+        "shapecontract": shapecontract.check,
+        "feederlock": feederlock.check,
     }
     violations: List[Violation] = []
     seen = set()
@@ -473,7 +625,72 @@ def run_lint(
                 seen.add(v)
                 violations.append(v)
     violations.sort(key=lambda v: (v.path, v.line, v.pass_id))
-    return violations
+    return violations, ctx, selected
+
+
+def run_lint(
+    paths: Sequence[str],
+    root: str,
+    passes: Optional[Sequence[str]] = None,
+    exclude: Sequence[str] = DEFAULT_EXCLUDE,
+) -> List[Violation]:
+    return _run_passes(paths, root, passes, exclude)[0]
+
+
+def find_stale_waivers(
+    ctx: LintContext, selected: Sequence[str]
+) -> List[StaleWaiver]:
+    """Declared waivers that suppressed nothing in this run. Only waivers
+    whose tag maps to a SELECTED pass are judged (a tag for a pass that
+    did not run cannot be proven stale); unknown tags are always
+    reported — a typo'd tag (`synk-ok`) suppresses nothing anywhere."""
+    selected_tags = {WAIVER_TAGS[p] for p in selected}
+    out: List[StaleWaiver] = []
+    for sf in ctx.files:
+        for line, entries in sorted(sf.waivers.items()):
+            for tag, reason in entries:
+                if tag not in TAG_TO_PASS:
+                    out.append(
+                        StaleWaiver(
+                            sf.path,
+                            line,
+                            tag,
+                            reason,
+                            f"unknown waiver tag {tag!r} — known tags: "
+                            f"{', '.join(sorted(TAG_TO_PASS))}",
+                        )
+                    )
+                    continue
+                if tag not in selected_tags:
+                    continue
+                if (line, tag) not in sf.used_waivers:
+                    out.append(
+                        StaleWaiver(
+                            sf.path,
+                            line,
+                            tag,
+                            reason,
+                            f"stale waiver: {tag}-ok({reason}) suppresses "
+                            f"nothing — the line/def no longer triggers the "
+                            f"{TAG_TO_PASS[tag]} pass; remove the waiver",
+                        )
+                    )
+    return out
+
+
+def run_lint_report(
+    paths: Sequence[str],
+    root: str,
+    passes: Optional[Sequence[str]] = None,
+    exclude: Sequence[str] = DEFAULT_EXCLUDE,
+) -> LintReport:
+    """run_lint plus the stale-waiver inventory (the --json/CI entry)."""
+    violations, ctx, selected = _run_passes(paths, root, passes, exclude)
+    return LintReport(
+        violations=violations,
+        stale_waivers=find_stale_waivers(ctx, selected),
+        root=root,
+    )
 
 
 def list_waivers(paths: Sequence[str], root: str) -> List[str]:

@@ -4,6 +4,9 @@ Within hot-path modules (lint.HOT_MODULES, or any file carrying a
 `# ktpu: hot-path` pragma), flags:
 
 - `.item()` calls and `.block_until_ready()` / `jax.block_until_ready`;
+- `.copy_to_host_async()` — async initiation, but still a d2h transfer
+  that belongs in the greppable budget (and may trip the transfer guard
+  on real accelerators — every site carries an allow_transfer scope);
 - `jax.device_get`, `to_host` (the multihost device-get wrapper),
   `np.asarray` / `np.array` — host materialization of device values;
 - `int()` / `float()` / `bool()` applied to array-valued expressions
@@ -56,7 +59,7 @@ _SYNC_FUNCS = {
     "numpy.asarray": "np.asarray on device values",
     "numpy.array": "np.array on device values",
 }
-_SYNC_METHODS = {"item", "block_until_ready"}
+_SYNC_METHODS = {"item", "block_until_ready", "copy_to_host_async"}
 _CAST_FUNCS = {"int", "float", "bool"}
 # Never sync and never propagate taint.
 _NEUTRAL_FUNCS = {"hasattr", "isinstance", "len", "getattr", "type", "id"}
@@ -87,7 +90,9 @@ class _FunctionChecker:
         self.class_taint = class_taint
         self.violations = violations
         self.tainted: Set[str] = set()
-        self.fn_waived = sf.waived(fn.lineno, PASS_ID)
+        # Non-recording probe: the def-scoped waiver only counts as USED
+        # (stale-waiver accounting) when it actually suppresses a flag.
+        self.fn_waived = sf.has_waiver(fn.lineno, PASS_ID)
         self.jit_like = self._local_jit_aliases()
 
     def _local_jit_aliases(self) -> Set[str]:
@@ -179,7 +184,10 @@ class _FunctionChecker:
 
     def _flag(self, node: ast.AST, message: str) -> None:
         line = node.lineno
-        if self.fn_waived or self.sf.waived(line, PASS_ID):
+        if self.sf.waived(line, PASS_ID):
+            return
+        if self.fn_waived:
+            self.sf.waived(self.fn.lineno, PASS_ID)  # record def-waiver use
             return
         self.violations.append(
             Violation(

@@ -1,0 +1,350 @@
+"""Scenario-trace discipline pass: the fleet's compile-once guarantee,
+statically.
+
+`ScenarioFleet` serves heterogeneous what-if configs through ONE compiled
+engine because every scenario-bearing parameter is per-cluster (C,)
+TRACED data (`fleet.scenario_leaves` composes them; `engine.
+update_scenario` re-installs them as host->device puts). That guarantee
+dies silently the moment a scenario leaf flows into anything that shapes
+a program: Python control flow, an `int()`/`.item()` host cast, a
+`static_argnames` kwarg of a jit entry, or a shape expression — the next
+wave then recompiles (or worse, compiles the previous wave's config into
+the program). bench --sweep catches the regression at runtime via
+jit-cache counts; this pass catches it at commit time, naming the leaf.
+
+Sources: attribute reads of the registered traced leaves — the
+`SCENARIO_TRACED_LEAVES` manifest next to `AutoscaleStatics`
+(batched/autoscale.py) plus `StepConstants.fault_seed`
+(`SCENARIO_TRACED_CONSTS` in batched/state.py). The pass unions every
+in-scope manifest with the built-in defaults, so fixtures and future
+registries extend it without touching the pass.
+
+Sinks (function-local taint, the hostsync machinery's sibling):
+- `if`/`while`/`assert` tests and `for` iterables;
+- `int()` / `float()` / `bool()` casts and `.item()` reads;
+- shape positions: `jnp.zeros/ones/full/empty/arange(shape..)`,
+  `jnp.broadcast_to(x, shape)`'s shape argument, `.reshape(...)` args;
+- keyword arguments that are `static_argnames` of a known jit entry.
+
+`x is None` / `is not None` presence checks never flag (leaf presence is
+a legitimate structural static — the `auto`/`fault_seed` pattern). Waive
+a deliberate host read with `# ktpu: scenario-ok(<reason>)`.
+
+Scope: simulation-path modules (lint.SIM_MODULES or `# ktpu: sim-path`).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from kubernetriks_tpu.lint import (
+    LintContext,
+    SourceFile,
+    Violation,
+    dotted_name,
+    is_sim_path,
+)
+
+PASS_ID = "scenariotrace"
+
+# Built-in defaults so PARTIAL-scope lints (one changed file, without
+# autoscale.py/state.py in scope) keep their taint sources; unioned with
+# every in-scope SCENARIO_TRACED_LEAVES / SCENARIO_TRACED_CONSTS manifest
+# (kept in the modules that own the leaves, so the registry lives next to
+# the NamedTuple it describes). This copy is pinned EQUAL to those
+# manifests by tests/test_lint.py::test_stateleaf_registries_match_runtime
+# — rename a leaf in one place and CI names the drift.
+DEFAULT_TRACED = frozenset(
+    {
+        # AutoscaleStatics per-lane control-law leaves (fleet-composed)
+        "hpa_interval",
+        "hpa_tolerance",
+        "ca_threshold",
+        "ca_max_nodes",
+        "pg_active_from",
+        "d_hpa_up",
+        "d_hpa_down",
+        "d_ca_up",
+        "d_ca_down",
+        "ca_period",
+        "ca_snap",
+        "ca_finish_vis",
+        "ca_commit_vis",
+        # StepConstants per-lane fault seed
+        "fault_seed",
+    }
+)
+MANIFEST_NAMES = ("SCENARIO_TRACED_LEAVES", "SCENARIO_TRACED_CONSTS")
+
+_NEUTRAL_ATTRS = {"shape", "dtype", "ndim", "size", "sharding"}
+_CAST_FUNCS = {"int", "float", "bool"}
+_NEUTRAL_FUNCS = {"hasattr", "isinstance", "len", "getattr", "type", "id"}
+# callee bare name -> indices of its SHAPE-position arguments
+_SHAPE_ARGS: Dict[str, Tuple[int, ...]] = {
+    "zeros": (0,),
+    "ones": (0,),
+    "empty": (0,),
+    "full": (0,),
+    "arange": (0, 1, 2),
+    "broadcast_to": (1,),
+    "iota": (1,),
+}
+
+
+def _collect_traced(ctx: LintContext) -> frozenset:
+    names: Set[str] = set(DEFAULT_TRACED)
+    for sf in ctx.files:
+        if not isinstance(sf.tree, ast.Module):
+            continue
+        for node in sf.tree.body:
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id in MANIFEST_NAMES
+                and isinstance(node.value, (ast.Tuple, ast.List))
+            ):
+                for elt in node.value.elts:
+                    if isinstance(elt, ast.Constant) and isinstance(
+                        elt.value, str
+                    ):
+                        names.add(elt.value)
+    return frozenset(names)
+
+
+class _Checker:
+    def __init__(
+        self,
+        sf: SourceFile,
+        fn: ast.FunctionDef,
+        traced: frozenset,
+        statics_by_entry: Dict[str, frozenset],
+        violations: List[Violation],
+    ):
+        self.sf = sf
+        self.fn = fn
+        self.traced = traced
+        self.statics_by_entry = statics_by_entry
+        self.violations = violations
+        self.tainted: Set[str] = set()
+
+    # -- taint ---------------------------------------------------------------
+
+    def _leaf_of(self, node: ast.AST) -> str:
+        """Best-effort leaf name for the message."""
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Attribute) and sub.attr in self.traced:
+                return sub.attr
+        return "scenario leaf"
+
+    def _is_tainted(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Attribute):
+            if node.attr in _NEUTRAL_ATTRS:
+                return False
+            if node.attr in self.traced:
+                return True
+            return self._is_tainted(node.value)
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Call):
+            fname = dotted_name(node.func)
+            if fname is not None:
+                bare = fname.rsplit(".", 1)[-1]
+                if bare in _CAST_FUNCS or bare in _NEUTRAL_FUNCS:
+                    return False  # casts are flagged as sinks, not sources
+            # traced data stays traced through array ops / helpers —
+            # including method calls on tainted receivers (.sum(), .any())
+            if isinstance(node.func, ast.Attribute) and node.func.attr not in (
+                "item",
+            ):
+                if self._is_tainted(node.func.value):
+                    return True
+            return any(
+                self._is_tainted(a) for a in node.args
+            ) or any(self._is_tainted(kw.value) for kw in node.keywords)
+        if isinstance(node, ast.Subscript):
+            return self._is_tainted(node.value)
+        if isinstance(node, ast.BinOp):
+            return self._is_tainted(node.left) or self._is_tainted(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self._is_tainted(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any(self._is_tainted(v) for v in node.values)
+        if isinstance(node, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return False  # presence checks are structural statics
+            return self._is_tainted(node.left) or any(
+                self._is_tainted(c) for c in node.comparators
+            )
+        if isinstance(node, ast.IfExp):
+            return self._is_tainted(node.body) or self._is_tainted(node.orelse)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self._is_tainted(e) for e in node.elts)
+        if isinstance(node, ast.Starred):
+            return self._is_tainted(node.value)
+        return False
+
+    # -- violations ----------------------------------------------------------
+
+    def _flag(self, node: ast.AST, leaf: str, what: str) -> None:
+        if self.sf.waived(node.lineno, PASS_ID):
+            return
+        self.violations.append(
+            Violation(
+                self.sf.path,
+                node.lineno,
+                PASS_ID,
+                f"per-lane scenario leaf '{leaf}' flows into {what} — a "
+                "what-if config would shape the compiled program and the "
+                "fleet's compile-once guarantee breaks (recompile per "
+                "wave); keep scenario leaves traced, or waive a "
+                "deliberate host read with # ktpu: scenario-ok(reason)",
+            )
+        )
+
+    def _check_expr(self, node: ast.AST) -> None:
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            fname = dotted_name(sub.func)
+            bare = fname.rsplit(".", 1)[-1] if fname else None
+            if (
+                bare in _CAST_FUNCS
+                and len(sub.args) == 1
+                and self._is_tainted(sub.args[0])
+            ):
+                self._flag(
+                    sub,
+                    self._leaf_of(sub.args[0]),
+                    f"a host {bare}() cast",
+                )
+                continue
+            if (
+                isinstance(sub.func, ast.Attribute)
+                and sub.func.attr == "item"
+                and not sub.args
+                and self._is_tainted(sub.func.value)
+            ):
+                self._flag(sub, self._leaf_of(sub.func.value), "an .item() read")
+                continue
+            # shape-position arguments
+            shape_idx: Tuple[int, ...] = ()
+            if bare in _SHAPE_ARGS:
+                shape_idx = _SHAPE_ARGS[bare]
+            elif isinstance(sub.func, ast.Attribute) and sub.func.attr == "reshape":
+                shape_idx = tuple(range(len(sub.args)))
+            for i in shape_idx:
+                if i < len(sub.args) and self._is_tainted(sub.args[i]):
+                    self._flag(
+                        sub,
+                        self._leaf_of(sub.args[i]),
+                        f"a shape expression ({bare or 'reshape'} arg {i})",
+                    )
+            # static kwargs of known jit entries
+            if bare in self.statics_by_entry:
+                statics = self.statics_by_entry[bare]
+                for kw in sub.keywords:
+                    if kw.arg in statics and self._is_tainted(kw.value):
+                        self._flag(
+                            kw.value,
+                            self._leaf_of(kw.value),
+                            f"jit static {kw.arg!r} of entry {bare}",
+                        )
+
+    # -- walk ----------------------------------------------------------------
+
+    def run(self) -> None:
+        self.visit_stmts(self.fn.body)
+
+    def visit_stmts(self, stmts) -> None:
+        for st in stmts:
+            self.visit_stmt(st)
+
+    def visit_stmt(self, st: ast.stmt) -> None:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return
+        if isinstance(st, (ast.If, ast.While)):
+            self._check_expr(st.test)
+            if self._is_tainted(st.test):
+                self._flag(
+                    st, self._leaf_of(st.test), "Python control flow"
+                )
+            for body in (st.body, st.orelse):
+                self.visit_stmts(body)
+            return
+        if isinstance(st, ast.Assert):
+            self._check_expr(st.test)
+            if self._is_tainted(st.test):
+                self._flag(st, self._leaf_of(st.test), "a Python assert")
+            return
+        if isinstance(st, (ast.For, ast.AsyncFor)):
+            self._check_expr(st.iter)
+            if self._is_tainted(st.iter):
+                self._flag(st, self._leaf_of(st.iter), "Python iteration")
+            self.visit_stmts(st.body)
+            self.visit_stmts(st.orelse)
+            return
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            for item in st.items:
+                self._check_expr(item.context_expr)
+            self.visit_stmts(st.body)
+            return
+        if isinstance(st, ast.Try):
+            self.visit_stmts(st.body)
+            for handler in st.handlers:
+                self.visit_stmts(handler.body)
+            self.visit_stmts(st.orelse)
+            self.visit_stmts(st.finalbody)
+            return
+        for _, value in ast.iter_fields(st):
+            if isinstance(value, ast.expr):
+                self._check_expr(value)
+            elif isinstance(value, list):
+                for v in value:
+                    if isinstance(v, ast.expr):
+                        self._check_expr(v)
+        if isinstance(st, ast.Assign):
+            tainted = self._is_tainted(st.value)
+            for tgt in st.targets:
+                elts = (
+                    tgt.elts if isinstance(tgt, (ast.Tuple, ast.List)) else [tgt]
+                )
+                for e in elts:
+                    path = dotted_name(e)
+                    if path is None:
+                        continue
+                    if tainted:
+                        self.tainted.add(path)
+                    else:
+                        self.tainted.discard(path)
+        elif isinstance(st, ast.AnnAssign) and st.value is not None:
+            path = dotted_name(st.target)
+            if path is not None:
+                if self._is_tainted(st.value):
+                    self.tainted.add(path)
+                else:
+                    self.tainted.discard(path)
+        elif isinstance(st, ast.AugAssign):
+            if self._is_tainted(st.value):
+                path = dotted_name(st.target)
+                if path is not None:
+                    self.tainted.add(path)
+
+
+def check(ctx: LintContext) -> List[Violation]:
+    traced = _collect_traced(ctx)
+    statics_by_entry: Dict[str, frozenset] = {}
+    for entry in ctx.jit_entries:
+        if entry.static_argnames:
+            statics_by_entry[entry.name] = statics_by_entry.get(
+                entry.name, frozenset()
+            ) | frozenset(entry.static_argnames)
+    violations: List[Violation] = []
+    for sf in ctx.files:
+        if not is_sim_path(sf):
+            continue
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                _Checker(sf, node, traced, statics_by_entry, violations).run()
+    return violations

@@ -1,0 +1,24 @@
+# ktpu: threaded
+"""Seeded feederlock violations: blocking while HOLDING the ring lock —
+an Event.wait and a time.sleep inside the with-lock block (the condvar's
+own .wait() is the one legal wait and must NOT flag)."""
+
+import threading
+import time
+
+
+class StallingFeeder:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._ready = threading.Event()
+        self.backlog = 0
+
+    def get(self):
+        with self._cond:
+            while self.backlog == 0:
+                self._cond.wait()  # legal: the condvar releases the lock
+            # Blocking on a NON-lock event while holding the lock: the
+            # producer can never publish, both threads stall.
+            self._ready.wait()
+            time.sleep(0.01)
+            self.backlog -= 1

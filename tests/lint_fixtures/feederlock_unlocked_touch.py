@@ -1,0 +1,27 @@
+# ktpu: threaded
+"""Seeded feederlock violations: shared mutable attributes (written from
+the producer thread) touched outside the ring lock — a torn counter and
+an unlocked ring append."""
+
+import threading
+
+
+class LeakyFeeder:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._ring = []
+        self.produced = 0
+        self.width = 128  # written only here: thread-safe config, exempt
+
+    def _produce(self, slab):
+        with self._cond:
+            self._ring.append(slab)
+        # Unlocked read-modify-write of a shared counter: torn updates.
+        self.produced += 1
+
+    def drain(self):
+        # Unlocked container mutation from the consumer side.
+        self._ring.pop()
+        with self._cond:
+            n = self.produced
+        return n, self.width  # width is init-only config: must NOT flag

@@ -1,0 +1,28 @@
+# ktpu: sim-path
+"""Seeded scenariotrace violations: per-lane scenario leaves flowing into
+Python control flow and host casts — each would turn a what-if config
+into a recompile (or bake the previous wave's config into the program)."""
+
+import jax.numpy as jnp
+
+
+def plan_cycle(st, auto):
+    # Branching on a traced per-lane leaf: implicit host sync AND a
+    # program whose structure depends on the scenario.
+    if st.hpa_tolerance.max() > 0.5:
+        tol = st.hpa_tolerance * 2.0
+    else:
+        tol = st.hpa_tolerance
+    # Host cast of the per-lane CA quota.
+    quota = int(st.ca_max_nodes.sum())
+    # .item() read of the fault seed.
+    seed0 = st.fault_seed.item()
+    # Presence checks stay LEGAL (structural static) — must not flag.
+    if st.fault_seed is None:
+        return tol, 0, 0
+    return tol, quota, seed0
+
+
+def waived_probe(st):
+    # A deliberate, documented host read keeps working under a waiver.
+    return float(st.ca_threshold[0])  # ktpu: scenario-ok(debug probe off the hot path)

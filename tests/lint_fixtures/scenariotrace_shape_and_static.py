@@ -1,0 +1,25 @@
+# ktpu: sim-path
+"""Seeded scenariotrace violations: a scenario leaf reaching a SHAPE
+expression and a jit-STATIC kwarg — both compile the current wave's
+config into the program."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+_STATICS = ("n_slots",)
+
+
+@partial(jax.jit, static_argnames=_STATICS)
+def grow_reserve(state, n_slots):
+    return state
+
+
+def resize(st, state):
+    # Per-lane quota flowing into a shape: the array's SIZE would track
+    # the scenario, recompiling every wave.
+    pad = jnp.zeros(st.ca_max_nodes.max())
+    # ...and into a declared jit static of a known entry.
+    out = grow_reserve(state, n_slots=st.ca_max_nodes[0])
+    return pad, out

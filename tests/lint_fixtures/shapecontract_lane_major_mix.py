@@ -1,0 +1,29 @@
+# ktpu: sim-path
+"""Seeded shapecontract violations: lane-major hazards — a (C,) lane
+vector meeting a layout-ambiguous hot node leaf directly (wrong in one
+of the two layouts whichever expansion you pick), plus a (C,)/(C,P) pod
+mix through a jnp.where combiner."""
+
+import jax.numpy as jnp
+
+# Fixtures lint in isolation, so they carry their own signature registry
+# (mirroring the real batched/state.py + autoscale.py entries).
+AXIS_SIGNATURES = {
+    "alive": "@node",
+    "phase": "C,P",
+    "time": "C",
+    "ca_max_nodes": "C",
+}
+
+
+def razor_mask(state, st):
+    nodes = state.nodes
+    # alive is (C, N) row-major at rest but (N, C) inside lane-major
+    # programs: the bare mask-mix must go through the axis-parameterized
+    # helpers, never a direct broadcast.
+    droppable = nodes.alive & (st.ca_max_nodes > 0)
+    # (C, P) pod phase against the (C,) lane clock through a combiner.
+    stale = jnp.where(state.pods.phase > 0, state.time, 0)
+    # Explicit expansion stays clean.
+    stale_ok = jnp.where(state.pods.phase > 0, state.time[:, None], 0)
+    return droppable, stale, stale_ok

@@ -1,0 +1,33 @@
+# ktpu: sim-path
+"""Seeded shapecontract violations: the exact PR 13 bug class — a (C,)
+per-lane leaf meeting a (C, G) per-group expression without an explicit
+[:, None], in compare and arithmetic positions."""
+
+import jax.numpy as jnp
+
+# Fixtures lint in isolation, so they carry their own signature registry
+# (mirroring the real batched/autoscale.py entries for these leaves).
+AXIS_SIGNATURES = {
+    "hpa_tolerance": "C",
+    "ca_max_nodes": "C",
+    "col_util_cpu": "C,G",
+    "col_util_ram": "C,G",
+    "ca_count": "C,G",
+    "hpa_tail": "C,G",
+}
+
+
+def hpa_desired(st, auto):
+    # (C, G) utilization ratio against the (C,) tolerance: the bare
+    # compare broadcasts the lane axis on the WRONG side.
+    util = auto.col_util_cpu / jnp.maximum(auto.col_util_ram, 1.0)
+    over = util > st.hpa_tolerance
+    under = util < (1.0 - st.hpa_tolerance)
+    # The correct spelling stays clean:
+    over_ok = util > st.hpa_tolerance[:, None]
+    # Arithmetic mix: (C, G) head count plus the (C,) CA quota.
+    budget = auto.ca_count + st.ca_max_nodes
+    budget_ok = auto.ca_count + st.ca_max_nodes[:, None]
+    # A deliberate mix under a waiver stays clean.
+    planned = auto.hpa_tail - st.ca_max_nodes  # ktpu: shape-ok(fixture: deliberate lane fold)
+    return over, under, over_ok, budget, budget_ok, planned

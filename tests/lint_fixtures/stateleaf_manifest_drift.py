@@ -1,0 +1,24 @@
+# ktpu: state-module
+"""Seeded stateleaf violations: the leaf manifest drifted from the class
+— a new field (`scratch`) missing from CLUSTER_STATE_LEAVES, and a stale
+manifest entry (`legacy_ring`) naming a field that no longer exists."""
+
+from typing import NamedTuple
+
+import numpy as np
+
+
+class ClusterBatchState(NamedTuple):
+    time: np.ndarray
+    pods: np.ndarray
+    scratch: np.ndarray  # added without touching the manifest
+
+
+CLUSTER_STATE_LEAVES = ("time", "pods", "legacy_ring")
+
+
+def compare_states(a, b):
+    import jax
+
+    flat_a, _ = jax.tree_util.tree_flatten_with_path(a)
+    return flat_a

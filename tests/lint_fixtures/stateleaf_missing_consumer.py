@@ -1,0 +1,29 @@
+# ktpu: state-module
+"""Seeded stateleaf violation: a state class whose BY-NAME consumer
+misses a leaf. `compare_states` here has no pytree-generic traversal and
+never names `auto` — the exact "new leaf added to ClusterBatchState but
+not to compare_states" hazard, self-contained (state-module pragma)."""
+
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+
+class ClusterBatchState(NamedTuple):
+    time: np.ndarray
+    pods: np.ndarray
+    auto: Optional[np.ndarray] = None
+
+
+# The manifest itself is complete — only the consumer lags.
+CLUSTER_STATE_LEAVES = ("time", "pods", "auto")
+
+
+def compare_states(a, b):
+    bad = []
+    if not (a.time == b.time).all():
+        bad.append("time")
+    if not (a.pods == b.pods).all():
+        bad.append("pods")
+    # `auto` silently escapes the comparison: the seeded violation.
+    return bad

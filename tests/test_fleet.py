@@ -429,7 +429,17 @@ def test_heterogeneous_ca_fleet_matches_scalar_oracles():
 def chaos_fleet_runs():
     """Two fleets over the composed+chaos scenario whose query lists are
     lane-PERMUTED (and carry a duplicate scenario), each run for two
-    waves — the shared engine-pair every permutation/wave gate reads."""
+    waves — the shared engine-pair every permutation/wave gate reads.
+
+    KTPU_EXPLAIN_RECOMPILES=1 is set for the whole fixture: both fleets
+    arm the recompile sentinel, so every post-warm-up wave the gates
+    below exercise runs under an expect_none guard — a compile during a
+    wave would raise RecompileError naming the jit entry (the runtime
+    cross-check of the compile-once contract the zero-recompile gate
+    pins by cache counts)."""
+    import os
+
+    os.environ["KTPU_EXPLAIN_RECOMPILES"] = "1"
     config = default_test_simulation_config(
         COMPOSED_CONFIG_SUFFIX + FAULT_SUFFIX
     )
@@ -461,11 +471,14 @@ def chaos_fleet_runs():
         Scenario(fault_seed=11, hpa_scan_interval=30.0),  # dup of 0
         Scenario(fault_seed=33, hpa_tolerance=0.25),
     ]
-    fleet_a, res_a = build_and_run([0, 1, 2, 3])
-    fleet_b, res_b = build_and_run([3, 2, 1, 0])
-    yield SCENS, fleet_a, res_a, fleet_b, res_b
-    fleet_a.close()
-    fleet_b.close()
+    try:
+        fleet_a, res_a = build_and_run([0, 1, 2, 3])
+        fleet_b, res_b = build_and_run([3, 2, 1, 0])
+        yield SCENS, fleet_a, res_a, fleet_b, res_b
+        fleet_a.close()
+        fleet_b.close()
+    finally:
+        os.environ.pop("KTPU_EXPLAIN_RECOMPILES", None)
 
 
 def test_lane_permutation_bit_identical(chaos_fleet_runs):
@@ -531,6 +544,21 @@ def test_wave_reset_and_zero_recompiles(chaos_fleet_runs):
     # The re-run wave reproduces the original waves' results exactly.
     assert res_rerun[0].counters == res_a[0].counters
     assert res_rerun[1].counters == res_a[3].counters
+
+
+def test_wave_sentinel_armed_and_quiet(chaos_fleet_runs):
+    """KTPU_EXPLAIN_RECOMPILES=1 (fixture-scoped) really armed the
+    sentinel: the fleets carry one, and another post-warm-up wave runs
+    quiet under its expect_none guard (a compile would raise
+    RecompileError naming the jit entry — pinned the other way by
+    tests/test_recompile.py's shape-drift gate)."""
+    scens, fleet_a, res_a, _, _ = chaos_fleet_runs
+    assert fleet_a._sentinel is not None, (
+        "ScenarioFleet did not arm the recompile sentinel under "
+        "KTPU_EXPLAIN_RECOMPILES=1"
+    )
+    rerun = fleet_a.sweep([scens[1]])
+    assert rerun[0].counters == res_a[1].counters
 
 
 def test_per_lane_fault_seed_matches_standalone_run(chaos_fleet_runs):

@@ -7,7 +7,7 @@ import os
 
 import pytest
 
-from kubernetriks_tpu.lint import run_lint
+from kubernetriks_tpu.lint import run_lint, run_lint_report
 from kubernetriks_tpu.lint.__main__ import DEFAULT_SCOPE, main as lint_main
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -20,12 +20,19 @@ def _fixture(name: str):
 
 def test_repo_is_golden_clean():
     """The whole default scope (package, bench.py, tests, scripts,
-    experiments) lints clean — every legitimate sync/draw carries an
-    explicit waiver with a reason. New violations fail CI here and in the
-    dedicated lint job."""
+    experiments) lints clean under all NINE passes — every legitimate
+    sync/draw/mix carries an explicit waiver with a reason — AND carries
+    zero stale waivers (a *-ok that suppresses nothing would silently
+    re-license a future violation). New violations fail CI here and in
+    the dedicated lint job (--strict-waivers)."""
     scope = [p for p in DEFAULT_SCOPE if os.path.exists(os.path.join(ROOT, p))]
-    violations = run_lint(scope, ROOT)
-    assert violations == [], "\n".join(v.render() for v in violations)
+    report = run_lint_report(scope, ROOT)
+    assert report.violations == [], "\n".join(
+        v.render() for v in report.violations
+    )
+    assert report.stale_waivers == [], "\n".join(
+        w.render() for w in report.stale_waivers
+    )
 
 
 def test_cli_exit_codes():
@@ -52,6 +59,20 @@ FIXTURE_CASES = [
     ("prng_np_random.py", "prng", 2, "random"),
     ("envflags_direct_read.py", "envflags", 1, "KTPU_SUPERSPAN"),
     ("envflags_unregistered.py", "envflags", 3, "not declared"),
+    # contract-prover passes (v2)
+    ("stateleaf_missing_consumer.py", "stateleaf", 1, "compare-states"),
+    ("stateleaf_manifest_drift.py", "stateleaf", 2, "CLUSTER_STATE_LEAVES"),
+    ("scenariotrace_control_flow.py", "scenariotrace", 3, "control flow"),
+    (
+        "scenariotrace_shape_and_static.py",
+        "scenariotrace",
+        2,
+        "shape expression",
+    ),
+    ("shapecontract_tolerance_mix.py", "shapecontract", 3, "[:, None]"),
+    ("shapecontract_lane_major_mix.py", "shapecontract", 2, "lane-major"),
+    ("feederlock_unlocked_touch.py", "feederlock", 3, "unlocked"),
+    ("feederlock_blocking_wait.py", "feederlock", 2, "HOLDING the ring lock"),
 ]
 
 
@@ -212,3 +233,264 @@ def test_flag_registry_truthiness(monkeypatch):
     assert flag_int("KTPU_STREAM_SEGMENT") is None
     with pytest.raises(TypeError):
         flag_int("KTPU_DEBUG_FINITE")  # registered as bool, read as int
+
+
+# --- contract-prover v2: state-leaf pass end-to-end --------------------------
+
+
+def test_stateleaf_scratch_leaf_fails_against_real_tree(tmp_path):
+    """THE acceptance gate for pass 6: a scratch leaf added to the REAL
+    ClusterBatchState without touching any registry is caught. The test
+    copies batched/state.py into a scratch repo layout, inserts a new
+    field, and proves the stateleaf pass fails naming the leaf and the
+    registries it missed (the untouched copy stays clean)."""
+    src_path = os.path.join(ROOT, "kubernetriks_tpu", "batched", "state.py")
+    src = open(src_path, encoding="utf-8").read()
+    dest_dir = tmp_path / "kubernetriks_tpu" / "batched"
+    dest_dir.mkdir(parents=True)
+    dest = dest_dir / "state.py"
+
+    # Untouched copy: clean (the classes, manifests and in-file
+    # consumers — compare_states, strip_telemetry, init_state — agree).
+    dest.write_text(src, encoding="utf-8")
+    clean = run_lint(
+        ["kubernetriks_tpu/batched/state.py"], str(tmp_path), passes=["stateleaf"]
+    )
+    assert clean == [], "\n".join(v.render() for v in clean)
+
+    marker = "    nodes: NodeArrays\n"
+    assert marker in src, "ClusterBatchState layout changed; update the test"
+    dest.write_text(
+        src.replace(marker, "    scratch_probe: jnp.ndarray\n" + marker, 1),
+        encoding="utf-8",
+    )
+    violations = run_lint(
+        ["kubernetriks_tpu/batched/state.py"], str(tmp_path), passes=["stateleaf"]
+    )
+    rendered = "\n".join(v.render() for v in violations)
+    assert any(
+        "scratch_probe" in v.message and "CLUSTER_STATE_LEAVES" in v.message
+        for v in violations
+    ), rendered or "scratch leaf escaped the manifest registry"
+    # The required-field constructor registry catches it too.
+    assert any(
+        "scratch_probe" in v.message and "init-state" in v.message
+        for v in violations
+    ), rendered
+    # And the CLI gates on it (the CI contract).
+    assert (
+        lint_main(["--root", str(tmp_path), "kubernetriks_tpu/batched/state.py"])
+        == 1
+    )
+
+
+def test_stateleaf_registries_match_runtime():
+    """The AST-parsed manifests equal the live NamedTuple fields, the
+    axis/scenario registries name real leaves, and the ckpt manifest
+    covers exactly the structural leaves — the lint pass and the runtime
+    can never drift apart silently."""
+    from kubernetriks_tpu.batched import autoscale, state
+    from kubernetriks_tpu.batched.engine import CKPT_COVERED_LEAVES
+
+    assert state.CLUSTER_STATE_LEAVES == state.ClusterBatchState._fields
+    assert state.TELEMETRY_RING_LEAVES == state.TelemetryRing._fields
+    assert (
+        autoscale.AUTOSCALE_STATE_LEAVES == autoscale.AutoscaleState._fields
+    )
+    # scenario-traced registries name real statics/consts leaves
+    statics_fields = set(autoscale.AutoscaleStatics._fields)
+    assert set(autoscale.SCENARIO_TRACED_LEAVES) <= statics_fields
+    assert set(state.SCENARIO_TRACED_CONSTS) <= set(
+        state.StepConstants._fields
+    )
+    # the pass's partial-scope fallback copy is pinned EQUAL to the
+    # module manifests — the three spellings can never drift
+    from kubernetriks_tpu.lint.scenariotrace import DEFAULT_TRACED
+
+    assert DEFAULT_TRACED == set(autoscale.SCENARIO_TRACED_LEAVES) | set(
+        state.SCENARIO_TRACED_CONSTS
+    )
+    # every fleet-composed leaf is registered as traced (compile-once)
+    composed = {
+        "hpa_interval",
+        "hpa_tolerance",
+        "ca_threshold",
+        "ca_max_nodes",
+        "pg_active_from",
+        "d_hpa_up",
+        "d_hpa_down",
+        "d_ca_up",
+        "d_ca_down",
+        "ca_period",
+        "ca_snap",
+        "ca_finish_vis",
+        "ca_commit_vis",
+    }
+    assert composed <= set(autoscale.SCENARIO_TRACED_LEAVES)
+    # axis signatures name real leaves of the registered NamedTuples
+    known = (
+        statics_fields
+        | set(autoscale.AutoscaleState._fields)
+        | set(state.ClusterBatchState._fields)
+        | set(state.NodeArrays._fields)
+        | set(state.PodArrays._fields)
+        | set(state.MetricArrays._fields)
+    )
+    for reg in (state.AXIS_SIGNATURES, autoscale.AXIS_SIGNATURES):
+        unknown = set(reg) - known
+        assert not unknown, f"AXIS_SIGNATURES names unknown leaves: {unknown}"
+    # the lane-major-ambiguous set is exactly NODE_HOT_LEAVES
+    node_sigs = {
+        k for k, v in state.AXIS_SIGNATURES.items() if v == "@node"
+    }
+    assert node_sigs == set(state.NODE_HOT_LEAVES)
+    # ckpt manifest == the structural (None-default) leaves
+    structural = {
+        f
+        for cls in (state.ClusterBatchState, autoscale.AutoscaleState)
+        for f in cls._fields
+        if cls._field_defaults.get(f, "<nodefault>") is None
+    }
+    assert set(CKPT_COVERED_LEAVES) == structural
+
+
+# --- contract-prover v2: stale-waiver detection ------------------------------
+
+
+def test_stale_waiver_detection(tmp_path):
+    """A waiver whose line no longer triggers its pass is reported stale;
+    a load-bearing waiver is not; an unknown tag always is. The CLI exits
+    0 by default (warning) and 1 under --strict-waivers."""
+    fixture = tmp_path / "stale.py"
+    fixture.write_text(
+        "# ktpu: hot-path\n"
+        "def readout(state):\n"
+        "    # the USED waiver: .item() really syncs in a hot module\n"
+        "    n = state.total.item()  # ktpu: sync-ok(readout at span boundary)\n"
+        "    m = 1 + 1  # ktpu: sync-ok(nothing here syncs anymore)\n"
+        "    k = 2  # ktpu: synk-ok(typo tag)\n"
+        "    return n + m + k\n",
+        encoding="utf-8",
+    )
+    report = run_lint_report([str(fixture)], str(tmp_path))
+    assert report.violations == [], [v.render() for v in report.violations]
+    lines = {w.line for w in report.stale_waivers}
+    assert 5 in lines, "unused waiver not reported stale"
+    assert 4 not in lines, "load-bearing waiver wrongly reported stale"
+    assert any(
+        w.line == 6 and "unknown waiver tag" in w.message
+        for w in report.stale_waivers
+    )
+    assert lint_main(["--root", str(tmp_path), str(fixture)]) == 0
+    assert (
+        lint_main(
+            ["--root", str(tmp_path), "--strict-waivers", str(fixture)]
+        )
+        == 1
+    )
+
+
+def test_stale_waivers_skipped_under_pass_filter(tmp_path):
+    """--pass filters leave other passes' waivers unjudged (their usage
+    was never recorded), so the CLI must not report them stale."""
+    fixture = tmp_path / "filtered.py"
+    fixture.write_text(
+        "# ktpu: hot-path\n"
+        "def f(state):\n"
+        "    return state.total.item()  # ktpu: sync-ok(span boundary)\n",
+        encoding="utf-8",
+    )
+    # envflags-only run: the sync-ok is out of judgment scope -> exit 0
+    # even under --strict-waivers.
+    assert (
+        lint_main(
+            [
+                "--root",
+                str(tmp_path),
+                "--strict-waivers",
+                "--pass",
+                "envflags",
+                str(fixture),
+            ]
+        )
+        == 0
+    )
+
+
+# --- contract-prover v2: machine-readable output -----------------------------
+
+
+def test_json_output(tmp_path, capsys):
+    """--json emits file/line/pass/message records for violations and
+    stale waivers — the CI annotation/artifact contract."""
+    import json
+
+    out_path = tmp_path / "lint.json"
+    rc = lint_main(
+        [
+            "--root",
+            ROOT,
+            "--json",
+            str(out_path),
+            _fixture("scenariotrace_control_flow.py"),
+        ]
+    )
+    assert rc == 1
+    payload = json.loads(out_path.read_text())
+    assert payload["counts"]["violations"] >= 3
+    rec = payload["violations"][0]
+    assert set(rec) >= {"file", "line", "pass", "message"}
+    assert rec["pass"] == "scenariotrace"
+    assert rec["file"].endswith("scenariotrace_control_flow.py")
+    # --github annotations ride the same findings
+    capsys.readouterr()
+    lint_main(
+        ["--root", ROOT, "--github", _fixture("scenariotrace_control_flow.py")]
+    )
+    out = capsys.readouterr().out
+    assert "::error file=" in out and "ktpu-lint[scenariotrace]" in out
+
+
+# --- contract-prover v2: doc sync --------------------------------------------
+
+# Deliberate negatives in tests (never real flags).
+_DOC_SYNC_ALLOW = {"KTPU_NOT_REGISTERED"}
+
+
+def test_flag_doc_sync():
+    """Every registered flag appears in README/DESIGN, and every KTPU_* /
+    KUBERNETRIKS_* token in docs, bench and tests resolves to a
+    registered flag (or a registered-prefix family like KTPU_SWEEP_*) —
+    renamed tuners can no longer leave stale documentation behind."""
+    import glob
+    import re
+
+    from kubernetriks_tpu import flags
+
+    docs = ""
+    for p in ("README.md", os.path.join("docs", "DESIGN.md")):
+        docs += open(os.path.join(ROOT, p), encoding="utf-8").read()
+    undocumented = [n for n in flags.REGISTRY if n not in docs]
+    assert not undocumented, (
+        f"flags missing from README/DESIGN: {undocumented} — document "
+        "them (the README 'Environment flags' table is the catch-all)"
+    )
+
+    scan = [os.path.join(ROOT, "README.md"), os.path.join(ROOT, "bench.py")]
+    scan += glob.glob(os.path.join(ROOT, "docs", "*.md"))
+    scan += glob.glob(os.path.join(ROOT, "tests", "*.py"))
+    scan += glob.glob(os.path.join(ROOT, "scripts", "*.py"))
+    bad = {}
+    for path in scan:
+        text = open(path, encoding="utf-8").read()
+        for tok in set(re.findall(r"\b(?:KTPU|KUBERNETRIKS)_[A-Z0-9_]*", text)):
+            name = tok.rstrip("_")
+            if name in flags.REGISTRY or tok in _DOC_SYNC_ALLOW:
+                continue
+            # KTPU_SWEEP_* style family references resolve to a prefix
+            if tok.endswith("_") and any(
+                k.startswith(tok) for k in flags.REGISTRY
+            ):
+                continue
+            bad.setdefault(os.path.relpath(path, ROOT), []).append(tok)
+    assert not bad, f"unregistered flag tokens in docs/tests: {bad}"

@@ -1,0 +1,90 @@
+"""Recompile-sentinel tests (KTPU_EXPLAIN_RECOMPILES): post-warm-up XLA
+compilations raise/warn NAMING the jit entry — the runtime cross-check of
+the scenariotrace lint pass's static compile-once guarantee."""
+
+import logging
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kubernetriks_tpu.recompile import (
+    RecompileError,
+    RecompileSentinel,
+    RecompileWarning,
+    maybe_sentinel,
+    sentinel_mode,
+)
+
+
+def test_shape_drift_raises_naming_the_entry():
+    """The acceptance gate: warm a jit entry, seal, drift its shape —
+    check() raises RecompileError carrying the entry's name; a cache-hit
+    call between seal and drift stays quiet."""
+    sent = RecompileSentinel("raise").install()
+    try:
+
+        @jax.jit
+        def drifty_probe(x):
+            return x * 2 + 1
+
+        drifty_probe(jnp.zeros((4,)))
+        assert any("drifty_probe" in e for e in sent.events), (
+            "warm-up compile not observed — the jax_log_compiles hook "
+            "is not wired"
+        )
+        sent.seal("unit warm-up")
+        drifty_probe(jnp.zeros((4,)))  # cache hit: no event
+        sent.check("steady state")  # must pass
+        drifty_probe(jnp.zeros((5,)))  # deliberate shape drift
+        with pytest.raises(RecompileError, match="drifty_probe"):
+            sent.check("drift probe")
+    finally:
+        sent.uninstall()
+
+
+def test_warn_mode_and_expect_none_windows():
+    """expect_none guards a block independent of seal(); warn mode emits
+    RecompileWarning instead of raising."""
+    sent = RecompileSentinel("warn").install()
+    try:
+
+        @jax.jit
+        def warm_probe(x):
+            return x - 1
+
+        warm_probe(jnp.zeros((3,)))
+        with sent.expect_none("cache-hit window"):
+            warm_probe(jnp.zeros((3,)))
+        with pytest.warns(RecompileWarning, match="warm_probe"):
+            with sent.expect_none("drift window"):
+                warm_probe(jnp.zeros((6,)))
+    finally:
+        sent.uninstall()
+
+
+def test_uninstall_restores_logging_state():
+    """Install/uninstall round-trips jax_log_compiles and the compile
+    loggers' propagation — the sentinel leaves no global residue."""
+    before = bool(jax.config.jax_log_compiles)
+    prop_before = logging.getLogger("jax._src.dispatch").propagate
+    sent = RecompileSentinel().install()
+    sent.uninstall()
+    assert bool(jax.config.jax_log_compiles) == before
+    assert logging.getLogger("jax._src.dispatch").propagate == prop_before
+
+
+def test_flag_wiring(monkeypatch):
+    """Tristate semantics: unset -> benches arm, fleet does not (None);
+    1 -> fleet arms a raising sentinel; 0 -> forced off."""
+    monkeypatch.delenv("KTPU_EXPLAIN_RECOMPILES", raising=False)
+    assert sentinel_mode() is None
+    assert maybe_sentinel() is None
+    monkeypatch.setenv("KTPU_EXPLAIN_RECOMPILES", "0")
+    assert sentinel_mode() is False
+    assert maybe_sentinel() is None
+    monkeypatch.setenv("KTPU_EXPLAIN_RECOMPILES", "1")
+    assert sentinel_mode() is True
+    sent = maybe_sentinel()
+    assert sent is not None and sent.mode == "raise"
+    sent.uninstall()
