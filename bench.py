@@ -876,6 +876,62 @@ def _scenario_config(base_yaml: str, scen) -> "object":
     return config
 
 
+def _sweep_setup(
+    n_nodes: int,
+    rate_per_second: float,
+    horizon: float,
+    max_group_pods: int,
+    burst: tuple,
+):
+    """Shared config + trace builder of the --sweep and open-loop lines:
+    one composed (plain Poisson + HPA burst group) workload over a
+    uniform cluster, autoscalers on. Returns (base_yaml, config,
+    cluster_events, workload)."""
+    from kubernetriks_tpu.config import SimulationConfig
+    from kubernetriks_tpu.trace.generator import (
+        PoissonWorkloadTrace,
+        UniformClusterTrace,
+    )
+    from kubernetriks_tpu.trace.generic import GenericWorkloadTrace
+
+    base_yaml = f"""
+sim_name: bench_sweep
+seed: 1
+scheduling_cycle_interval: 10.0
+horizontal_pod_autoscaler:
+  enabled: true
+cluster_autoscaler:
+  enabled: true
+  scan_interval: 10.0
+  max_node_count: {n_nodes}
+  node_groups:
+  - node_template:
+      metadata: {{name: ca_node}}
+      status: {{capacity: {{cpu: 64000, ram: 137438953472}}}}
+"""
+    config = SimulationConfig.from_yaml(base_yaml)
+    cluster = UniformClusterTrace(n_nodes, cpu=64000, ram=128 * 1024**3)
+    plain = PoissonWorkloadTrace(
+        rate_per_second=rate_per_second,
+        horizon=horizon,
+        seed=3,
+        cpu=16000,
+        ram=32 * 1024**3,
+        duration_range=(30.0, 120.0),
+        name_prefix="plain",
+    )
+    group = GenericWorkloadTrace.from_yaml(
+        SWEEP_GROUP_YAML.format(
+            max_pods=max_group_pods, d1=burst[0], d2=burst[1], d3=burst[2]
+        )
+    ).convert_to_simulator_events()
+    cluster_events = cluster.convert_to_simulator_events()
+    workload = sorted(
+        plain.convert_to_simulator_events() + group, key=lambda e: e[0]
+    )
+    return base_yaml, config, cluster_events, workload
+
+
 def run_sweep(
     n_scenarios: int = 64,
     n_lanes: int = None,
@@ -919,11 +975,6 @@ def run_sweep(
         jit_cache_sizes,
     )
     from kubernetriks_tpu.flags import flag_int
-    from kubernetriks_tpu.trace.generator import (
-        PoissonWorkloadTrace,
-        UniformClusterTrace,
-    )
-    from kubernetriks_tpu.trace.generic import GenericWorkloadTrace
 
     if n_lanes is None:
         n_lanes = flag_int("KTPU_SWEEP_LANES") or (4 if smoke else 16)
@@ -931,42 +982,8 @@ def run_sweep(
         baseline_engines = flag_int("KTPU_SWEEP_BASELINE") or 3
     baseline_engines = max(1, min(baseline_engines, n_scenarios))
 
-    base_yaml = f"""
-sim_name: bench_sweep
-seed: 1
-scheduling_cycle_interval: 10.0
-horizontal_pod_autoscaler:
-  enabled: true
-cluster_autoscaler:
-  enabled: true
-  scan_interval: 10.0
-  max_node_count: {n_nodes}
-  node_groups:
-  - node_template:
-      metadata: {{name: ca_node}}
-      status: {{capacity: {{cpu: 64000, ram: 137438953472}}}}
-"""
-    from kubernetriks_tpu.config import SimulationConfig
-
-    config = SimulationConfig.from_yaml(base_yaml)
-    cluster = UniformClusterTrace(n_nodes, cpu=64000, ram=128 * 1024**3)
-    plain = PoissonWorkloadTrace(
-        rate_per_second=rate_per_second,
-        horizon=horizon,
-        seed=3,
-        cpu=16000,
-        ram=32 * 1024**3,
-        duration_range=(30.0, 120.0),
-        name_prefix="plain",
-    )
-    group = GenericWorkloadTrace.from_yaml(
-        SWEEP_GROUP_YAML.format(
-            max_pods=max_group_pods, d1=burst[0], d2=burst[1], d3=burst[2]
-        )
-    ).convert_to_simulator_events()
-    cluster_events = cluster.convert_to_simulator_events()
-    workload = sorted(
-        plain.convert_to_simulator_events() + group, key=lambda e: e[0]
+    base_yaml, config, cluster_events, workload = _sweep_setup(
+        n_nodes, rate_per_second, horizon, max_group_pods, burst
     )
     scenarios, probe_positions = _sweep_scenarios(n_scenarios)
 
@@ -1118,11 +1135,220 @@ cluster_autoscaler:
     return out
 
 
+# Heterogeneous-horizon mix of the open-loop line: every 4-query block
+# holds one full-horizon query and three shorter ones, so a WAVE-aligned
+# fleet pays the block's max horizon on every lane while the lane-async
+# fleet re-seeds each lane the round its own query finishes — the idle
+# tail the per-lane window clock exists to delete.
+OPEN_LOOP_HORIZON_MIX = (1.0, 0.0625, 0.125, 0.0625)
+
+
+def run_open_loop(
+    n_queries: int = 32,
+    n_lanes: int = 4,
+    n_nodes: int = 64,
+    *,
+    rate_per_second: float = 3.0,
+    horizon: float = 400.0,
+    query_horizon: float = 450.0,
+    max_group_pods: int = 32,
+    burst: tuple = (100.0, 150.0, 250.0),
+    max_pods_per_cycle: int = 256,
+    rounds: int = 5,
+    span_windows: int = 4,
+    horizon_mix: tuple = None,
+    smoke: bool = False,
+    json_path: str = None,
+) -> dict:
+    """The OPEN-LOOP client line (lane-async fleet, DESIGN §13): the same
+    heterogeneous scenario stream submitted to a wave-aligned fleet and a
+    lane-asynchronous fleet, with per-query horizons cycling
+    OPEN_LOOP_HORIZON_MIX — the workload shape where wave alignment
+    wastes the most device time (every wave runs to its longest lane).
+
+    Protocol: both fleets run the full stream once as warm-up (compile +
+    program warm), the jit caches and the recompile sentinel are sealed,
+    then `rounds` timed repeats run on the RESIDENT fleets; the reported
+    queries/s are medians (median-of->=5 in full mode).
+
+    In-bench asserts:
+    - A/B identity: every query's counters/replica readouts are
+      bit-identical between the wave and lane-async fleets.
+    - Zero post-warm-up recompiles (jit-cache counts + sentinel), as in
+      --sweep.
+    - Full mode only: mean lane occupancy > 90% on the mix, and the
+      lane-async fleet sustains >= 1.5x the wave fleet's queries/s.
+    """
+    import time as _time
+
+    from kubernetriks_tpu.batched.fleet import ScenarioFleet, jit_cache_sizes
+    from kubernetriks_tpu.recompile import RecompileSentinel, sentinel_mode
+
+    base_yaml, config, cluster_events, workload = _sweep_setup(
+        n_nodes, rate_per_second, horizon, max_group_pods, burst
+    )
+    scenarios, _ = _sweep_scenarios(n_queries)
+    mix = tuple(horizon_mix) if horizon_mix else OPEN_LOOP_HORIZON_MIX
+    horizons = [
+        query_horizon * mix[i % len(mix)] for i in range(n_queries)
+    ]
+
+    sentinel = (
+        RecompileSentinel("raise").install()
+        if sentinel_mode() is not False
+        else None
+    )
+
+    def build(lane_async):
+        return ScenarioFleet(
+            config,
+            cluster_events,
+            workload,
+            n_lanes=n_lanes,
+            horizon=query_horizon,
+            max_pods_per_cycle=max_pods_per_cycle,
+            use_pallas=None if not smoke else False,
+            lane_async=lane_async,
+            span_windows=span_windows if lane_async else None,
+            # Flight recorder on BOTH fleets so the A/B timing compares
+            # identical window programs (the ring record is in-graph);
+            # the async side's lane_active column cross-checks the host
+            # occupancy ledger (ring_lane_occupancy in the record) and
+            # the per-query latency stats flow into the observatory.
+            telemetry=True,
+        )
+
+    def submit_stream(fleet):
+        return [
+            fleet.submit(s, h) for s, h in zip(scenarios, horizons)
+        ]
+
+    wave = build(False)
+    asy = build(True)
+    # Warm-up: the full stream once per fleet, plus the A/B identity
+    # gate — every query's results bit-match across the two executions.
+    warm_wave = submit_stream(wave)
+    wave.run()
+    warm_asy = submit_stream(asy)
+    asy.run_async()
+    for i, (qw, qa) in enumerate(zip(warm_wave, warm_asy)):
+        rw, ra = wave.results[qw], asy.results[qa]
+        assert (
+            rw.counters == ra.counters
+            and rw.hpa_replicas == ra.hpa_replicas
+            and rw.ca_nodes == ra.ca_nodes
+        ), (
+            f"open-loop: query {i} diverges between the wave-aligned and "
+            f"lane-async fleets (scenario {scenarios[i]}, horizon "
+            f"{horizons[i]}):\n{rw.counters}\n{ra.counters}"
+        )
+    sizes_after_warm = jit_cache_sizes()
+    if sentinel is not None:
+        sentinel.seal("open-loop warm-up (both fleets, full stream)")
+    # The timed rounds start from a clean ledger: warm-up latencies are
+    # dominated by compile time and would swamp the percentiles.
+    asy.reset_query_stats()
+
+    wave_times, asy_times = [], []
+    for _ in range(max(1, rounds) if not smoke else 1):
+        submit_stream(wave)
+        t0 = _time.perf_counter()
+        wave.run()
+        wave_times.append(_time.perf_counter() - t0)
+        submit_stream(asy)
+        t0 = _time.perf_counter()
+        asy.run_async()
+        asy_times.append(_time.perf_counter() - t0)
+
+    sizes_after = jit_cache_sizes()
+    recompiled = {
+        name: (sizes_after[name], sizes_after_warm[name])
+        for name in sizes_after_warm
+        if sizes_after[name] != sizes_after_warm[name]
+    }
+    assert not recompiled, (
+        "open-loop: the post-warm-up query stream RECOMPILED jit entries "
+        f"(compiled-variant counts moved: {recompiled})"
+    )
+    sentinel_events = 0
+    if sentinel is not None:
+        sentinel.check("the open-loop post-warm-up query stream")
+        sentinel_events = len(sentinel.post_seal_events())
+        sentinel.uninstall()
+
+    wave_qps = n_queries / float(np.median(wave_times))
+    asy_qps = n_queries / float(np.median(asy_times))
+    speedup = asy_qps / wave_qps if wave_qps > 0 else float("inf")
+    occupancy = asy.lane_occupancy()
+    latency = asy.query_latency_percentiles()
+    report = asy.engine.telemetry_report() if asy.engine._telemetry else {}
+    ring_occ = (
+        report.get("resources", {}).get("occupancy", {}).get("lane_occupancy")
+    )
+    wave.close()
+    asy.close()
+
+    if not smoke:
+        assert occupancy["mean"] > 0.90, (
+            f"open-loop: mean lane occupancy {occupancy['mean']:.3f} <= "
+            "0.90 on the heterogeneous-horizon mix — dispatched lane-"
+            "windows are being wasted (span too wide for the mix?)"
+        )
+        assert speedup >= 1.5, (
+            f"open-loop: lane-async fleet at {asy_qps:.2f} queries/s vs "
+            f"wave-aligned {wave_qps:.2f} = {speedup:.2f}x < the 1.5x gate"
+        )
+
+    out = {
+        "value": asy_qps,
+        "open_loop": {
+            "queries": n_queries,
+            "lanes": n_lanes,
+            "span_windows": span_windows,
+            "horizon_mix": list(mix),
+            "rounds_timed": len(asy_times),
+            "wave_queries_per_s": round(wave_qps, 3),
+            "async_queries_per_s": round(asy_qps, 3),
+            "speedup_vs_wave": round(speedup, 3),
+            "lane_occupancy": {
+                "mean": round(occupancy["mean"], 4),
+                "min": round(occupancy["min"], 4),
+            },
+            "ring_lane_occupancy": ring_occ,
+            "latency_ms": {
+                k: round(v, 3)
+                for k, v in latency.items()
+                if k != "count"
+            },
+            "ab_identity_checked": n_queries,
+            "recompiles_after_warmup": 0,
+            "recompile_sentinel": {
+                "armed": sentinel is not None,
+                "post_warmup_events": sentinel_events,
+            },
+        },
+    }
+    if json_path:
+        with open(json_path, "w") as fh:
+            json.dump(out["open_loop"], fh, indent=2)
+            fh.write("\n")
+    return out
+
+
 def _sweep_path() -> str:
     from kubernetriks_tpu.flags import flag_str
 
     stem = flag_str("KTPU_SWEEP_PATH") or "ktpu_sweep"
     return f"{stem}.json"
+
+
+def _open_loop_path() -> str:
+    """The open-loop line's JSON artifact rides the sweep stem:
+    <KTPU_SWEEP_PATH or ./ktpu_sweep>_openloop.json (CI uploads both)."""
+    from kubernetriks_tpu.flags import flag_str
+
+    stem = flag_str("KTPU_SWEEP_PATH") or "ktpu_sweep"
+    return f"{stem}_openloop.json"
 
 
 def _trace_path(label: str) -> str:
@@ -1156,6 +1382,18 @@ def _emit_sweep(metric: str, value: dict) -> None:
         "sweep": value["sweep"],
         "value": round(value["value"], 3),
         "unit": "scenarios/s",
+    }
+    print(json.dumps(rec), flush=True)
+
+
+def _emit_open_loop(metric: str, value: dict) -> None:
+    """The open-loop line's unit is queries/s (continuous submit/poll
+    completions per wall-clock second through the lane-async fleet)."""
+    rec = {
+        "metric": metric,
+        "open_loop": value["open_loop"],
+        "value": round(value["value"], 3),
+        "unit": "queries/s",
     }
     print(json.dumps(rec), flush=True)
 
@@ -1218,6 +1456,19 @@ def main(argv=None) -> None:
             f"what-if scenarios/sec (scenario-vector fleet, {n} "
             "heterogeneous scenarios over resident lanes)",
             run_sweep(n_scenarios=n, sweep_path=_sweep_path()),
+        )
+        _emit_open_loop(
+            # The OPEN-LOOP companion line: a continuous submit/poll
+            # client streaming heterogeneous-horizon queries through the
+            # lane-asynchronous fleet vs the wave-aligned fleet on the
+            # same stream. In-bench gates: per-query A/B bit-identity,
+            # zero post-warm-up recompiles, lane occupancy > 90%, and
+            # >= 1.5x wave-aligned queries/s. Writes the open-loop
+            # record next to the sweep artifact (SWEEP_rXX.json
+            # material).
+            "what-if queries/sec (open-loop lane-async fleet: 32 "
+            "heterogeneous-horizon queries over 4 resident lanes)",
+            run_open_loop(json_path=_open_loop_path()),
         )
         return
     # --endurance [N]: the bounded-memory endurance line standalone — N
@@ -1360,6 +1611,34 @@ def main(argv=None) -> None:
                 "chaos faults)",
                 run_composed(4, 8, faults=True, **smoke_composed),
             )
+        _emit_open_loop(
+            # The OPEN-LOOP line: 8 heterogeneous-horizon queries
+            # streamed through a continuous submit/poll lane-async
+            # fleet next to the wave-aligned fleet on the same stream —
+            # the in-bench asserts require per-query A/B bit-identity
+            # (lane-async completion order must not change any result)
+            # and zero post-warm-up recompiles across pump rounds
+            # (a per-lane clock or trace offset regressing to a
+            # jit-static recompiles per reseed and fails loudly here).
+            # tests/test_bench_smoke.py pins this line's presence and
+            # position: BEFORE the sweep line, which must stay LAST
+            # (its baseline's jax.clear_caches would cold-start this
+            # line's fleets).
+            "what-if queries/sec (SMOKE, open-loop lane-async fleet: 8 "
+            "queries over 4 resident lanes)",
+            run_open_loop(
+                n_queries=8,
+                n_lanes=4,
+                n_nodes=8,
+                rate_per_second=0.375,
+                horizon=300.0,
+                query_horizon=350.0,
+                max_group_pods=16,
+                max_pods_per_cycle=64,
+                smoke=True,
+                json_path=_open_loop_path(),
+            ),
+        )
         _emit_sweep(
             # The scenario-FLEET line: 8 heterogeneous what-if scenarios
             # through one resident 4-lane fleet (batched/fleet.py) — the

@@ -714,6 +714,7 @@ class BatchedSimulation:
         reclaim_period: Optional[int] = None,
         scheduler_profile=None,
         scenario=None,
+        lane_async: bool = False,
     ) -> None:
         self.config = config
         # Scenario-vector fleet (batched/fleet.py): optional per-lane
@@ -1022,6 +1023,44 @@ class BatchedSimulation:
         self.conditional_move = bool(
             config.enable_unscheduled_pods_conditional_move
         )
+        # Lane-asynchronous fleet mode (batched/fleet.py, DESIGN §13):
+        # per-lane window clocks in StepConstants (lane_clock/lane_horizon)
+        # let each lane run its own virtual span inside the shared window
+        # programs — a finished lane is frozen by the window body and
+        # re-seeded in place (set_lane_plan + lane_reset) while neighbors
+        # keep stepping. Requires a SCENARIO build (the per-lane reset
+        # pristine + scenario leaves are the substrate) and the
+        # full-resident dispatch path: the sliding window, superspan
+        # executor, streaming feeder and fast-forward skip all assume one
+        # fleet-global clock, so composing them here would be a silent
+        # correctness hazard — loud errors instead (the
+        # stream-without-superspan precedent).
+        self.lane_async = bool(lane_async)
+        if self.lane_async:
+            if self._scenario is None:
+                raise ValueError(
+                    "lane_async=True requires a scenario build (scenario="
+                    "{...} / ScenarioFleet): per-lane resets re-seed from "
+                    "the scenario pristine"
+                )
+            if pod_window is not None:
+                raise ValueError(
+                    "lane_async=True requires the full-resident pod path "
+                    "(pod_window=None): the sliding window's refill cursor "
+                    "is fleet-global"
+                )
+            if superspan or stream:
+                raise ValueError(
+                    "lane_async=True is incompatible with the superspan "
+                    "executor / streaming feeder: their progress carries "
+                    "assume one fleet-global window clock"
+                )
+            # Tristate-off the global-clock perf statics instead of
+            # erroring on their accelerator defaults.
+            self._superspan = False
+            self._stream = False
+            self._fuse_slide = False
+            fast_forward = False
         self.consts = make_step_constants(config)
         self.ram_unit = ram_unit
         compiled_traces = list(compiled_traces)
@@ -1164,6 +1203,19 @@ class BatchedSimulation:
                 fault_seed=jnp.asarray(
                     seeds.astype(np.uint32), jnp.uint32
                 )
+            )
+        # Lane-async clocks: traced (C,) data in StepConstants, plus the
+        # host-side numpy mirrors the completion arithmetic reads (the
+        # traced leaves themselves are never read on the host — the
+        # scenariotrace pass's compile-once contract). All lanes start
+        # INACTIVE (horizon 0): the fleet arms each lane with
+        # set_lane_plan when it assigns a query.
+        if self.lane_async:
+            self._lane_clock_np = np.zeros((C,), np.int64)
+            self._lane_horizon_np = np.zeros((C,), np.int64)
+            self.consts = self.consts._replace(
+                lane_clock=jnp.asarray(self._lane_clock_np, jnp.int32),
+                lane_horizon=jnp.asarray(self._lane_horizon_np, jnp.int32),
             )
 
         if pod_window is not None:
@@ -1516,6 +1568,22 @@ class BatchedSimulation:
         ev_win, ev_off = from_f64_np(ev_time, config.scheduling_cycle_interval)
         self.slab = TraceSlab.build(ev_win, ev_off, ev_kind, ev_slot)
         self._ev_time_np = ev_time  # host copy (f64) for completion checks
+        self._lane_mux = None
+        if self.lane_async:
+            # Per-lane trace multiplexer (DESIGN §13): host copy of the
+            # just-built packed slab (build-time fetch of a host-sourced
+            # array — the cold construction boundary, not a steady-state
+            # sync), plus a warm pass of the data-only row install so the
+            # first RANGED query re-seeds under the sentinel without
+            # compiling anything.
+            from kubernetriks_tpu.batched.stream import LaneTraceMux
+
+            self._lane_mux = LaneTraceMux(np.asarray(self.slab.packed))  # ktpu: sync-ok(build-time host copy of the freshly built trace slab for the lane mux — construction boundary, no steady-state device read)
+            rows = self._lane_mux.offer(0)
+            self._lane_mux.retire([0])
+            self._install_lane_rows(
+                0, rows if rows is not None else self._lane_mux._base[0]
+            )
         if self._fast_forward_requested is None:
             finite = ev_time[np.isfinite(ev_time)]
             span = (
@@ -1808,7 +1876,12 @@ class BatchedSimulation:
             profile=self.profile,
         )
 
-    def _dispatch_windows(self, idxs: np.ndarray, fuse_slide: bool = False) -> None:
+    def _dispatch_windows(
+        self,
+        idxs: np.ndarray,
+        fuse_slide: bool = False,
+        freeze_lanes: bool = True,
+    ) -> None:
         """Run one chunk of windows and fold the results into self.state
         (+ gauge accumulation). With fuse_slide, dispatch the chunk+slide
         megastep instead (_fused_chunk_slide): the returned shift's host
@@ -1886,6 +1959,7 @@ class BatchedSimulation:
             jnp.asarray(idxs, jnp.int32),
             self.consts,
             collect_gauges=self.collect_gauges,
+            freeze_lanes=freeze_lanes,
             **self._window_call_kwargs(),
         )
         tr.end(PH_WINDOW_CHUNK, t0)
@@ -2129,6 +2203,217 @@ class BatchedSimulation:
             self._pending_flow = 0
         if self.observatory is not None:
             self.observatory.reset()
+
+    # --- lane-async clock protocol (DESIGN §13) ---------------------------
+
+    def horizon_windows(self, horizon: float) -> int:
+        """Window count a fresh run of `horizon` sim-seconds executes —
+        the lane_horizon a lane needs for per-query bit-identity with the
+        wave-aligned path (window_idxs from cursor 0)."""
+        interval = self.config.scheduling_cycle_interval
+        return int(math.floor(horizon / interval)) + 1
+
+    def set_lane_plan(self, lanes, start_window: int, horizons) -> None:
+        """Arm per-lane clocks: lanes start their virtual window 0 at
+        global window `start_window` and run `horizons[i]` windows. PURE
+        DATA update — the (C,) consts leaves are traced, so re-seeding a
+        lane never recompiles (the fleet's compile-once contract); the
+        numpy mirrors keep host completion arithmetic sync-free."""
+        if not self.lane_async:
+            raise ValueError(
+                "set_lane_plan requires an engine built with lane_async="
+                "True (per-lane window clocks)"
+            )
+        lanes = np.asarray(list(lanes), np.int64)  # ktpu: sync-ok(python lane-index list, no device value)
+        self._lane_clock_np[lanes] = int(start_window)
+        self._lane_horizon_np[lanes] = np.asarray(horizons, np.int64)  # ktpu: sync-ok(python horizon list into the host mirror, no device value)
+        self.consts = self.consts._replace(
+            lane_clock=jnp.asarray(self._lane_clock_np, jnp.int32),
+            lane_horizon=jnp.asarray(self._lane_horizon_np, jnp.int32),
+        )
+
+    def lane_windows_done(self) -> np.ndarray:
+        """(C,) bool: lanes whose planned span is fully dispatched (global
+        cursor past lane_clock + lane_horizon). Host arithmetic over the
+        numpy clock mirrors — zero device syncs; counters for finished
+        lanes are fetched by the caller at an existing host-block
+        boundary (fleet._lane_rows)."""
+        return (
+            self._lane_clock_np + self._lane_horizon_np
+            <= self.next_window_idx
+        )
+
+    def _install_lane_rows(self, lane: int, rows: np.ndarray) -> None:
+        """Data-only device install of one lane's (E, 4) trace rows via
+        dynamic_update_slice with TRACED start indices — one compiled
+        program for every lane (a static `.at[lane].set` would compile
+        per lane index and trip the post-warm-up sentinel)."""
+        packed = jax.lax.dynamic_update_slice(
+            self.slab.packed,
+            jnp.asarray(rows, jnp.int32)[None],
+            (
+                jnp.asarray(lane, jnp.int32),
+                jnp.asarray(0, jnp.int32),
+                jnp.asarray(0, jnp.int32),
+            ),
+        )
+        self.slab = TraceSlab(packed=packed)
+
+    def set_lane_trace(self, lane: int, lo: int = 0, hi=None) -> None:
+        """Install a per-lane workload row-range (stream.LaneTraceMux):
+        the lane replays only slab rows [lo, hi) (pod creates outside the
+        range and their removes masked to EV_NONE in place — host copy,
+        sort order preserved). Reseed-boundary call: the mux's never-
+        re-offer invariant refuses a lane whose previous range was not
+        retired by lane_reset. Pure data install — zero recompiles, zero
+        new steady-state syncs."""
+        if not self.lane_async or self._lane_mux is None:
+            raise ValueError(
+                "set_lane_trace requires an engine built with "
+                "lane_async=True (per-lane trace multiplexer)"
+            )
+        rows = self._lane_mux.offer(int(lane), lo, hi)
+        if rows is not None:
+            self._install_lane_rows(int(lane), rows)
+
+    def lane_windows_remaining(self) -> np.ndarray:
+        """(C,) host ints: windows left on each lane's plan from the
+        global cursor (0 for idle/finished lanes) — the pump's occupancy
+        ledger input. Same sync-free mirror arithmetic as
+        lane_windows_done."""
+        rem = (
+            self._lane_clock_np + self._lane_horizon_np
+            - self.next_window_idx
+        )
+        return np.clip(rem, 0, None)
+
+    def step_windows(self, n_windows: int) -> None:
+        """Dispatch exactly `n_windows` windows from the global cursor —
+        the lane-async pump's fixed-span dispatch. The full-resident plain
+        path compiles ONE program per distinct span length (program shape
+        = idxs length), so a free-running fleet that always pumps the same
+        span recompiles nothing after warm-up (the sweep's sentinel
+        asserts it). Same guard/drain protocol as step_until_time."""
+        n = int(n_windows)
+        if n <= 0:
+            return
+        if self.pod_window is not None:
+            raise ValueError(
+                "step_windows requires the full-resident pod path "
+                "(pod_window=None); sliding-window engines advance with "
+                "step_until_time"
+            )
+        if self.state.telemetry is not None:
+            pending = self.next_window_idx - self._ring_drained_at
+            if pending > 0 and pending + n > self._telemetry_ring_size:
+                self._maybe_drain_ring(force=True)
+        # All-active fast path: the host clock mirrors prove every lane
+        # stays inside its [clock, clock + horizon) span for the WHOLE
+        # chunk, so the state-wide freeze selects (identities there) are
+        # compiled out (step._window_body freeze_lanes=False). The mirrors
+        # are host-authoritative (clocks only move via set_lane_plan /
+        # lane_reset), so the proof costs no device read; spans touching a
+        # lane boundary keep the freezing program. Two warmed variants
+        # total — the pump's warm-up stream exercises both.
+        start = self.next_window_idx
+        freeze = True
+        if self.lane_async:
+            freeze = not (
+                bool(np.all(self._lane_clock_np <= start))
+                and bool(
+                    np.all(
+                        start + n
+                        <= self._lane_clock_np + self._lane_horizon_np
+                    )
+                )
+            )
+        with sanitize.guard(self._sanitize):
+            self._step_idxs(
+                np.arange(start, start + n, dtype=np.int32),
+                freeze_lanes=freeze,
+            )
+        self._maybe_drain_ring()
+
+    def precompile_lane_spans(self, span: int) -> int:
+        """Warm the lane-async pump's window-program variants: every
+        power-of-two chunk of the pump ladder {span, span/2, ..., 1}
+        plus the raw drain-tail span, each in BOTH freeze variants (the
+        boundary-aligned no-freeze program and the freezing fallback).
+        The pump's organic stream only exercises the variants its feed
+        pattern happens to need — a burst-submitted stream runs
+        boundary-aligned (no-freeze) chunks exclusively until the queue
+        dries, so its first freezing dispatch would otherwise compile
+        mid-stream, after the fleet declared itself warm (the armed
+        sentinel in tests/test_fleet_async.py catches exactly that).
+        Same scratch-copy protocol as precompile_chunks: the current
+        window index repeats chunk times, so per-shape warm-up compute
+        is ~one real window plus empty cycles. Returns the number of
+        programs dispatched (cache hits included)."""
+        if not self.lane_async or self.pod_window is not None:
+            return 0
+        from kubernetriks_tpu.batched.step import run_windows_donated
+
+        win_fn = run_windows_donated if self.donate else run_windows
+        sizes = []
+        c = 1 << (max(int(span), 1).bit_length() - 1)
+        while c >= 1:
+            sizes.append(c)
+            c //= 2
+        if int(span) not in sizes:
+            sizes.insert(0, int(span))
+        n = 0
+        t_warm = self.tracer.begin()
+        for chunk in sizes:
+            idxs = jnp.full((chunk,), self.next_window_idx, jnp.int32)
+            for freeze in (False, True):
+                out = win_fn(
+                    tree_copy(self.state),
+                    self.slab,
+                    idxs,
+                    self.consts,
+                    collect_gauges=self.collect_gauges,
+                    freeze_lanes=freeze,
+                    **self._window_call_kwargs(),
+                )
+                jax.block_until_ready(out)  # discarded: warm-up only  # ktpu: sync-ok(warm-up: AOT compile of the lane-span variants, outside every timed region)
+                n += 1
+        self.tracer.end(PH_PRECOMPILE, t_warm)
+        return n
+
+    def lane_reset(self, lanes) -> None:
+        """Per-lane pristine reset that PRESERVES the telemetry ring: the
+        free-running engine re-seeds finished lanes mid-flight, and a
+        plain fleet_reset(lanes) would tree-map the ring back to its
+        pristine (cursor 0) snapshot — desynchronizing the per-lane
+        cursors the uniform-window scatter relies on and dropping
+        undrained rows. Strips the ring from both sides of the donated
+        select (None = absent pytree node, one extra warmed program
+        variant) and re-attaches the live ring after."""
+        from kubernetriks_tpu.batched.fleet import _reset_lanes
+
+        if not self.lane_async:
+            raise ValueError(
+                "lane_reset requires an engine built with lane_async=True"
+            )
+        if self._pristine is None:
+            raise ValueError(
+                "lane_reset requires an engine built with scenario= "
+                "(the fleet build keeps the pristine state snapshot)"
+            )
+        mask = np.zeros((self.n_clusters,), bool)
+        mask[np.asarray(list(lanes), np.int64)] = True  # ktpu: sync-ok(lane reset: host numpy over a python lane list, no device values)
+        if self._lane_mux is not None:
+            # The reset boundary retires the lanes' offered trace ranges:
+            # the next set_lane_trace for them is legal again.
+            self._lane_mux.retire(lanes)
+        ring = self.state.telemetry
+        state = self.state._replace(telemetry=None)
+        pristine = self._pristine._replace(telemetry=None)
+        donated_in = state if self._sanitize else None
+        state = _reset_lanes(state, pristine, jnp.asarray(mask))
+        if donated_in is not None:
+            sanitize.consume_donated(donated_in)
+        self.state = state._replace(telemetry=ring)
 
     def step_until_time(self, until_time: float) -> None:
         """Advance to `until_time`. THE steady-state dispatch region: under
@@ -3128,9 +3413,16 @@ class BatchedSimulation:
         ):
             return int(to_host(self.state.metrics.scheduling_decisions).sum())
 
-    def _step_idxs(self, idxs: np.ndarray, fuse_slide: bool = False) -> None:
+    def _step_idxs(
+        self,
+        idxs: np.ndarray,
+        fuse_slide: bool = False,
+        freeze_lanes: bool = True,
+    ) -> None:
         if not (self.profile_dir or self.log_throughput):
-            self._dispatch_windows(idxs, fuse_slide=fuse_slide)
+            self._dispatch_windows(
+                idxs, fuse_slide=fuse_slide, freeze_lanes=freeze_lanes
+            )
             self._check_finite()
             return
 
@@ -3157,7 +3449,9 @@ class BatchedSimulation:
         before = self._decisions_total() if self.log_throughput else 0
         t0 = time.perf_counter()
         with ctx, self.tracer.span(PH_CHUNK_FENCED):
-            self._dispatch_windows(idxs, fuse_slide=fuse_slide)
+            self._dispatch_windows(
+                idxs, fuse_slide=fuse_slide, freeze_lanes=freeze_lanes
+            )
             jax.block_until_ready(self.state.time)  # ktpu: sync-ok(instrumented path: fence so the per-chunk clock measures device work, not dispatch)
         elapsed = time.perf_counter() - t0
         self.tracer.annotate = False

@@ -37,18 +37,28 @@ superspan program serves any scenario mix, and this module supplies:
   points — zero NEW syncs inside the dispatch loop). Compile and warm-up
   amortize across the whole query stream.
 
-Lane reset protocol (honest scope): the engine's window clock is
-fleet-GLOBAL (every lane steps the same window index), so queries are
-packed into WAVES — all lanes reset together at a wave boundary, then the
-wave runs to its queries' horizons (per-lane results are read as each
-horizon passes; lanes whose horizon came early keep simulating idle).
-A per-lane window-clock offset (true continuous batching, a lane freed
-mid-wave re-seeding immediately) is the named follow-up; the per-lane
-config vectors landed here are exactly what it needs.
+Lane reset protocol — two modes:
+
+- WAVE-aligned (the default): the engine's window clock is fleet-global,
+  so queries pack into C-lane waves — all lanes reset together at a wave
+  boundary, then the wave runs to its queries' horizons (lanes whose
+  horizon came early keep simulating idle until the wave drains).
+- LANE-ASYNCHRONOUS (`lane_async=True`, DESIGN §13): the engine carries
+  per-lane window clocks (StepConstants.lane_clock / lane_horizon —
+  traced (C,) data), each lane steps its own virtual span inside the
+  shared window programs, and a finished lane is reset + re-seeded IN
+  PLACE while neighbors keep stepping. Queries flow through a continuous
+  `submit()` / `pump()` / `poll()` engine (`run_async()` drains the
+  queue); per-query results are bit-identical to the wave-aligned path
+  on the same (scenario, horizon) mix (tests/test_fleet_async.py's A/B
+  gate), per-lane completion is pure host arithmetic over the clock
+  mirrors (zero new syncs), and the telemetry ring's lane_active column
+  feeds the observatory's lane-occupancy gauge + idle-lane verdict.
 """
 
 from __future__ import annotations
 
+import time
 from collections import deque
 from dataclasses import dataclass, fields
 from functools import partial
@@ -378,9 +388,12 @@ class ScenarioFleet:
         horizon: float,
         strict_divergence: bool = True,
         build_scenarios: Optional[Sequence[Optional[Scenario]]] = None,
+        lane_async: bool = False,
+        span_windows: Optional[int] = None,
         **engine_kwargs,
     ) -> None:
         from kubernetriks_tpu.batched.engine import build_batched_from_traces
+        from kubernetriks_tpu.flags import flag_int
 
         if n_lanes < 1:
             raise ValueError("a fleet needs at least one lane")
@@ -388,6 +401,12 @@ class ScenarioFleet:
         self.n_lanes = int(n_lanes)
         self.default_horizon = float(horizon)
         self.strict_divergence = bool(strict_divergence)
+        self.lane_async = bool(lane_async)
+        if self.lane_async:
+            engine_kwargs.setdefault("lane_async", True)
+        if span_windows is None:
+            span_windows = flag_int("KTPU_LANE_SPAN")
+        self.span_windows = max(1, int(span_windows)) if span_windows else 8
         # Build WITH the scenario vectors so every scenario-bearing leaf
         # is (C,)-shaped traced data from the start (later updates are
         # pure data; in particular consts.fault_seed's pytree presence is
@@ -427,16 +446,53 @@ class ScenarioFleet:
         from kubernetriks_tpu.recompile import maybe_sentinel
 
         self._sentinel = maybe_sentinel()
+        # Lane-async bookkeeping (pump/poll, DESIGN §13). _live_vectors is
+        # the CURRENT per-lane config row set: assignments rewrite only
+        # the re-seeded lanes' rows, so update_scenario hands in-flight
+        # lanes bit-identical values and their trajectories are untouched.
+        self._live_vectors = {k: v.copy() for k, v in self._vectors.items()}
+        self._active: Dict[int, tuple] = {}  # lane -> (qid, scen, horizon)
+        self._trace_rows: Dict[int, tuple] = {}  # qid -> (lo, hi)
+        self._submit_wall: Dict[int, float] = {}
+        self._completed: deque = deque()
+        self.query_latency_s: Dict[int, float] = {}
+        self.pump_rounds = 0
+        # True once a pump round has exercised the full program set
+        # (assign + step + drain) — the sentinel guards rounds after that.
+        self._async_warm_done = False
+        # Span values whose window-program variants were AOT-warmed
+        # (engine.precompile_lane_spans) — first drain alone cannot
+        # prove the drain tail's freezing program compiled, because a
+        # burst-submitted stream runs boundary-aligned (no-freeze)
+        # chunks exclusively until the queue dries.
+        self._warm_spans: set = set()
+        self.lane_busy_windows = np.zeros((self.n_lanes,), np.int64)
+        self.lane_total_windows = np.zeros((self.n_lanes,), np.int64)
 
     # -- query intake --------------------------------------------------------
 
     def submit(
-        self, scenario: Optional[Scenario] = None, horizon: Optional[float] = None
+        self,
+        scenario: Optional[Scenario] = None,
+        horizon: Optional[float] = None,
+        trace_rows: Optional[tuple] = None,
     ) -> int:
         """Queue one what-if query; returns its id (the key into
-        `results` after `run()`)."""
+        `results` after `run()` / the pump's drains). trace_rows:
+        optional (lo, hi) workload row-range for the query's lane
+        (lane-async builds only — engine.set_lane_trace installs it at
+        the lane's reseed boundary)."""
+        if trace_rows is not None:
+            if not self.lane_async:
+                raise ValueError(
+                    "trace_rows needs lane_async=True (the per-lane "
+                    "trace multiplexer)"
+                )
+            lo, hi = trace_rows
+            self._trace_rows[self._next_query] = (int(lo), hi)
         qid = self._next_query
         self._next_query += 1
+        self._submit_wall[qid] = time.monotonic()
         self._queue.append(
             (
                 qid,
@@ -469,7 +525,13 @@ class ScenarioFleet:
         }
 
     def _drain_lane(
-        self, qid: int, lane: int, horizon: float, scen: Scenario, rows: Dict
+        self,
+        qid: int,
+        lane: int,
+        horizon: float,
+        scen: Scenario,
+        rows: Dict,
+        wave: Optional[int] = None,
     ) -> None:
         row = rows[lane]
         clamped = int(row.pop("hpa_reserve_clamped"))
@@ -490,7 +552,7 @@ class ScenarioFleet:
             ca = [int(v) for v in eng.ca_node_counts(lane)]
         self.results[qid] = FleetResult(
             query=qid,
-            wave=self.waves_run,
+            wave=self.waves_run if wave is None else wave,
             lane=lane,
             horizon=horizon,
             scenario=scen,
@@ -549,6 +611,199 @@ class ScenarioFleet:
             ]
             self._run_wave(wave)
         return self.results
+
+    # -- lane-async pump (continuous submit/poll, DESIGN §13) ----------------
+
+    def pump(self, span_windows: Optional[int] = None) -> int:
+        """One lane-async scheduling round: seed idle lanes from the
+        queue, step up to `span_windows` global windows in power-of-two
+        chunks clamped to the nearest lane-plan boundary (each chunk
+        shape compiles once; boundary-aligned chunks run the no-freeze
+        window program and never overshoot a horizon), then drain the
+        lanes whose per-lane clock says their plan completed — pure host
+        arithmetic over the clock mirrors, zero new device syncs. Returns
+        the number of queries completed this round."""
+        if not self.lane_async:
+            raise ValueError(
+                "pump() needs lane_async=True (wave-aligned fleets run())"
+            )
+        span = int(span_windows) if span_windows else self.span_windows
+        if span not in self._warm_spans:
+            self.engine.precompile_lane_spans(span)
+            self._warm_spans.add(span)
+        if self._sentinel is not None and self._async_warm_done:
+            with self._sentinel.expect_none(
+                f"fleet pump round {self.pump_rounds + 1} (post-warm-up)"
+            ):
+                drained = self._pump_inner(span)
+        else:
+            drained = self._pump_inner(span)
+        self.pump_rounds += 1
+        if drained and self.pump_rounds >= 1:
+            # Assign + step + drain have all run at least once: every
+            # program class the steady query stream touches is warm.
+            self._async_warm_done = True
+        return drained
+
+    def _pump_inner(self, span: int) -> int:
+        eng = self.engine
+        # 1. Seed idle lanes: rewrite ONLY their _live_vectors rows (base
+        # row + this query's overrides), reset their state in place, and
+        # start their clocks at the engine's current global window.
+        assigned = []
+        for lane in range(self.n_lanes):
+            if lane in self._active or not self._queue:
+                continue
+            assigned.append((lane, *self._queue.popleft()))
+        if assigned:
+            for lane, qid, scen, horizon in assigned:
+                for key in SCENARIO_KEYS:
+                    self._live_vectors[key][lane] = self._vectors[key][lane]
+                for key, val in scen.overrides().items():
+                    self._live_vectors[key][lane] = val
+            eng.update_scenario(
+                {k: v.copy() for k, v in self._live_vectors.items()}
+            )
+            lanes = [lane for lane, _, _, _ in assigned]
+            eng.lane_reset(lanes)
+            for lane, qid, _, _ in assigned:
+                # Always (re)install the lane's workload range at the
+                # reseed boundary: a previous query's mask must not leak
+                # into this one (full range when the query carries none;
+                # the mux skips the device write when nothing changed).
+                lo, hi = self._trace_rows.pop(qid, (0, None))
+                eng.set_lane_trace(lane, lo, hi)
+            eng.set_lane_plan(
+                lanes,
+                eng.next_window_idx,
+                [eng.horizon_windows(h) for _, _, _, h in assigned],
+            )
+            for lane, qid, scen, horizon in assigned:
+                self._active[lane] = (qid, scen, horizon)
+        if not self._active:
+            return 0
+        # 2. Dispatch, boundary-aligned: while every lane is mid-plan,
+        # step power-of-two sub-spans clamped to the NEAREST lane
+        # completion (ladder {span, span/2, ..., 1} — each shape compiles
+        # once). Chunks then never cross a plan boundary, so (a) no lane
+        # overshoots its horizon (zero occupancy waste while the queue
+        # feeds) and (b) the engine's host-mirror proof selects the
+        # no-freeze window program for every chunk — the lane-async
+        # executor's per-window cost collapses to the wave-aligned
+        # program's. Only the drain tail (queue dry, parked lanes riding
+        # along) falls back to the fixed span + freezing program.
+        remaining0 = eng.lane_windows_remaining()
+        queue_fed = bool(self._queue)
+        stepped = 0
+        if len(self._active) == self.n_lanes:
+            left = span
+            remaining = remaining0.copy()
+            while left > 0:
+                m = int(min(left, remaining.min()))
+                sub = 1 << (m.bit_length() - 1)
+                eng.step_windows(sub)
+                stepped += sub
+                left -= sub
+                remaining = remaining - sub
+                if (remaining <= 0).any():
+                    # A plan completed exactly at the chunk edge: stop the
+                    # round so the drain/reseed below runs promptly.
+                    break
+        else:
+            eng.step_windows(span)
+            stepped = span
+        # 3. Occupancy ledger (host ints): a lane is busy for
+        # min(stepped, windows left on its plan). Idle lanes count as
+        # wasted dispatch only while queries were WAITING (queue fed) —
+        # parked lanes riding out the drain tail of a dried-up stream are
+        # not the async executor's waste (an open-loop feed never dries).
+        for lane in range(self.n_lanes):
+            if lane in self._active:
+                self.lane_busy_windows[lane] += min(
+                    stepped, int(remaining0[lane])
+                )
+                self.lane_total_windows[lane] += stepped
+            elif queue_fed:
+                self.lane_total_windows[lane] += stepped
+        # 4. Drain completed plans.
+        done = eng.lane_windows_done()
+        finished = [lane for lane in sorted(self._active) if done[lane]]
+        if not finished:
+            return 0
+        rows = self._lane_rows(finished)
+        now = time.monotonic()
+        obs = getattr(eng, "observatory", None)
+        for lane in finished:
+            qid, scen, horizon = self._active.pop(lane)
+            self._drain_lane(
+                qid, lane, horizon, scen, rows, wave=self.pump_rounds
+            )
+            lat = now - self._submit_wall.get(qid, now)
+            self.query_latency_s[qid] = lat
+            self._completed.append(qid)
+            if obs is not None:
+                obs.note_query(lat)
+        return len(finished)
+
+    def poll(self) -> List[FleetResult]:
+        """Results completed since the last poll, in completion order —
+        the read side of the continuous submit/pump/poll engine."""
+        out = [self.results[qid] for qid in self._completed]
+        self._completed.clear()
+        return out
+
+    def run_async(
+        self, span_windows: Optional[int] = None
+    ) -> Dict[int, FleetResult]:
+        """Pump until the queue and every in-flight lane drain. The async
+        counterpart of run(): same {query id: FleetResult} map, same
+        per-query numbers (the A/B gate in tests/test_fleet_async.py),
+        but a finished lane re-seeds immediately instead of idling to the
+        wave boundary."""
+        if not self.lane_async:
+            raise ValueError(
+                "run_async() needs lane_async=True (wave-aligned fleets run())"
+            )
+        while self._queue or self._active:
+            self.pump(span_windows)
+        return self.results
+
+    def lane_occupancy(self) -> Dict[str, float]:
+        """Busy fraction of dispatched lane-windows (the open-loop bench
+        gate): per-lane busy/total from the pump ledger, reported as the
+        across-lane mean and min. 1.0 before any pump round."""
+        total = np.maximum(self.lane_total_windows, 1)
+        frac = self.lane_busy_windows / total
+        if not self.lane_total_windows.any():
+            frac = np.ones_like(frac)
+        return {
+            "mean": float(frac.mean()),
+            "min": float(frac.min()),
+            "lane_windows_busy": int(self.lane_busy_windows.sum()),
+            "lane_windows_total": int(self.lane_total_windows.sum()),
+        }
+
+    def reset_query_stats(self) -> None:
+        """Forget the latency samples and the occupancy ledger (bench
+        warm-up boundary: the reported percentiles/occupancy then
+        reflect the resident steady state, not compile time)."""
+        self.query_latency_s.clear()
+        self.lane_busy_windows[:] = 0
+        self.lane_total_windows[:] = 0
+
+    def query_latency_percentiles(self) -> Dict[str, float]:
+        """Submit-to-drain wall latency percentiles (ms) over every
+        completed query — exported next to queries/s in the open-loop
+        bench record and the observatory report."""
+        if not self.query_latency_s:
+            return {"count": 0}
+        lat = np.asarray(sorted(self.query_latency_s.values()))
+        return {
+            "count": int(lat.size),
+            "p50_ms": float(np.percentile(lat, 50) * 1e3),
+            "p95_ms": float(np.percentile(lat, 95) * 1e3),
+            "p99_ms": float(np.percentile(lat, 99) * 1e3),
+        }
 
     def sweep(
         self, scenarios: Sequence[Scenario], horizon: Optional[float] = None

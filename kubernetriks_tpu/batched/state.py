@@ -242,7 +242,16 @@ TELEM_CA_RESERVE = 9  # CA reserve slots consumed across groups (ca_cursor:
 # telemetry/observatory.UNBOUNDED_SENTINEL mean "no sliding window /
 # whole trace resident" (the trace_pod_bound default is a huge sentinel).
 TELEM_POD_HEADROOM = 10
-TELEMETRY_COLS = 11
+# Lane-asynchronous fleet (batched/fleet.py lane_async mode): 1 when this
+# lane was ACTIVE for the window (its per-lane clock placed the global
+# window inside [lane_clock, lane_clock + lane_horizon)), else 0. Always 1
+# outside lane-async builds. The observatory folds the column into the
+# lane-occupancy gauge and the idle-lane-waste verdict; in lane-async mode
+# the TELEM_WINDOW column records the GLOBAL window index (uniform across
+# lanes — ring.merge_snapshot keys on it), while every other column is the
+# lane's own (virtual-clock) value.
+TELEM_LANE_ACTIVE = 11
+TELEMETRY_COLS = 12
 
 
 class TelemetryRing(NamedTuple):
@@ -354,6 +363,16 @@ class StepConstants(NamedTuple):
     # bit-identical to a standalone run with that seed. Traced data — a
     # fleet can re-seed lanes between queries without recompiling.
     fault_seed: Optional[jnp.ndarray] = None
+    # Lane-asynchronous fleet (engine lane_async=True): per-lane window
+    # clocks. A lane's VIRTUAL window for global window W is W -
+    # lane_clock[c]; the lane is active while 0 <= W - lane_clock[c] <
+    # lane_horizon[c], and the window body freezes (reverts) every state
+    # leaf of inactive lanes so a finished lane parks bit-exactly at its
+    # final state until the host re-seeds it in place (engine
+    # set_lane_plan — traced data, so a reseed never recompiles). None
+    # (the default) keeps programs identical to the wave-aligned build.
+    lane_clock: Optional[jnp.ndarray] = None  # (C,) int32 global start window
+    lane_horizon: Optional[jnp.ndarray] = None  # (C,) int32 windows to run
 
 
 def make_step_constants(config) -> StepConstants:
@@ -558,8 +577,32 @@ TELEMETRY_RING_LEAVES = ("buf", "cursor")
 # StepConstants leaves that are per-lane TRACED scenario data (the
 # scenariotrace lint pass forbids them from flowing into Python control
 # flow, host casts, jit statics or shape expressions — the fleet's
-# compile-once guarantee; `is None` presence checks stay legal).
-SCENARIO_TRACED_CONSTS = ("fault_seed",)
+# compile-once guarantee; `is None` presence checks stay legal). The
+# lane-async clock leaves are traced for the same reason: re-seeding a
+# finished lane (engine.set_lane_plan) is a data update, never a
+# recompile. Host-side mirrors live under different names
+# (engine._lane_clock_np / _lane_horizon_np) so host arithmetic never
+# reads the traced leaves.
+SCENARIO_TRACED_CONSTS = ("fault_seed", "lane_clock", "lane_horizon")
+
+# StepConstants manifest for the stateleaf lint pass: like
+# CLUSTER_STATE_LEAVES, a new consts leaf must be added here (and to
+# AXIS_SIGNATURES below if per-lane-shaped) or the pass fails naming it —
+# the lane-async clock leaves are the template.
+STEP_CONSTANTS_LEAVES = (
+    "scheduling_interval",
+    "time_per_node",
+    "delta_pod_enqueue",
+    "delta_bind_start",
+    "delta_reschedule",
+    "flush_interval",
+    "max_unschedulable_stay",
+    "trace_pod_bound",
+    "resident_shift",
+    "fault_seed",
+    "lane_clock",
+    "lane_horizon",
+)
 
 # Declared axis signatures of state leaves (the shapecontract lint pass):
 # "C" = per-cluster lane vector, "C,P"/"C,N" = per-object planes, "C,*" =
@@ -570,6 +613,9 @@ SCENARIO_TRACED_CONSTS = ("fault_seed",)
 # axis-parameterized helpers, never a bare broadcast).
 AXIS_SIGNATURES = {
     "time": "C",
+    # StepConstants lane-async clock leaves (per-lane vectors)
+    "lane_clock": "C",
+    "lane_horizon": "C",
     "queue_seq_counter": "C",
     "event_cursor": "C",
     "pod_base": "C",

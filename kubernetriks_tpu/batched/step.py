@@ -60,6 +60,7 @@ from kubernetriks_tpu.batched.state import (
     PHASE_RUNNING,
     PHASE_SUCCEEDED,
     PHASE_UNSCHEDULABLE,
+    NODE_HOT_LEAVES,
     StepConstants,
     TraceSlab,
     swap_node_layout,
@@ -1737,12 +1738,57 @@ def _run_scheduling_cycle(
     )
 
 
+def _freeze_lanes(
+    state: ClusterBatchState,
+    state0: ClusterBatchState,
+    active: jnp.ndarray,
+    lane_major: bool = False,
+) -> ClusterBatchState:
+    """Lane-async clock protocol (DESIGN §13): revert every state leaf of
+    INACTIVE lanes to its pre-window value, so a lane outside its
+    [lane_clock, lane_clock + lane_horizon) span parks bit-exactly while
+    neighbors keep stepping. `active` is the (C,) bool lane mask; the
+    telemetry ring is excluded (inactive lanes still record their
+    zero-delta row — the occupancy column needs it) and the hot node
+    leaves mask along their own cluster axis (axis 1 inside lane-major
+    programs — a bare leading-C broadcast would be the exact hazard the
+    shapecontract pass patrols). Pure selects on values the body already
+    holds: no reductions, no new syncs."""
+
+    def keep(cur, prev, c_axis):
+        shape = [1] * cur.ndim
+        shape[c_axis] = active.shape[0]
+        return jnp.where(active.reshape(shape), cur, prev)
+
+    nodes = state.nodes
+    frozen_nodes = nodes._replace(
+        **{
+            name: jax.tree.map(
+                lambda cur, prev, ax=(
+                    1 if (lane_major and name in NODE_HOT_LEAVES) else 0
+                ): keep(cur, prev, ax),
+                getattr(nodes, name),
+                getattr(state0.nodes, name),
+            )
+            for name in nodes._fields
+        }
+    )
+    rest = jax.tree.map(
+        lambda cur, prev: keep(cur, prev, 0),
+        state._replace(nodes=None, telemetry=None),
+        state0._replace(nodes=None, telemetry=None),
+    )
+    return rest._replace(nodes=frozen_nodes, telemetry=state.telemetry)
+
+
 def _telemetry_record(
     state: ClusterBatchState,
     m0,
     W: jnp.ndarray,
     consts: StepConstants,
     lane_major: bool = False,
+    telem_window=None,
+    lane_active=None,
 ):
     """Fold one per-window record row into the device telemetry ring:
     metric-counter deltas vs the window's incoming metrics `m0` plus queue
@@ -1804,9 +1850,15 @@ def _telemetry_record(
         + (m1.pod_restarts - m0.pod_restarts)
         + (m1.pods_failed - m0.pods_failed)
     )
+    # Lane-async mode: the window column records the GLOBAL window index
+    # (telem_window) so it stays lane-uniform — ring.merge_snapshot keys
+    # on buf[0, :, 0] — while every other column carries the lane's own
+    # values; the lane_active bit is the occupancy observable. Outside
+    # lane-async builds both default to the wave-aligned behavior
+    # (window = W, active = 1 everywhere).
     row = jnp.stack(
         [
-            W,
+            telem_window if telem_window is not None else W,
             m1.scheduling_decisions - m0.scheduling_decisions,
             queued,
             unsched,
@@ -1817,6 +1869,11 @@ def _telemetry_record(
             hpa_used,
             ca_used,
             headroom,
+            (
+                lane_active.astype(jnp.int32)
+                if lane_active is not None
+                else jnp.ones_like(W)
+            ),
         ],
         axis=-1,
     ).astype(jnp.int32)
@@ -1852,8 +1909,30 @@ def _window_body(
     reclaim: bool = False,
     reclaim_period: int = 1,
     profile=None,
+    freeze_lanes: bool = True,
 ) -> ClusterBatchState:
     W = jnp.broadcast_to(jnp.asarray(W, jnp.int32), state.time.shape)
+    # Lane-async clock protocol (engine lane_async=True, DESIGN §13): each
+    # lane steps its VIRTUAL window W - lane_clock[c] — bit-identical to a
+    # fresh run's window of that index — and is active only inside
+    # [0, lane_horizon[c]). Inactive lanes still execute the body (the
+    # clamp keeps the virtual index sane) and are reverted wholesale by
+    # _freeze_lanes before the telemetry record, so a finished lane parks
+    # at its exact final state until the host re-seeds it. lane_clock is
+    # traced (C,) data: re-seeding never recompiles. freeze_lanes=False is
+    # the ALL-ACTIVE fast path: the engine's host clock mirrors prove no
+    # lane enters or leaves its span during the dispatched chunk, so the
+    # state-wide revert selects (pure identities there) are compiled out —
+    # bit-identical by construction, and the dominant per-window saving of
+    # the lane-async executor (the freeze is O(state) every window).
+    telem_W = W
+    lane_active = None
+    state0 = None
+    if consts.lane_clock is not None:
+        rel = W - consts.lane_clock
+        lane_active = (rel >= 0) & (rel < consts.lane_horizon)
+        state0 = state if freeze_lanes else None
+        W = jnp.maximum(rel, 0)
     # Telemetry ring (flight recorder): the window's incoming metric
     # counters, diffed at the end of the body into one per-window record.
     m0 = state.metrics
@@ -1984,10 +2063,21 @@ def _window_body(
             reclaim=reclaim,
         )
         state = state._replace(auto=auto)
+    if lane_active is not None and state0 is not None:
+        # Freeze BEFORE the record: frozen lanes then diff m1 == m0 and
+        # record zero-delta rows (their gauges re-read the parked state),
+        # so the ring never carries phantom progress for an idle lane.
+        state = _freeze_lanes(state, state0, lane_active, lane_major)
     if state.telemetry is not None:
         state = state._replace(
             telemetry=_telemetry_record(
-                state, m0, W, consts, lane_major=lane_major
+                state,
+                m0,
+                W,
+                consts,
+                lane_major=lane_major,
+                telem_window=telem_W,
+                lane_active=lane_active,
             )
         )
     return state
@@ -2438,6 +2528,7 @@ def _run_windows_impl(
     reclaim: bool = False,
     reclaim_period: int = 1,
     profile=None,
+    freeze_lanes: bool = True,
 ):
     """Scan a whole sequence of scheduling-cycle windows on-device (the hot
     benchmark loop: no host round-trips between cycles). window_idxs: (Wn,)
@@ -2476,6 +2567,7 @@ def _run_windows_impl(
             reclaim=reclaim,
             reclaim_period=reclaim_period,
             profile=profile,
+            freeze_lanes=freeze_lanes,
         )
         return new, (
             gauge_snapshot(new, lane_major=lane_major)
@@ -2492,11 +2584,11 @@ def _run_windows_impl(
 
 
 run_windows = partial(
-    jax.jit, static_argnames=_STEP_STATICS + ("collect_gauges",)
+    jax.jit, static_argnames=_STEP_STATICS + ("collect_gauges", "freeze_lanes")
 )(_run_windows_impl)
 run_windows_donated = jax.jit(
     _run_windows_impl,
-    static_argnames=_STEP_STATICS + ("collect_gauges",),
+    static_argnames=_STEP_STATICS + ("collect_gauges", "freeze_lanes"),
     donate_argnums=(0,),
 )
 

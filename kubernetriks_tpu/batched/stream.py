@@ -427,3 +427,122 @@ class StreamFeeder:
                     },
                 },
             }
+
+
+class LaneTraceMux:
+    """Per-lane workload multiplexer over the compiled trace slab — the
+    full-resident analog of `trace.feeder.WorkloadSegmentReader`'s
+    row-range contract (the PayloadSource seam), turned 90 degrees: where
+    the streaming feeder offers every lane the SAME row window of an
+    unbounded trace, the mux offers each lane its OWN row-range of the
+    resident slab, so a lane-async fleet can replay a workload subset per
+    query without recompiling anything (the masked rows are pure data).
+
+    Semantics (`offer(lane, lo, hi)`): slab rows [lo, hi) of the lane are
+    the kept range. Plain-pod CREATE events outside it are masked to
+    EV_NONE IN PLACE — `win` stays untouched, so the per-lane time sort
+    the event loop's searchsorted gathers rely on is preserved — and pod
+    REMOVE events are masked by SLOT membership: a remove whose slot's
+    create was masked is masked too (never a remove without its create),
+    while a remove of a slot the slab never creates (pre-existing pods)
+    is always kept. Node and chaos events are never masked: cluster shape
+    and fault streams belong to the scenario vectors, not the workload
+    range.
+
+    Never-re-offer (per lane): `offer` REFUSES a lane whose previous
+    range is still flying — the engine retires a lane's range at its
+    reset boundary (`engine.lane_reset` -> `retire`), exactly like the
+    feeder ring's retired-slab high-water mark refuses to re-serve a
+    spent slab. Mutating an in-flight lane's rows would change history
+    the scan carry already consumed.
+
+    Host-only: the mux owns a host copy of the packed slab and returns
+    host row blocks; the ENGINE owns the device install
+    (`engine.set_lane_trace`, a data-only dynamic_update_slice at the
+    reseed host-block boundary — zero new steady-state syncs).
+    """
+
+    def __init__(self, packed) -> None:
+        import numpy as np
+
+        base = np.array(packed, np.int32)  # ktpu: sync-ok(mux construction: one owned host copy of the freshly built slab, never on the steady-state path)
+        if base.ndim != 3 or base.shape[-1] != 4:
+            raise ValueError(
+                f"LaneTraceMux wants a (C, E, 4) packed slab, got {base.shape}"
+            )
+        self._base = base
+        C = base.shape[0]
+        self._flying = [False] * C  # offer outstanding (not yet retired)
+        self._installed = [None] * C  # last (lo, hi) served per lane
+        self.offers = 0
+
+    @property
+    def n_rows(self) -> int:
+        return self._base.shape[1]
+
+    def offer(self, lane: int, lo: int = 0, hi: Optional[int] = None):
+        """Masked host row block (E, 4) for `lane`, or None when the lane
+        already has exactly this range installed (the caller skips the
+        device update). Raises on a re-offer to a lane whose previous
+        range was never retired."""
+        import numpy as np
+
+        from kubernetriks_tpu.batched.state import (
+            EV_CREATE_POD,
+            EV_NONE,
+            EV_REMOVE_POD,
+        )
+
+        E = self._base.shape[1]
+        hi = E if hi is None else int(hi)
+        lo = int(lo)
+        if not (0 <= lo <= hi <= E):
+            raise ValueError(
+                f"lane {lane}: trace row-range [{lo}, {hi}) outside [0, {E})"
+            )
+        if self._flying[lane]:
+            raise RuntimeError(
+                f"lane {lane}: trace rows re-offered while its previous "
+                "range is still flying — retire the lane (lane_reset) "
+                "before re-seeding (never-re-offer invariant)"
+            )
+        self._flying[lane] = True
+        self.offers += 1
+        if self._installed[lane] == (lo, hi):
+            return None
+        self._installed[lane] = (lo, hi)
+        rows = self._base[lane].copy()
+        kind = rows[:, 2]
+        slot = rows[:, 3]
+        is_create = kind == EV_CREATE_POD
+        is_remove = kind == EV_REMOVE_POD
+        if not bool(is_create.any()):
+            return rows
+        in_range = np.zeros((E,), bool)
+        in_range[lo:hi] = True
+        n_slots = int(slot[is_create | is_remove].max()) + 1
+        created = np.zeros((n_slots,), bool)
+        created[slot[is_create]] = True
+        kept = np.zeros((n_slots,), bool)
+        kept[slot[is_create & in_range]] = True
+        drop = (is_create & ~in_range) | (
+            is_remove & created[slot] & ~kept[slot]
+        )
+        rows[drop, 2] = EV_NONE
+        return rows
+
+    def retire(self, lanes) -> None:
+        """Mark lanes' offered ranges as consumed (reset boundary): the
+        next offer for them is legal again."""
+        for lane in lanes:
+            self._flying[int(lane)] = False
+
+    def report(self) -> dict:
+        return {
+            "offers": self.offers,
+            "installed": {
+                lane: rng
+                for lane, rng in enumerate(self._installed)
+                if rng is not None
+            },
+        }

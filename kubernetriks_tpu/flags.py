@@ -286,6 +286,17 @@ _FLAGS = [
         "Unset: 3.",
     ),
     Flag(
+        "KTPU_LANE_SPAN",
+        "int",
+        None,
+        "Pump span (windows per round) of the lane-asynchronous fleet's "
+        "continuous submit/poll engine (batched/fleet.py pump()): every "
+        "round steps ALL lanes this many global windows through one "
+        "compiled fixed-span program, then re-seeds the lanes whose "
+        "per-lane clock finished. Smaller spans cut completion latency "
+        "and idle-lane waste at more dispatch overhead. Unset: 8.",
+    ),
+    Flag(
         "KUBERNETRIKS_PALLAS",
         "tristate",
         None,

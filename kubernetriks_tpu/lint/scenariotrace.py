@@ -73,6 +73,10 @@ DEFAULT_TRACED = frozenset(
         "ca_commit_vis",
         # StepConstants per-lane fault seed
         "fault_seed",
+        # StepConstants lane-async window clocks (engine set_lane_plan
+        # re-seeds a finished lane as a pure data update — compile-once)
+        "lane_clock",
+        "lane_horizon",
     }
 )
 MANIFEST_NAMES = ("SCENARIO_TRACED_LEAVES", "SCENARIO_TRACED_CONSTS")

@@ -71,6 +71,10 @@ STATE_CLASSES: Dict[str, str] = {
     "ClusterBatchState": STATE_PY,
     "TelemetryRing": STATE_PY,
     "AutoscaleState": AUTOSCALE_PY,
+    # Lane-async clock leaves ride StepConstants (traced per-lane data,
+    # engine set_lane_plan re-seeds without recompiling) — a new consts
+    # leaf must reach the manifest like any state leaf.
+    "StepConstants": STATE_PY,
 }
 
 # class -> (manifest constant, module holding it)
@@ -78,6 +82,7 @@ MANIFESTS: Dict[str, Tuple[str, str]] = {
     "ClusterBatchState": ("CLUSTER_STATE_LEAVES", STATE_PY),
     "TelemetryRing": ("TELEMETRY_RING_LEAVES", STATE_PY),
     "AutoscaleState": ("AUTOSCALE_STATE_LEAVES", AUTOSCALE_PY),
+    "StepConstants": ("STEP_CONSTANTS_LEAVES", STATE_PY),
 }
 
 CHECKLIST_HINT = (

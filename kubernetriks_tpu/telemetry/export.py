@@ -121,6 +121,10 @@ def prometheus_lines(report: Dict, prefix: str = "ktpu_") -> List[str]:
                 gauge("memory_bytes", sub_val, {"kind": f"{key}.{sub_key}"})
         else:
             gauge("memory_bytes", value, {"kind": key})
+    for key, value in (resources.get("queries") or {}).items():
+        # Lane-async per-query latency percentiles (observatory
+        # query_stats): count + p50/p95/p99 in ms.
+        gauge("query_latency", value, {"stat": key})
     watchdog = (resources.get("watchdog") or {})
     gauge("watchdog_enabled", watchdog.get("enabled"))
     for kind, window in (watchdog.get("fired") or {}).items():

@@ -53,6 +53,7 @@ import numpy as np
 from kubernetriks_tpu.batched.state import (
     TELEM_CA_RESERVE,
     TELEM_HPA_RESERVE,
+    TELEM_LANE_ACTIVE,
     TELEM_POD_HEADROOM,
     TELEM_WINDOW,
 )
@@ -162,6 +163,7 @@ class Observatory:
         fit_window: int = 64,
         exporters: Optional[list] = None,
         max_events: int = 256,
+        lane_idle_frac: float = 0.5,
     ) -> None:
         self.interval = float(interval)
         self.capacities = dict(capacities or {})
@@ -184,18 +186,27 @@ class Observatory:
         self.fit_window = max(self.min_points, int(fit_window))
         self.exporters = list(exporters or [])
         self.max_events = int(max_events)
+        # Idle-lane verdict floor: a lane active for less than this
+        # fraction of the recent windows (lane-async fleets only — the
+        # lane_active ring column is constant 1 everywhere else) means
+        # dispatched lane-windows are being thrown away.
+        self.lane_idle_frac = float(lane_idle_frac)
         self.reset()
 
     def reset(self) -> None:
         """Drop accumulated series/watermarks (checkpoint restore: the
         restored run is a fresh trajectory)."""
-        # (window, hpa_used (C,), ca_used (C,), headroom (C,)) — bounded.
+        # (window, hpa_used (C,), ca_used (C,), headroom (C,),
+        # lane_active (C,)) — bounded.
         self._points: deque = deque(maxlen=self.fit_window)
         self._last_window = -1
         self._high_water: Dict[str, int] = {}
         self._mem_high: Dict[str, int] = {}
         self._last_resources: Dict = {}
         self._last_stall_not_ready = 0
+        # Submit-to-drain wall latencies (seconds) noted by the lane-async
+        # fleet's pump — bounded like every other observatory series.
+        self._queries: deque = deque(maxlen=4096)
         self.events: List[Dict] = []
         self.fired: Dict[str, int] = {}
         self.samples = 0
@@ -220,7 +231,8 @@ class Observatory:
             hpa = buf[:, slot, TELEM_HPA_RESERVE].copy()
             ca = buf[:, slot, TELEM_CA_RESERVE].copy()
             head = buf[:, slot, TELEM_POD_HEADROOM].copy()
-            self._points.append((w, hpa, ca, head))
+            active = buf[:, slot, TELEM_LANE_ACTIVE].copy()
+            self._points.append((w, hpa, ca, head, active))
             self._last_window = w
         # High-water folds over EVERY fresh row, not just the last one:
         # hpa_reserve_used is non-monotone (scale-downs shrink it), so an
@@ -378,6 +390,40 @@ class Observatory:
                 )
                 break  # one headroom line per observe is plenty
 
+    def _check_lanes(self, warnings_out: list) -> None:
+        """Idle-lane-waste verdict (lane-async fleets): a lane whose
+        lane_active bit was 0 for more than (1 - lane_idle_frac) of the
+        recent windows is burning dispatched lane-windows without
+        simulating anything — the open-loop client is underfeeding the
+        queue or the pump span badly overshoots the horizon mix. One
+        verdict per run (the idle fraction can only be cured by feeding
+        the queue, and repeating it every drain would be noise). Vacuous
+        outside lane-async builds: the column is constant 1 there."""
+        if "lane_idle" in self.fired:
+            return
+        if len(self._points) < self.min_points:
+            return
+        ys = np.stack([p[4] for p in self._points], axis=0)  # (n, C)
+        if not bool((ys == 0).any()):
+            return
+        fracs = (ys > 0).mean(axis=0)  # (C,) active fraction
+        worst = int(np.argmin(fracs))
+        if float(fracs[worst]) < self.lane_idle_frac:
+            warnings_out.append(
+                self._warn(
+                    "lane_idle",
+                    f"saturation watchdog: lane {worst} was active for "
+                    f"only {float(fracs[worst]):.0%} of the last "
+                    f"{ys.shape[0]} windows (floor "
+                    f"{self.lane_idle_frac:.0%}) — dispatched lane-"
+                    "windows are being discarded; feed the submit queue "
+                    "or shrink the pump span (KTPU_LANE_SPAN)",
+                    lane=worst,
+                    active_frac=round(float(fracs[worst]), 4),
+                    windows=int(ys.shape[0]),
+                )
+            )
+
     def _check_pipeline(
         self, dispatch_stats: Optional[Dict], sync_budget: Optional[Dict],
         feeder: Optional[Dict], warnings_out: list,
@@ -483,6 +529,7 @@ class Observatory:
             self._check_reserve("hpa_reserve_used", 1, fired)
             self._check_reserve("ca_reserve_used", 2, fired)
             self._check_headroom(fired)
+            self._check_lanes(fired)
             self._check_pipeline(dispatch_stats, sync_budget, feeder, fired)
         record = {
             "t_wall_s": round(time.time(), 3),
@@ -493,6 +540,8 @@ class Observatory:
             "resources": dict(self._last_resources),
             "watchdog": [dict(e) for e in fired],
         }
+        if self._queries:
+            record["queries"] = self.query_stats()
         if fresh is None:
             record["fresh_windows"] = len(self._points)
         if is_fresh:
@@ -536,7 +585,42 @@ class Observatory:
             "min": int(bounded.min()) if bounded.size else None,
             "unbounded_clusters": int((head >= UNBOUNDED_SENTINEL).sum()),
         }
+        # Lane-occupancy gauge from the lane_active ring column: per-lane
+        # active fraction over the bounded point window, reported as the
+        # across-lane mean and min (1.0 outside lane-async builds — the
+        # column is constant 1 there).
+        active = np.stack([p[4] for p in self._points], axis=0)  # (n, C)
+        fracs = (active > 0).mean(axis=0)
+        out["lane_occupancy"] = {
+            "mean": round(float(fracs.mean()), 4),
+            "min": round(float(fracs.min()), 4),
+        }
         return out
+
+    # -- query latency (lane-async fleet) -----------------------------------
+
+    def note_query(self, latency_s: float) -> None:
+        """Record one completed query's submit-to-drain wall latency —
+        called by the lane-async fleet's pump at the drain boundary (pure
+        host float, no device access)."""
+        self._queries.append(float(latency_s))
+
+    def query_stats(self) -> Dict:
+        """Latency percentiles (ms) over the recorded query completions —
+        the observatory half of the open-loop bench's per-query numbers."""
+        if not self._queries:
+            return {"count": 0}
+        # np.fromiter, not np.asarray: the latency deque is pure host
+        # floats, and this module's zero-sync-waiver policy bans the
+        # asarray spelling outright (it is the smuggling seam the
+        # host-sync pass patrols for).
+        lat = np.fromiter(self._queries, np.float64, count=len(self._queries))
+        return {
+            "count": int(lat.size),
+            "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 3),
+            "p95_ms": round(float(np.percentile(lat, 95)) * 1e3, 3),
+            "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 3),
+        }
 
     def report(self) -> Dict:
         """The `telemetry_report()["resources"]` section: occupancy,
@@ -547,6 +631,7 @@ class Observatory:
                 **self._last_resources,
                 "high_water": dict(self._mem_high),
             },
+            "queries": self.query_stats(),
             "watchdog": {
                 "enabled": self.watchdog,
                 "fired": dict(self.fired),

@@ -50,6 +50,11 @@ RING_COLUMNS = (
     "hpa_reserve_used",
     "ca_reserve_used",
     "pod_headroom",
+    # Lane-async fleet occupancy bit (state.TELEM_LANE_ACTIVE): 1 when the
+    # lane's per-lane clock made it active for the window, constant 1
+    # outside lane-async builds. The observatory's lane-occupancy gauge
+    # and idle-lane-waste verdict fold this column.
+    "lane_active",
 )
 assert len(RING_COLUMNS) == TELEMETRY_COLS
 
@@ -64,6 +69,7 @@ GAUGE_COLUMNS = frozenset(
         "hpa_reserve_used",
         "ca_reserve_used",
         "pod_headroom",
+        "lane_active",
     }
 )
 

@@ -38,6 +38,12 @@ def _smoke_records(capsys, args):
             assert set(rec) == {"metric", "value", "unit", "sweep"}
             assert rec["value"] > 0
             continue
+        if rec.get("unit") == "queries/s":
+            # The open-loop lane-async line (DESIGN §13): queries per
+            # second + the full open_loop block.
+            assert set(rec) == {"metric", "value", "unit", "open_loop"}
+            assert rec["value"] > 0
+            continue
         assert set(rec) - {"spans", "telemetry", "endurance"} == {
             "metric", "value", "unit", "vs_baseline",
         }
@@ -49,7 +55,7 @@ def _smoke_records(capsys, args):
     return records
 
 
-def test_bench_smoke_emits_eight_parseable_lines(capsys, tmp_path, monkeypatch):
+def test_bench_smoke_emits_nine_parseable_lines(capsys, tmp_path, monkeypatch):
     # --trace rides along (the CI smoke job runs it this way): the
     # composed lines must carry the flight-recorder summary AND write a
     # Perfetto-loadable Chrome trace per traced line.
@@ -57,12 +63,12 @@ def test_bench_smoke_emits_eight_parseable_lines(capsys, tmp_path, monkeypatch):
     monkeypatch.setenv("KTPU_METRICS_PATH", str(tmp_path / "ktpu_metrics"))
     monkeypatch.setenv("KTPU_SWEEP_PATH", str(tmp_path / "ktpu_sweep"))
     records = _smoke_records(capsys, ["--smoke", "--trace"])
-    assert len(records) == 8, records
+    assert len(records) == 9, records
     # Line order is part of the contract: continuity, composed, superspan
     # machinery, streaming feeder, endurance churn, compiled profile,
-    # north-star, scenario fleet (the sweep runs LAST: its cold-process
-    # baseline clears the jit caches, which would cold-start anything
-    # after it).
+    # north-star, open-loop lane-async fleet, scenario fleet (the sweep
+    # runs LAST: its cold-process baseline clears the jit caches, which
+    # would cold-start anything after it).
     assert "composed" in records[1]["metric"]
     assert "superspan" in records[2]["metric"]
     assert "streaming" in records[3]["metric"]
@@ -72,7 +78,8 @@ def test_bench_smoke_emits_eight_parseable_lines(capsys, tmp_path, monkeypatch):
     # falls back to the default pipeline, so its presence IS the gate.
     assert "best_fit profile" in records[5]["metric"]
     assert "north-star" in records[6]["metric"]
-    assert "scenario-vector fleet" in records[7]["metric"]
+    assert "open-loop lane-async fleet" in records[7]["metric"]
+    assert "scenario-vector fleet" in records[8]["metric"]
     # The ENDURANCE line (r14): run_endurance's in-bench gates (reclaim
     # actually fired, flat RSS/slab watermarks, zero recompiles after
     # warm-up, no reserve saturation verdict) already ran — the record's
@@ -94,7 +101,7 @@ def test_bench_smoke_emits_eight_parseable_lines(capsys, tmp_path, monkeypatch):
     # after warm-up, no lane cross-talk on the duplicate-scenario probes)
     # already ran inside run_sweep — the record's sweep block discloses
     # what was checked, and the JSON artifact landed for CI upload.
-    sweep = records[7]["sweep"]
+    sweep = records[8]["sweep"]
     assert sweep["scenarios"] == 8 and sweep["lanes"] == 4
     assert sweep["waves"] == 2
     assert sweep["recompiles_after_warmup"] == 0
@@ -105,6 +112,22 @@ def test_bench_smoke_emits_eight_parseable_lines(capsys, tmp_path, monkeypatch):
     assert sweep["baseline"]["cold_process_model"] is False
     sweep_doc = json.loads((tmp_path / "ktpu_sweep.json").read_text())
     assert sweep_doc == sweep
+    # The OPEN-LOOP line (DESIGN §13): run_open_loop's in-bench asserts
+    # (A/B bit-identity on every query between the wave-aligned and
+    # lane-async fleets, zero recompiles across post-warm-up pump
+    # rounds) already ran; pin the disclosure + the JSON artifact CI
+    # uploads. The occupancy/speedup hard gates arm on the full --sweep
+    # only — smoke pins the machinery, not toy-shape performance.
+    ol = records[7]["open_loop"]
+    assert ol["queries"] == 8 and ol["lanes"] == 4
+    assert ol["ab_identity_checked"] == 8
+    assert ol["recompiles_after_warmup"] == 0
+    assert ol["recompile_sentinel"]["post_warmup_events"] == 0
+    assert ol["async_queries_per_s"] > 0 and ol["wave_queries_per_s"] > 0
+    assert 0 < ol["lane_occupancy"]["min"] <= ol["lane_occupancy"]["mean"] <= 1
+    assert ol["latency_ms"]["p50_ms"] > 0
+    ol_doc = json.loads((tmp_path / "ktpu_sweep_openloop.json").read_text())
+    assert ol_doc == ol
     # Composed lines report the >= 5-span median with min/max spread; the
     # plain-shape lines keep the bare single-region value.
     for rec in records[1:4]:
@@ -223,9 +246,10 @@ def test_bench_smoke_faults_adds_chaos_line(capsys, tmp_path, monkeypatch):
     monkeypatch.setenv("KTPU_METRICS_PATH", str(tmp_path / "ktpu_metrics"))
     monkeypatch.setenv("KTPU_SWEEP_PATH", str(tmp_path / "ktpu_sweep"))
     records = _smoke_records(capsys, ["--smoke", "--faults", "--trace"])
-    assert len(records) == 9, records
+    assert len(records) == 10, records
     assert "chaos" in records[7]["metric"]
     assert records[7]["value"] > 0
     assert records[7]["spans"]["n"] >= 5
     assert "telemetry" not in records[7]
-    assert "scenario-vector fleet" in records[8]["metric"]
+    assert "open-loop lane-async fleet" in records[8]["metric"]
+    assert "scenario-vector fleet" in records[9]["metric"]

@@ -1,0 +1,306 @@
+"""Lane-asynchronous fleet gates (batched/fleet.py submit/pump/poll +
+the per-lane window clocks of DESIGN §13).
+
+1. A/B IDENTITY: the same heterogeneous-horizon query stream through a
+   wave-aligned fleet and a lane-async fleet returns bit-identical
+   per-query results — with chaos ON and more queries than lanes, so
+   lanes finish early and re-seed mid-flight while neighbours keep
+   stepping.
+2. LANE PERMUTATION: submitting the same multiset in a different order
+   lands queries on different lanes at different global windows — the
+   per-query results still bit-match (a lane's trajectory is a pure
+   function of its scenario + horizon, never its lane index or clock
+   offset; per-lane fault seeds keep that true under chaos).
+3. SCALAR ORACLES: each heterogeneous-horizon query's HPA replica count
+   equals an independent scalar-oracle run of that scenario stepped to
+   that query's OWN horizon (the test_fleet oracle protocol, made
+   horizon-heterogeneous).
+4. CONTINUOUS ENGINE MECHANICS: poll() streams completions exactly once;
+   re-running a stream is recompile-free (cache counts + armed
+   sentinel); the occupancy ledger and latency percentiles account every
+   query; the trace mux masks per-lane row spans and never re-offers a
+   flying lane.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from kubernetriks_tpu.batched.fleet import (
+    Scenario,
+    ScenarioFleet,
+    jit_cache_sizes,
+)
+from kubernetriks_tpu.sim.simulator import KubernetriksSimulation
+from kubernetriks_tpu.test_util import default_test_simulation_config
+from kubernetriks_tpu.trace.generic import (
+    GenericClusterTrace,
+    GenericWorkloadTrace,
+)
+
+from test_fleet import FAULT_SUFFIX, _apply_scenario_to_config, _composed_traces
+from test_random_hpa_equivalence import (
+    CLUSTER_TRACE as HPA_CLUSTER_TRACE,
+    make_workload as make_hpa_workload,
+)
+from test_window_donation_dispatch import COMPOSED_CONFIG_SUFFIX
+
+# Scenario 0 == scenario 3 (in-stream duplicate at a different horizon
+# slot); five queries over three lanes force a mid-flight reseed; the
+# 150 s horizon finishes its lane ~3x earlier than its neighbours.
+SCENS = [
+    (Scenario(fault_seed=11, hpa_scan_interval=30.0), 450.0),
+    (Scenario(fault_seed=22, ca_threshold=0.7), 250.0),
+    (Scenario(fault_seed=33, hpa_tolerance=0.25), 350.0),
+    (Scenario(fault_seed=11, hpa_scan_interval=30.0), 450.0),  # dup of 0
+    (Scenario(fault_seed=44), 150.0),
+]
+
+
+def _build(lane_async, config, cluster_events, workload):
+    return ScenarioFleet(
+        config,
+        cluster_events,
+        workload,
+        n_lanes=3,
+        horizon=450.0,
+        max_pods_per_cycle=16,
+        use_pallas=False,
+        ca_slot_multiplier=4,
+        lane_async=lane_async,
+    )
+
+
+@pytest.fixture(scope="module")
+def async_ab_runs():
+    """One wave-aligned and two lane-async fleets (the second fed the
+    permuted stream) over the composed+chaos scenario — the shared
+    engines every gate below reads. KTPU_EXPLAIN_RECOMPILES=1 arms the
+    recompile sentinel on the two lane-async fleets, so every
+    post-warm-up pump round already runs under an expect_none guard.
+    The WAVE reference stays unarmed: it compiles one program per
+    distinct span length by design, and this stream's second wave
+    introduces span lengths the first never ran."""
+    config = default_test_simulation_config(
+        COMPOSED_CONFIG_SUFFIX + FAULT_SUFFIX
+    )
+    cluster_events, workload = _composed_traces()
+    wave = _build(False, config, cluster_events, workload)
+    for scen, hor in SCENS:
+        wave.submit(scen, hor)
+    wave_res = wave.run()
+
+    os.environ["KTPU_EXPLAIN_RECOMPILES"] = "1"
+    try:
+        asy = _build(True, config, cluster_events, workload)
+        qids = [asy.submit(s, h) for s, h in SCENS]
+        asy.run_async()
+
+        perm = [4, 2, 3, 0, 1]
+        asy_p = _build(True, config, cluster_events, workload)
+        qids_p = [asy_p.submit(*SCENS[i]) for i in perm]
+        asy_p.run_async()
+
+        yield wave, wave_res, asy, qids, asy_p, qids_p, perm
+        wave.close()
+        asy.close()
+        asy_p.close()
+    finally:
+        os.environ.pop("KTPU_EXPLAIN_RECOMPILES", None)
+
+
+def _same_result(a, b):
+    return (
+        a.counters == b.counters
+        and a.hpa_replicas == b.hpa_replicas
+        and a.ca_nodes == b.ca_nodes
+    )
+
+
+def test_async_bit_matches_wave(async_ab_runs):
+    """The A/B gate: every query's counters / replica / node readouts are
+    bit-identical between the wave-aligned and lane-async executions,
+    with the chaos machinery demonstrably engaged."""
+    wave, wave_res, asy, qids, _, _, _ = async_ab_runs
+    total_faults = 0
+    for i, qid in enumerate(qids):
+        ra, rw = asy.results[qid], wave_res[i]
+        assert _same_result(ra, rw), (
+            f"query {i} ({SCENS[i]}) diverges between wave and async:\n"
+            f"{rw.counters}\n{ra.counters}"
+        )
+        total_faults += (
+            ra.counters["pod_restarts"] + ra.counters["node_crashes"]
+        )
+    assert total_faults > 0, "chaos fleet produced no faults (vacuous gate)"
+
+
+def test_async_lane_permutation_bit_identical(async_ab_runs):
+    """Permuted submission order = different lanes, different clock
+    offsets, different reseed timing — identical per-query results. The
+    in-stream duplicate (scenario 0 == 3) also bit-matches within one
+    fleet across its two placements."""
+    _, _, asy, qids, asy_p, qids_p, perm = async_ab_runs
+    for j, i in enumerate(perm):
+        ra, rp = asy.results[qids[i]], asy_p.results[qids_p[j]]
+        assert _same_result(ra, rp), (
+            f"scenario {i} differs between lane {ra.lane} (in-order) and "
+            f"lane {rp.lane} (permuted)"
+        )
+    r0, r3 = asy.results[qids[0]], asy.results[qids[3]]
+    assert _same_result(r0, r3)
+
+
+def test_async_poll_streams_each_result_once(async_ab_runs):
+    """poll() is the streaming read side: after run_async drained the
+    whole stream, one poll returns every result exactly once (completion
+    order) and the next poll returns nothing."""
+    _, _, asy, qids, _, _, _ = async_ab_runs
+    polled = asy.poll()
+    assert sorted(r.query for r in polled) == sorted(qids)
+    assert asy.poll() == []
+
+
+def test_async_rerun_is_recompile_free(async_ab_runs):
+    """The compile-once contract across reseeds: re-submitting the whole
+    stream to the warm fleet moves no jit-cache count (and the armed
+    sentinel would raise on any hidden compile), and reproduces the
+    first run's results exactly."""
+    _, _, asy, qids, _, _, _ = async_ab_runs
+    assert asy._sentinel is not None, (
+        "KTPU_EXPLAIN_RECOMPILES=1 did not arm the fleet sentinel"
+    )
+    first = {i: asy.results[qid] for i, qid in enumerate(qids)}
+    sizes0 = jit_cache_sizes()
+    rerun_qids = [asy.submit(s, h) for s, h in SCENS]
+    asy.run_async()
+    sizes1 = jit_cache_sizes()
+    assert sizes0 == sizes1, {
+        k: (sizes0[k], sizes1[k]) for k in sizes0 if sizes0[k] != sizes1[k]
+    }
+    for i, qid in enumerate(rerun_qids):
+        assert _same_result(asy.results[qid], first[i]), f"rerun query {i}"
+    asy.poll()  # drain the completion queue for later gates
+
+
+def test_async_ledger_and_latency_account_every_query(async_ab_runs):
+    """The occupancy ledger saw busy lane-windows, every completed query
+    has a latency sample, and reset_query_stats() returns both to their
+    pre-run state."""
+    _, _, _, _, asy_p, qids_p, _ = async_ab_runs
+    occ = asy_p.lane_occupancy()
+    assert 0.0 < occ["min"] <= occ["mean"] <= 1.0
+    assert occ["lane_windows_busy"] > 0
+    lat = asy_p.query_latency_percentiles()
+    assert lat["count"] == len(qids_p)
+    assert 0.0 < lat["p50_ms"] <= lat["p95_ms"] <= lat["p99_ms"]
+    asy_p.reset_query_stats()
+    assert asy_p.query_latency_percentiles() == {"count": 0}
+    assert asy_p.lane_occupancy()["mean"] == 1.0  # pristine ledger
+
+
+def test_async_matches_scalar_oracles_at_own_horizons():
+    """Per-query scalar-oracle equivalence under heterogeneous horizons:
+    each lane-async query's final HPA replica count equals an
+    independent scalar run of that scenario stepped to that query's own
+    horizon — four queries over three lanes, so one oracle checks a
+    RE-SEEDED lane (the test_fleet HPA oracle protocol; tolerance-only
+    scenarios, where scalar and batched sampling provably agree)."""
+    queries = [
+        (Scenario(), 950.0),
+        (Scenario(hpa_tolerance=0.02), 470.0),
+        (Scenario(hpa_tolerance=0.4), 710.0),
+        (Scenario(hpa_tolerance=0.02), 230.0),
+    ]
+    workload = make_hpa_workload(29)
+    base = default_test_simulation_config()
+    base.horizontal_pod_autoscaler.enabled = True
+    fleet = ScenarioFleet(
+        base,
+        GenericClusterTrace.from_yaml(
+            HPA_CLUSTER_TRACE
+        ).convert_to_simulator_events(),
+        GenericWorkloadTrace.from_yaml(workload).convert_to_simulator_events(),
+        n_lanes=3,
+        horizon=950.0,
+        use_pallas=False,
+        lane_async=True,
+    )
+    qids = [fleet.submit(s, h) for s, h in queries]
+    fleet.run_async()
+    diverged = set()
+    for i, (scen, hor) in enumerate(queries):
+        cfg = default_test_simulation_config()
+        cfg.horizontal_pod_autoscaler.enabled = True
+        sim = KubernetriksSimulation(_apply_scenario_to_config(cfg, scen))
+        sim.initialize(
+            GenericClusterTrace.from_yaml(HPA_CLUSTER_TRACE),
+            GenericWorkloadTrace.from_yaml(workload),
+        )
+        sim.step_until_time(hor)
+        groups = sim.horizontal_pod_autoscaler.pod_groups
+        oracle = (
+            len(groups["pod_group_1"].created_pods)
+            if "pod_group_1" in groups
+            else 0
+        )
+        got = fleet.results[qids[i]].hpa_replicas["pod_group_1"]
+        assert got == oracle, (
+            f"query {i} ({scen}, horizon {hor}): async fleet reports "
+            f"{got} replicas, scalar oracle {oracle}"
+        )
+        diverged.add((oracle, hor))
+    assert len(diverged) > 1  # the heterogeneity was non-vacuous
+    fleet.close()
+
+
+def test_trace_mux_masks_and_never_reoffers():
+    """The lane trace multiplexer: a masked row span changes results
+    (non-vacuous), equal masks bit-match across lane placements
+    (including a 1-lane fleet — placement invariance), and offering a
+    FLYING lane raises (never-re-offer invariant)."""
+    config = default_test_simulation_config(
+        COMPOSED_CONFIG_SUFFIX + FAULT_SUFFIX
+    )
+    cluster_events, workload = _composed_traces()
+    fleet = _build(True, config, cluster_events, workload)
+    E = fleet.engine._lane_mux.n_rows
+    q_full = fleet.submit(Scenario(fault_seed=11), 300.0)
+    q_mask = fleet.submit(
+        Scenario(fault_seed=11), 300.0, trace_rows=(0, E // 2)
+    )
+    q_full2 = fleet.submit(Scenario(fault_seed=11), 300.0)
+    # Lands on a RE-USED lane: the mux must retire the old span first.
+    q_mask2 = fleet.submit(
+        Scenario(fault_seed=11), 300.0, trace_rows=(0, E // 2)
+    )
+    fleet.run_async()
+    res = fleet.results
+    assert res[q_full].counters == res[q_full2].counters
+    assert res[q_mask].counters == res[q_mask2].counters
+    assert res[q_full].counters != res[q_mask].counters, "mask did not bite"
+
+    solo = ScenarioFleet(
+        config,
+        cluster_events,
+        workload,
+        n_lanes=1,
+        horizon=450.0,
+        max_pods_per_cycle=16,
+        use_pallas=False,
+        ca_slot_multiplier=4,
+        lane_async=True,
+    )
+    s1 = solo.submit(Scenario(fault_seed=11), 300.0, trace_rows=(0, E // 2))
+    solo.run_async()
+    assert solo.results[s1].counters == res[q_mask].counters
+    solo.close()
+
+    flying = _build(True, config, cluster_events, workload)
+    flying.submit(Scenario(), 300.0)
+    flying.pump()  # lane 0 is now in flight
+    with pytest.raises(RuntimeError, match="fly|flight|active"):
+        flying.engine.set_lane_trace(0, 0, E // 2)
+    flying.close()
+    fleet.close()

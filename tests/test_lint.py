@@ -284,6 +284,48 @@ def test_stateleaf_scratch_leaf_fails_against_real_tree(tmp_path):
     )
 
 
+def test_stateleaf_scratch_clock_leaf_fails_against_real_tree(tmp_path):
+    """The lane-async variant of the gate above: a scratch per-lane
+    CLOCK leaf added to the REAL StepConstants without touching
+    STEP_CONSTANTS_LEAVES is caught by the same tmp-tree e2e path (the
+    untouched copy stays clean) — the 'how to add a consts leaf'
+    checklist anchor for the DESIGN §13 clock protocol."""
+    src_path = os.path.join(ROOT, "kubernetriks_tpu", "batched", "state.py")
+    src = open(src_path, encoding="utf-8").read()
+    dest_dir = tmp_path / "kubernetriks_tpu" / "batched"
+    dest_dir.mkdir(parents=True)
+    dest = dest_dir / "state.py"
+
+    dest.write_text(src, encoding="utf-8")
+    clean = run_lint(
+        ["kubernetriks_tpu/batched/state.py"], str(tmp_path), passes=["stateleaf"]
+    )
+    assert clean == [], "\n".join(v.render() for v in clean)
+
+    marker = "    lane_clock: Optional[jnp.ndarray] = None"
+    assert marker in src, "StepConstants layout changed; update the test"
+    dest.write_text(
+        src.replace(
+            marker,
+            "    scratch_clock: Optional[jnp.ndarray] = None\n" + marker,
+            1,
+        ),
+        encoding="utf-8",
+    )
+    violations = run_lint(
+        ["kubernetriks_tpu/batched/state.py"], str(tmp_path), passes=["stateleaf"]
+    )
+    rendered = "\n".join(v.render() for v in violations)
+    assert any(
+        "scratch_clock" in v.message and "STEP_CONSTANTS_LEAVES" in v.message
+        for v in violations
+    ), rendered or "scratch clock leaf escaped the consts manifest"
+    assert (
+        lint_main(["--root", str(tmp_path), "kubernetriks_tpu/batched/state.py"])
+        == 1
+    )
+
+
 def test_stateleaf_registries_match_runtime():
     """The AST-parsed manifests equal the live NamedTuple fields, the
     axis/scenario registries name real leaves, and the ckpt manifest
@@ -297,6 +339,7 @@ def test_stateleaf_registries_match_runtime():
     assert (
         autoscale.AUTOSCALE_STATE_LEAVES == autoscale.AutoscaleState._fields
     )
+    assert state.STEP_CONSTANTS_LEAVES == state.StepConstants._fields
     # scenario-traced registries name real statics/consts leaves
     statics_fields = set(autoscale.AutoscaleStatics._fields)
     assert set(autoscale.SCENARIO_TRACED_LEAVES) <= statics_fields
@@ -335,6 +378,7 @@ def test_stateleaf_registries_match_runtime():
         | set(state.NodeArrays._fields)
         | set(state.PodArrays._fields)
         | set(state.MetricArrays._fields)
+        | set(state.StepConstants._fields)
     )
     for reg in (state.AXIS_SIGNATURES, autoscale.AXIS_SIGNATURES):
         unknown = set(reg) - known

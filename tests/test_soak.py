@@ -372,6 +372,56 @@ def test_watchdog_flat_tie_names_most_saturated_cluster():
     assert ev["cluster"] == 1 and ev["used"] == 19
 
 
+def test_watchdog_fires_idle_lane_verdict():
+    """Lane-async idle-waste verdict (DESIGN §13): a lane whose
+    lane_active ring bit was 0 for most of the recent windows draws
+    exactly ONE lane_idle verdict naming the worst lane — and never
+    re-fires (the idle fraction is only cured by feeding the submit
+    queue; repeating the verdict every drain would be noise)."""
+    obs = Observatory(interval=10.0, capacities={})
+
+    def lane_buf(w0, R, lane1_active):
+        buf = np.full((2, R, len(RING_COLUMNS)), -1, np.int32)
+        for slot in range(R):
+            buf[:, slot, COL["window"]] = w0 + slot
+            buf[:, slot, COL["hpa_reserve_used"]] = 0
+            buf[:, slot, COL["ca_reserve_used"]] = 0
+            buf[:, slot, COL["pod_headroom"]] = UNBOUNDED_SENTINEL
+            buf[0, slot, COL["lane_active"]] = 1
+            buf[1, slot, COL["lane_active"]] = lane1_active(slot)
+        return buf
+
+    # Lane 1 active for 2 of 8 windows (25% < the 50% floor).
+    obs.ingest(lane_buf(0, 8, lambda slot: 1 if slot < 2 else 0))
+    with pytest.warns(SaturationWarning, match="lane 1"):
+        rec = obs.observe()
+    ev = [e for e in rec["watchdog"] if e["kind"] == "lane_idle"][0]
+    assert ev["lane"] == 1
+    assert ev["active_frac"] == pytest.approx(0.25)
+    assert "lane_idle" in obs.report()["watchdog"]["fired"]
+    # One-shot: more idle windows do NOT re-warn.
+    obs.ingest(lane_buf(8, 6, lambda slot: 0))
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        rec2 = obs.observe()
+    assert not [x for x in w if issubclass(x.category, SaturationWarning)]
+    assert [e for e in rec2["watchdog"] if e["kind"] == "lane_idle"] == []
+
+
+def test_watchdog_lane_verdict_vacuous_without_lane_async():
+    """Outside lane-async builds the lane_active column is never 0 (the
+    synthetic buffers carry the -1 pad), so the verdict cannot fire."""
+    obs = Observatory(interval=10.0, capacities={})
+    obs.ingest(
+        _ring_buf([(w, 0, 0, UNBOUNDED_SENTINEL) for w in range(8)])
+    )
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        rec = obs.observe()
+    assert not [x for x in w if issubclass(x.category, SaturationWarning)]
+    assert rec["watchdog"] == []
+
+
 def test_watchdog_quiet_on_flat_and_low_occupancy():
     obs = Observatory(
         interval=10.0,
