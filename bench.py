@@ -1159,6 +1159,8 @@ def run_open_loop(
     horizon_mix: tuple = None,
     smoke: bool = False,
     json_path: str = None,
+    trace_path: str = None,  # per-lane Chrome trace (query swimlanes)
+    metrics_path: str = None,  # observatory JSONL/prom export stem
 ) -> dict:
     """The OPEN-LOOP client line (lane-async fleet, DESIGN §13): the same
     heterogeneous scenario stream submitted to a wave-aligned fleet and a
@@ -1176,6 +1178,10 @@ def run_open_loop(
       bit-identical between the wave and lane-async fleets.
     - Zero post-warm-up recompiles (jit-cache counts + sentinel), as in
       --sweep.
+    - Query observatory (PR 17): the bounded latency histogram's count
+      equals the number of polled queries, and its bucket-derived p99
+      lands within one bucket width of the exact sorted-array p99 over
+      the bounded exact-sample window (while both exist).
     - Full mode only: mean lane occupancy > 90% on the mix, and the
       lane-async fleet sustains >= 1.5x the wave fleet's queries/s.
     """
@@ -1225,6 +1231,16 @@ def run_open_loop(
 
     wave = build(False)
     asy = build(True)
+    if metrics_path:
+        # Observatory time-series export for the serving line, like the
+        # composed line's: JSONL drain records now, the final report as
+        # a Prometheus textfile (with the native query-latency histogram
+        # series) after the timed rounds.
+        from kubernetriks_tpu.telemetry.export import JsonlExporter
+
+        asy.engine.attach_metrics_exporter(
+            JsonlExporter(metrics_path + ".jsonl")
+        )
     # Warm-up: the full stream once per fleet, plus the A/B identity
     # gate — every query's results bit-match across the two executions.
     warm_wave = submit_stream(wave)
@@ -1245,11 +1261,15 @@ def run_open_loop(
     sizes_after_warm = jit_cache_sizes()
     if sentinel is not None:
         sentinel.seal("open-loop warm-up (both fleets, full stream)")
-    # The timed rounds start from a clean ledger: warm-up latencies are
-    # dominated by compile time and would swamp the percentiles.
+    # Drain the warm-up completions, then start the timed rounds from a
+    # clean ledger: warm-up latencies are dominated by compile time and
+    # would swamp the percentiles. reset_query_stats() resets the fleet
+    # histograms AND the observatory's query stats atomically.
+    asy.poll()
     asy.reset_query_stats()
 
     wave_times, asy_times = [], []
+    polled_queries = 0
     for _ in range(max(1, rounds) if not smoke else 1):
         submit_stream(wave)
         t0 = _time.perf_counter()
@@ -1259,6 +1279,7 @@ def run_open_loop(
         t0 = _time.perf_counter()
         asy.run_async()
         asy_times.append(_time.perf_counter() - t0)
+        polled_queries += len(asy.poll())
 
     sizes_after = jit_cache_sizes()
     recompiled = {
@@ -1281,10 +1302,47 @@ def run_open_loop(
     speedup = asy_qps / wave_qps if wave_qps > 0 else float("inf")
     occupancy = asy.lane_occupancy()
     latency = asy.query_latency_percentiles()
+    breakdown = asy.query_latency_breakdown()
+    # Query-observatory asserts (PR 17): the bounded histogram must agree
+    # with ground truth. (a) Exact count: one histogram sample per polled
+    # query. (b) Percentile quantisation: while the exact-sample window
+    # still holds the whole post-warm-up stream, the bucket-derived p99
+    # (numpy's method="higher" rank convention) sits within one bucket
+    # width (~5% relative) of the exact sorted-array p99.
+    hist = asy.latency_hist
+    assert hist.count == polled_queries, (
+        f"open-loop: latency histogram holds {hist.count} samples but "
+        f"{polled_queries} queries were polled — a drain path skipped "
+        "the histogram (or double-counted)"
+    )
+    exact_window = list(asy.latency_exact_window)
+    if exact_window and len(exact_window) == hist.count:
+        exact_p99 = float(
+            np.percentile(np.asarray(exact_window), 99, method="higher")
+        )
+        hist_p99 = hist.percentile(99.0)
+        width = hist.bucket_width(exact_p99)
+        assert abs(hist_p99 - exact_p99) <= width + 1e-12, (
+            f"open-loop: histogram p99 {hist_p99 * 1e3:.3f}ms is more "
+            f"than one bucket width ({width * 1e3:.3f}ms) from the exact "
+            f"p99 {exact_p99 * 1e3:.3f}ms"
+        )
     report = asy.engine.telemetry_report() if asy.engine._telemetry else {}
     ring_occ = (
         report.get("resources", {}).get("occupancy", {}).get("lane_occupancy")
     )
+    if trace_path:
+        # The per-lane Chrome trace: pid 2 carries one swimlane per
+        # fleet lane, spans named by the occupying query id, flow arrows
+        # linking each submit to its drain (CI uploads it; open it in
+        # Perfetto — README "Query observatory").
+        asy.engine.write_chrome_trace(trace_path)
+    if metrics_path:
+        from kubernetriks_tpu.telemetry.export import (
+            write_prometheus_textfile,
+        )
+
+        write_prometheus_textfile(metrics_path + ".prom", report)
     wave.close()
     asy.close()
 
@@ -1320,6 +1378,16 @@ def run_open_loop(
                 for k, v in latency.items()
                 if k != "count"
             },
+            # Queue-wait (submit->admit) vs service (admit->drain) split
+            # + the raw bounded-histogram dump (log buckets, ~5%
+            # relative resolution, exact count/sum) — PR 17's per-query
+            # observability embedded in the SWEEP artifact.
+            "latency_breakdown": {
+                "queue_wait_ms": breakdown["queue_wait_ms"],
+                "service_ms": breakdown["service_ms"],
+            },
+            "latency_histogram": breakdown["histogram"],
+            "histogram_polled_queries": polled_queries,
             "ab_identity_checked": n_queries,
             "recompiles_after_warmup": 0,
             "recompile_sentinel": {
@@ -1468,7 +1536,15 @@ def main(argv=None) -> None:
             # material).
             "what-if queries/sec (open-loop lane-async fleet: 32 "
             "heterogeneous-horizon queries over 4 resident lanes)",
-            run_open_loop(json_path=_open_loop_path()),
+            run_open_loop(
+                json_path=_open_loop_path(),
+                trace_path=(
+                    _trace_path("open_loop") if trace else None
+                ),
+                metrics_path=(
+                    _metrics_path("open_loop") if trace else None
+                ),
+            ),
         )
         return
     # --endurance [N]: the bounded-memory endurance line standalone — N
@@ -1637,6 +1713,12 @@ def main(argv=None) -> None:
                 max_pods_per_cycle=64,
                 smoke=True,
                 json_path=_open_loop_path(),
+                trace_path=(
+                    _trace_path("open_loop") if trace else None
+                ),
+                metrics_path=(
+                    _metrics_path("open_loop") if trace else None
+                ),
             ),
         )
         _emit_sweep(

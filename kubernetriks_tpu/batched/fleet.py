@@ -54,6 +54,15 @@ Lane reset protocol — two modes:
   gate), per-lane completion is pure host arithmetic over the clock
   mirrors (zero new syncs), and the telemetry ring's lane_active column
   feeds the observatory's lane-occupancy gauge + idle-lane verdict.
+
+Query observatory (PR 17, DESIGN §14): every query carries a host-side
+lifecycle record (submitted → admitted-to-lane → first-dispatch →
+horizon-drained → polled, all perf_counter_ns stamps — no device reads),
+the tracer gets a queue-wait and a service span per query linked by a
+submit→drain Chrome flow plus a per-lane swimlane event, and the latency
+statistics live in bounded log-bucketed streaming histograms
+(telemetry/histogram.py: O(buckets) forever, never O(queries)) with the
+queue-wait (submit→admit) vs service (admit→drain) split.
 """
 
 from __future__ import annotations
@@ -71,6 +80,20 @@ from kubernetriks_tpu.config import (
     KubeHorizontalPodAutoscalerConfig,
     SimulationConfig,
 )
+from kubernetriks_tpu.telemetry.histogram import LatencyHistogram
+from kubernetriks_tpu.telemetry.tracer import (
+    PH_QUERY_QUEUE,
+    PH_QUERY_SERVICE,
+)
+
+# Lifecycle records retired at poll() survive in a bounded trail (the
+# most recent polled queries stay inspectable via query_lifecycle()).
+_POLLED_LIFECYCLES_KEPT = 128
+# Exact-sample cross-check window: the open-loop bench compares the
+# histogram-derived p99 against the exact sorted-array p99 over this many
+# most-recent latencies while both exist (bounded — the histogram is the
+# statistic of record once the stream outgrows it).
+_EXACT_LATENCY_WINDOW = 1024
 
 # Scenario keys accepted as per-lane overrides (the vectorizable set).
 SCENARIO_KEYS = (
@@ -453,9 +476,24 @@ class ScenarioFleet:
         self._live_vectors = {k: v.copy() for k, v in self._vectors.items()}
         self._active: Dict[int, tuple] = {}  # lane -> (qid, scen, horizon)
         self._trace_rows: Dict[int, tuple] = {}  # qid -> (lo, hi)
-        self._submit_wall: Dict[int, float] = {}
         self._completed: deque = deque()
-        self.query_latency_s: Dict[int, float] = {}
+        # Query-observatory state (PR 17). _lifecycle holds one mutable
+        # record per LIVE query (queued / in-flight / completed-unpolled):
+        # perf_counter_ns stamps for submitted -> admitted ->
+        # first_dispatch -> drained (-> polled at retirement), the
+        # assigned lane, and the Chrome flow id linking submit to drain.
+        # poll() retires records into the bounded _polled_lifecycles
+        # trail, so the map's size tracks live queries, never the stream.
+        self._lifecycle: Dict[int, Dict[str, int]] = {}
+        self._polled_lifecycles: deque = deque(maxlen=_POLLED_LIFECYCLES_KEPT)
+        # Latency statistics: bounded log-bucket histograms (O(buckets),
+        # exact count/sum — the replacement for the PR 16-era unbounded
+        # query_latency_s dict) + the bounded exact-sample window the
+        # bench's histogram-vs-exact assert reads.
+        self.latency_hist = LatencyHistogram()
+        self.queue_wait_hist = LatencyHistogram()
+        self.service_hist = LatencyHistogram()
+        self.latency_exact_window: deque = deque(maxlen=_EXACT_LATENCY_WINDOW)
         self.pump_rounds = 0
         # True once a pump round has exercised the full program set
         # (assign + step + drain) — the sentinel guards rounds after that.
@@ -492,7 +530,13 @@ class ScenarioFleet:
             self._trace_rows[self._next_query] = (int(lo), hi)
         qid = self._next_query
         self._next_query += 1
-        self._submit_wall[qid] = time.monotonic()
+        # Lifecycle birth: host stamp + the submit->drain flow arrow's id
+        # (NULL_TRACER returns 0 = no flow; all pure host, zero syncs).
+        self._lifecycle[qid] = {
+            "submitted_ns": time.perf_counter_ns(),
+            "flow_id": self.engine.tracer.flow_start(PH_QUERY_QUEUE),
+            "lane": -1,
+        }
         self._queue.append(
             (
                 qid,
@@ -587,17 +631,37 @@ class ScenarioFleet:
         if self._dirty:
             eng.fleet_reset()
         self._dirty = True
+        # Wave admission: every lane of the wave starts together, so the
+        # whole wave shares one admission stamp (queue-wait on this path
+        # is wave-packing delay, not lane contention).
+        t_admit = time.perf_counter_ns()
+        for lane, (qid, _, _) in enumerate(wave):
+            rec = self._lifecycle.get(qid)
+            if rec is not None:
+                rec["admitted_ns"] = t_admit
+                rec["lane"] = lane
         # Step to each distinct horizon once; lanes finishing there are
         # read back while the host is already blocked at the step exit.
         by_horizon: Dict[float, list] = {}
         for lane, (qid, scen, horizon) in enumerate(wave):
             by_horizon.setdefault(horizon, []).append((qid, lane, scen))
+        tracer = eng.tracer
         for horizon in sorted(by_horizon):
             eng.step_until_time(horizon)
             lanes = [lane for _, lane, _ in by_horizon[horizon]]
             rows = self._lane_rows(lanes)
+            t_drain = time.perf_counter_ns()
             for qid, lane, scen in by_horizon[horizon]:
                 self._drain_lane(qid, lane, horizon, scen, rows)
+                # Retire the lifecycle record here (wave fleets read
+                # results from `results`, not poll()) so the map stays
+                # bounded by live queries on this path too.
+                rec = self._lifecycle.pop(qid, None)
+                if rec is not None:
+                    rec["drained_ns"] = t_drain
+                    if rec["flow_id"]:
+                        tracer.flow_end(PH_QUERY_QUEUE, rec["flow_id"])
+                    self._polled_lifecycles.append((qid, rec))
         self.waves_run += 1
 
     def run(self) -> Dict[int, FleetResult]:
@@ -678,10 +742,30 @@ class ScenarioFleet:
                 eng.next_window_idx,
                 [eng.horizon_windows(h) for _, _, _, h in assigned],
             )
+            # Lifecycle: admitted-to-lane — close the queue-wait span
+            # (submit -> here) on the tracer with an explicit duration.
+            t_admit = time.perf_counter_ns()
+            tracer = eng.tracer
             for lane, qid, scen, horizon in assigned:
                 self._active[lane] = (qid, scen, horizon)
+                rec = self._lifecycle.get(qid)
+                if rec is not None:
+                    rec["admitted_ns"] = t_admit
+                    rec["lane"] = lane
+                    tracer.end(
+                        PH_QUERY_QUEUE,
+                        rec["submitted_ns"],
+                        dur=t_admit - rec["submitted_ns"],
+                    )
         if not self._active:
             return 0
+        # Lifecycle: first-dispatch — the step block below is the first
+        # device dispatch that can carry a freshly admitted lane's plan.
+        t_dispatch = time.perf_counter_ns()
+        for lane, (qid, _, _) in self._active.items():
+            rec = self._lifecycle.get(qid)
+            if rec is not None and "first_dispatch_ns" not in rec:
+                rec["first_dispatch_ns"] = t_dispatch
         # 2. Dispatch, boundary-aligned: while every lane is mid-plan,
         # step power-of-two sub-spans clamped to the NEAREST lane
         # completion (ladder {span, span/2, ..., 1} — each shape compiles
@@ -731,26 +815,117 @@ class ScenarioFleet:
         if not finished:
             return 0
         rows = self._lane_rows(finished)
-        now = time.monotonic()
+        t_drain = time.perf_counter_ns()
         obs = getattr(eng, "observatory", None)
+        tracer = eng.tracer
         for lane in finished:
             qid, scen, horizon = self._active.pop(lane)
             self._drain_lane(
                 qid, lane, horizon, scen, rows, wave=self.pump_rounds
             )
-            lat = now - self._submit_wall.get(qid, now)
-            self.query_latency_s[qid] = lat
+            # Lifecycle: horizon-drained — close the service span
+            # (admit -> here), land the flow arrow, and draw the lane
+            # swimlane interval; then fold the total / queue-wait /
+            # service walls into the bounded histograms. All host
+            # timestamps: telemetry armed or not, zero device reads.
+            rec = self._lifecycle.get(qid)
+            if rec is not None:
+                rec["drained_ns"] = t_drain
+                t_sub = rec["submitted_ns"]
+                t_adm = rec.get("admitted_ns", t_sub)
+                tracer.end(
+                    PH_QUERY_SERVICE, t_adm, dur=t_drain - t_adm
+                )
+                if rec["flow_id"]:
+                    tracer.flow_end(PH_QUERY_QUEUE, rec["flow_id"])
+                tracer.lane_event(lane, qid, t_adm, t_drain - t_adm)
+                lat = (t_drain - t_sub) / 1e9
+                queue_wait = (t_adm - t_sub) / 1e9
+                service = (t_drain - t_adm) / 1e9
+            else:  # pragma: no cover - records exist for every submit
+                lat = queue_wait = service = 0.0
+            self.latency_hist.record(lat)
+            self.queue_wait_hist.record(queue_wait)
+            self.service_hist.record(service)
+            self.latency_exact_window.append(lat)
             self._completed.append(qid)
             if obs is not None:
-                obs.note_query(lat)
+                obs.note_query(lat, queue_wait, service)
         return len(finished)
 
-    def poll(self) -> List[FleetResult]:
+    def _qid_inventory(self) -> str:
+        """The known-qid inventory for loud lookup errors: what this
+        fleet has seen, where everything currently is."""
+        if self._next_query == 0:
+            return "no queries have been submitted to this fleet yet"
+        in_flight = sorted(q for q, _, _ in self._active.values())
+        return (
+            f"{self._next_query} submitted "
+            f"(qids 0..{self._next_query - 1}), "
+            f"{len(self.results)} completed "
+            f"({len(self._completed)} unpolled), "
+            f"in-flight qids {in_flight}, {len(self._queue)} queued"
+        )
+
+    def _retire_lifecycle(self, qid: int, t_poll_ns: int) -> None:
+        rec = self._lifecycle.pop(qid, None)
+        if rec is not None:
+            rec["polled_ns"] = t_poll_ns
+            self._polled_lifecycles.append((qid, rec))
+
+    def poll(self, qid: Optional[int] = None) -> List[FleetResult]:
         """Results completed since the last poll, in completion order —
-        the read side of the continuous submit/pump/poll engine."""
-        out = [self.results[qid] for qid in self._completed]
-        self._completed.clear()
-        return out
+        the read side of the continuous submit/pump/poll engine.
+
+        ``poll(qid)`` narrows to one query: its result (as a one-element
+        list) exactly once after it completes, ``[]`` while it is still
+        queued/in-flight (or after its result was already streamed), and
+        a loud ``KeyError`` carrying the known-qid inventory when the
+        qid was never submitted here — silence is reserved for
+        not-ready, never for a caller bug."""
+        t_poll = time.perf_counter_ns()
+        if qid is None:
+            out = [self.results[q] for q in self._completed]
+            for q in self._completed:
+                self._retire_lifecycle(q, t_poll)
+            self._completed.clear()
+            return out
+        qid = int(qid)
+        if qid < 0 or qid >= self._next_query:
+            raise KeyError(
+                f"poll({qid}): query {qid} was never submitted to this "
+                f"fleet — {self._qid_inventory()}"
+            )
+        if qid in self._completed:
+            self._completed.remove(qid)
+            self._retire_lifecycle(qid, t_poll)
+            return [self.results[qid]]
+        return []
+
+    def query_lifecycle(self, qid: int) -> Dict[str, int]:
+        """The host-side lifecycle record for one query: perf_counter_ns
+        stamps (submitted_ns, admitted_ns, first_dispatch_ns, drained_ns,
+        polled_ns — present once the stage happened), the assigned lane,
+        and the trace flow id. Live queries read from the live map;
+        recently polled ones from the bounded retirement trail. Raises
+        the same loud KeyError as poll() for unknown qids (and for
+        records that aged out of the bounded trail)."""
+        qid = int(qid)
+        if 0 <= qid < self._next_query:
+            rec = self._lifecycle.get(qid)
+            if rec is None:
+                for old_qid, old_rec in reversed(self._polled_lifecycles):
+                    if old_qid == qid:
+                        rec = old_rec
+                        break
+            if rec is not None:
+                return dict(rec)
+        raise KeyError(
+            f"query_lifecycle({qid}): no lifecycle record (never "
+            f"submitted, or retired past the last "
+            f"{_POLLED_LIFECYCLES_KEPT} polled queries) — "
+            f"{self._qid_inventory()}"
+        )
 
     def run_async(
         self, span_windows: Optional[int] = None
@@ -784,25 +959,44 @@ class ScenarioFleet:
         }
 
     def reset_query_stats(self) -> None:
-        """Forget the latency samples and the occupancy ledger (bench
+        """Forget the latency histograms and the occupancy ledger (bench
         warm-up boundary: the reported percentiles/occupancy then
-        reflect the resident steady state, not compile time)."""
-        self.query_latency_s.clear()
+        reflect the resident steady state, not compile time). ATOMIC
+        across both sides: the fleet's histograms and the engine
+        observatory's query histograms/SLO window reset together, so the
+        two can never report different streams."""
+        self.latency_hist.reset()
+        self.queue_wait_hist.reset()
+        self.service_hist.reset()
+        self.latency_exact_window.clear()
         self.lane_busy_windows[:] = 0
         self.lane_total_windows[:] = 0
+        obs = getattr(self.engine, "observatory", None)
+        if obs is not None:
+            obs.reset_query_stats()
 
     def query_latency_percentiles(self) -> Dict[str, float]:
         """Submit-to-drain wall latency percentiles (ms) over every
-        completed query — exported next to queries/s in the open-loop
-        bench record and the observatory report."""
-        if not self.query_latency_s:
+        completed query — derived from the bounded histogram (exact
+        count, percentiles within one bucket width of exact) — exported
+        next to queries/s in the open-loop bench record and the
+        observatory report."""
+        h = self.latency_hist
+        if h.count == 0:
             return {"count": 0}
-        lat = np.asarray(sorted(self.query_latency_s.values()))
+        out: Dict[str, float] = {"count": h.count}
+        out.update(h.percentiles_ms())
+        return out
+
+    def query_latency_breakdown(self) -> Dict[str, object]:
+        """The queue-wait (submit→admit) vs service (admit→drain) split
+        plus the raw histogram dump: the open-loop bench embeds this in
+        the SWEEP JSON and the Prometheus exporter renders the histogram
+        natively (`_bucket`/`_sum`/`_count`)."""
         return {
-            "count": int(lat.size),
-            "p50_ms": float(np.percentile(lat, 50) * 1e3),
-            "p95_ms": float(np.percentile(lat, 95) * 1e3),
-            "p99_ms": float(np.percentile(lat, 99) * 1e3),
+            "queue_wait_ms": self.queue_wait_hist.percentiles_ms(),
+            "service_ms": self.service_hist.percentiles_ms(),
+            "histogram": self.latency_hist.to_dict(),
         }
 
     def sweep(

@@ -297,6 +297,23 @@ _FLAGS = [
         "and idle-lane waste at more dispatch overhead. Unset: 8.",
     ),
     Flag(
+        "KTPU_SLO_MS",
+        "int",
+        None,
+        "Latency-SLO target in milliseconds (submit-to-drain wall) for "
+        "lane-async fleet queries: arms the capacity observatory's SLO "
+        "burn-rate verdicts (telemetry/observatory.py) — fast/slow "
+        "error-budget burn alerting with hysteresis, windowed by "
+        "KTPU_SLO_BURN_WINDOW. Unset: SLO verdicts disarmed.",
+    ),
+    Flag(
+        "KTPU_SLO_BURN_WINDOW",
+        "int",
+        60,
+        "Fast burn-rate window (wall seconds) for the SLO verdict; the "
+        "slow-burn window is 12x this. Default: 60.",
+    ),
+    Flag(
         "KUBERNETRIKS_PALLAS",
         "tristate",
         None,

@@ -25,6 +25,15 @@ Plus the capacity half (docs/DESIGN.md §10):
   atomic Prometheus-textfile writer, fed strictly from drained host
   copies.
 
+And the query half (docs/DESIGN.md §14, PR 17):
+
+- **Latency histogram** (histogram.py): the log-bucketed streaming
+  histogram (O(buckets), exact count/sum, ~5% relative resolution)
+  behind the lane-async fleet's per-query latency stats, the
+  observatory's `query_stats()`, and the native Prometheus
+  `_bucket`/`_sum`/`_count` series — replacing every O(queries) host
+  structure on the serving path.
+
 Enable with `KTPU_TRACE=1` (or `BatchedSimulation(telemetry=True)`);
 `engine.telemetry_report()` / `engine.write_chrome_trace()` /
 `engine.drain_telemetry()` read it out, and `bench.py --trace` embeds
@@ -32,6 +41,7 @@ the summary in the BENCH JSON.
 """
 
 from kubernetriks_tpu.telemetry.gauges import GaugeSeries
+from kubernetriks_tpu.telemetry.histogram import LatencyHistogram
 from kubernetriks_tpu.telemetry.tracer import (
     NULL_TRACER,
     NullTracer,
@@ -42,6 +52,7 @@ from kubernetriks_tpu.telemetry.tracer import (
 
 __all__ = [
     "GaugeSeries",
+    "LatencyHistogram",
     "NULL_TRACER",
     "NullTracer",
     "PHASE_NAMES",

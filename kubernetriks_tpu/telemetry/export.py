@@ -121,10 +121,41 @@ def prometheus_lines(report: Dict, prefix: str = "ktpu_") -> List[str]:
                 gauge("memory_bytes", sub_val, {"kind": f"{key}.{sub_key}"})
         else:
             gauge("memory_bytes", value, {"kind": key})
-    for key, value in (resources.get("queries") or {}).items():
-        # Lane-async per-query latency percentiles (observatory
-        # query_stats): count + p50/p95/p99 in ms.
-        gauge("query_latency", value, {"stat": key})
+    queries = resources.get("queries") or {}
+    for key, value in queries.items():
+        # Lane-async per-query latency stats (observatory query_stats):
+        # count + p50/p95/p99 in ms, with the queue_wait/service split
+        # flattened into the stat label.
+        if key == "histogram":
+            continue
+        if isinstance(value, dict):
+            for sub_key, sub_val in value.items():
+                gauge("query_latency", sub_val, {"stat": f"{key}_{sub_key}"})
+        else:
+            gauge("query_latency", value, {"stat": key})
+    hist = queries.get("histogram") or {}
+    if hist:
+        # Native Prometheus histogram series from the bounded log-bucket
+        # histogram: cumulative _bucket{le=...} samples (sparse — only
+        # boundaries with nonzero increments, "+Inf" last), exact _sum
+        # and _count, values under the same precision-preserving rule as
+        # every other sample.
+        for le, cum in hist.get("buckets") or []:
+            le_num = _num(le)
+            le_txt = (
+                le
+                if le_num is None
+                else (
+                    str(int(le_num))
+                    if le_num == int(le_num)
+                    else repr(le_num)
+                )
+            )
+            gauge(
+                "query_latency_seconds_bucket", cum, {"le": str(le_txt)}
+            )
+        gauge("query_latency_seconds_sum", hist.get("sum_s"))
+        gauge("query_latency_seconds_count", hist.get("count"))
     watchdog = (resources.get("watchdog") or {})
     gauge("watchdog_enabled", watchdog.get("enabled"))
     for kind, window in (watchdog.get("fired") or {}).items():

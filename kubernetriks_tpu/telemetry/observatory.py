@@ -57,11 +57,26 @@ from kubernetriks_tpu.batched.state import (
     TELEM_POD_HEADROOM,
     TELEM_WINDOW,
 )
+from kubernetriks_tpu.flags import flag_int
+from kubernetriks_tpu.telemetry.histogram import LatencyHistogram
 
 # TELEM_POD_HEADROOM values at or above this mean "no sliding window /
 # whole plain trace resident" (state.StepConstants.trace_pod_bound
 # defaults to a 1 << 30 sentinel): the watchdog skips those clusters.
 UNBOUNDED_SENTINEL = 1 << 28
+
+# SLO burn-rate verdict constants (DESIGN §14): the objective is "99% of
+# queries complete under KTPU_SLO_MS", i.e. a 1% error budget. Burn rate
+# = (violating fraction over a window) / budget; the fast page fires at
+# the classic 14.4x multiple over the fast window (KTPU_SLO_BURN_WINDOW),
+# the slow ticket at 6x over 12x that window, and each clears with
+# hysteresis at half its threshold (like the reserve verdicts' recover
+# fraction).
+SLO_ERROR_BUDGET = 0.01
+SLO_FAST_BURN = 14.4
+SLO_SLOW_BURN = 6.0
+SLO_MIN_SAMPLES = 8
+_SLO_SAMPLE_CAP = 8192  # bounded (wall-windowed) violation samples
 
 
 class SaturationWarning(UserWarning):
@@ -164,6 +179,8 @@ class Observatory:
         exporters: Optional[list] = None,
         max_events: int = 256,
         lane_idle_frac: float = 0.5,
+        slo_ms: Optional[float] = None,
+        slo_burn_window_s: Optional[float] = None,
     ) -> None:
         self.interval = float(interval)
         self.capacities = dict(capacities or {})
@@ -191,6 +208,14 @@ class Observatory:
         # lane_active ring column is constant 1 everywhere else) means
         # dispatched lane-windows are being thrown away.
         self.lane_idle_frac = float(lane_idle_frac)
+        # Latency-SLO verdict config: explicit kwargs win; otherwise the
+        # registered flags decide (KTPU_SLO_MS unset = disarmed).
+        if slo_ms is None:
+            slo_ms = flag_int("KTPU_SLO_MS")
+        self.slo_ms = float(slo_ms) if slo_ms is not None else None
+        if slo_burn_window_s is None:
+            slo_burn_window_s = flag_int("KTPU_SLO_BURN_WINDOW")
+        self.slo_burn_window_s = float(slo_burn_window_s or 60)
         self.reset()
 
     def reset(self) -> None:
@@ -204,12 +229,26 @@ class Observatory:
         self._mem_high: Dict[str, int] = {}
         self._last_resources: Dict = {}
         self._last_stall_not_ready = 0
-        # Submit-to-drain wall latencies (seconds) noted by the lane-async
-        # fleet's pump — bounded like every other observatory series.
-        self._queries: deque = deque(maxlen=4096)
         self.events: List[Dict] = []
         self.fired: Dict[str, int] = {}
         self.samples = 0
+        self.reset_query_stats()
+
+    def reset_query_stats(self) -> None:
+        """Reset the query-latency histograms + the SLO sample window
+        atomically (the fleet's reset_query_stats() calls this so the
+        fleet and observatory sides never disagree). Fired SLO verdicts
+        clear too: the post-reset traffic is a fresh trajectory."""
+        # Bounded per-query latency stats (PR 17): log-bucketed streaming
+        # histograms — O(buckets) forever, never O(queries) — for the
+        # total submit->drain wall plus the queue-wait / service split.
+        self._lat_hist = LatencyHistogram()
+        self._queue_hist = LatencyHistogram()
+        self._service_hist = LatencyHistogram()
+        # (t_wall, violated) pairs for the SLO burn-rate windows.
+        self._slo_samples: deque = deque(maxlen=_SLO_SAMPLE_CAP)
+        for kind in ("slo_fast_burn", "slo_slow_burn"):
+            self.fired.pop(kind, None)
 
     # -- ingest -------------------------------------------------------------
 
@@ -424,6 +463,66 @@ class Observatory:
                 )
             )
 
+    def _check_slo(self, warnings_out: list) -> None:
+        """Latency-SLO burn-rate verdicts (armed by KTPU_SLO_MS): the
+        violating fraction of recent queries against the 1% error budget,
+        judged over two wall windows — fast (KTPU_SLO_BURN_WINDOW, 14.4x
+        threshold: pager material) and slow (12x the window, 6x: a
+        ticket). A latency regression burns the budget the moment slow
+        queries land, so this fires while lane occupancy still looks
+        perfect — strictly before the idle-lane or reserve verdicts see
+        anything. Hysteresis like the reserve verdicts: a fired kind
+        clears (and re-arms) once its burn rate drops to half the firing
+        threshold."""
+        if self.slo_ms is None or not self._slo_samples:
+            return
+        now = time.monotonic()
+        for kind, window, threshold in (
+            ("slo_fast_burn", self.slo_burn_window_s, SLO_FAST_BURN),
+            ("slo_slow_burn", 12.0 * self.slo_burn_window_s, SLO_SLOW_BURN),
+        ):
+            total = 0
+            bad = 0
+            for t, violated in reversed(self._slo_samples):
+                if now - t > window:
+                    break
+                total += 1
+                bad += int(violated)
+            if total < SLO_MIN_SAMPLES:
+                continue
+            burn = (bad / total) / SLO_ERROR_BUDGET
+            if burn >= threshold:
+                warnings_out.append(
+                    self._warn(
+                        kind,
+                        f"saturation watchdog: {kind.replace('_', ' ')} — "
+                        f"{bad}/{total} queries over the {self.slo_ms:g}ms "
+                        f"SLO in the last {window:g}s wall window, burn "
+                        f"rate {burn:.1f}x the {SLO_ERROR_BUDGET:.0%} "
+                        f"error budget (threshold {threshold}x) — slow "
+                        "lanes are eating the budget while occupancy "
+                        "still looks healthy; shed load or add lanes",
+                        burn_rate=round(burn, 2),
+                        window_s=round(window, 1),
+                        violations=bad,
+                        samples=total,
+                        slo_ms=self.slo_ms,
+                    )
+                )
+            elif kind in self.fired and burn <= threshold / 2.0:
+                del self.fired[kind]
+                warnings_out.append(
+                    self._event(
+                        f"{kind}_recovered",
+                        f"saturation watchdog: {kind.replace('_', ' ')} "
+                        f"recovered — burn rate down to {burn:.1f}x "
+                        f"(clear threshold {threshold / 2.0:g}x); the "
+                        "verdict re-arms",
+                        burn_rate=round(burn, 2),
+                        window_s=round(window, 1),
+                    )
+                )
+
     def _check_pipeline(
         self, dispatch_stats: Optional[Dict], sync_budget: Optional[Dict],
         feeder: Optional[Dict], warnings_out: list,
@@ -530,6 +629,7 @@ class Observatory:
             self._check_reserve("ca_reserve_used", 2, fired)
             self._check_headroom(fired)
             self._check_lanes(fired)
+            self._check_slo(fired)
             self._check_pipeline(dispatch_stats, sync_budget, feeder, fired)
         record = {
             "t_wall_s": round(time.time(), 3),
@@ -540,7 +640,7 @@ class Observatory:
             "resources": dict(self._last_resources),
             "watchdog": [dict(e) for e in fired],
         }
-        if self._queries:
+        if self._lat_hist.count:
             record["queries"] = self.query_stats()
         if fresh is None:
             record["fresh_windows"] = len(self._points)
@@ -599,28 +699,47 @@ class Observatory:
 
     # -- query latency (lane-async fleet) -----------------------------------
 
-    def note_query(self, latency_s: float) -> None:
+    def note_query(
+        self,
+        latency_s: float,
+        queue_wait_s: Optional[float] = None,
+        service_s: Optional[float] = None,
+    ) -> None:
         """Record one completed query's submit-to-drain wall latency —
         called by the lane-async fleet's pump at the drain boundary (pure
-        host float, no device access)."""
-        self._queries.append(float(latency_s))
+        host floats, no device access). ``queue_wait_s`` / ``service_s``
+        carry the submit→admit vs admit→drain split when the caller has
+        lifecycle records (the PR 16-era single-number call keeps
+        working)."""
+        lat = float(latency_s)
+        self._lat_hist.record(lat)
+        if queue_wait_s is not None:
+            self._queue_hist.record(float(queue_wait_s))
+        if service_s is not None:
+            self._service_hist.record(float(service_s))
+        if self.slo_ms is not None:
+            self._slo_samples.append(
+                (time.monotonic(), lat * 1e3 > self.slo_ms)
+            )
 
     def query_stats(self) -> Dict:
-        """Latency percentiles (ms) over the recorded query completions —
-        the observatory half of the open-loop bench's per-query numbers."""
-        if not self._queries:
+        """Latency percentiles (ms) over the recorded query completions,
+        derived from the bounded histogram buckets (O(buckets) memory,
+        exact count/sum, percentiles within one bucket width of exact) —
+        plus the queue-wait/service split and the native-histogram dump
+        the Prometheus exporter renders as ``_bucket``/``_sum``/
+        ``_count``."""
+        h = self._lat_hist
+        if h.count == 0:
             return {"count": 0}
-        # np.fromiter, not np.asarray: the latency deque is pure host
-        # floats, and this module's zero-sync-waiver policy bans the
-        # asarray spelling outright (it is the smuggling seam the
-        # host-sync pass patrols for).
-        lat = np.fromiter(self._queries, np.float64, count=len(self._queries))
-        return {
-            "count": int(lat.size),
-            "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 3),
-            "p95_ms": round(float(np.percentile(lat, 95)) * 1e3, 3),
-            "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 3),
-        }
+        out: Dict = {"count": h.count}
+        out.update(h.percentiles_ms())
+        if self._queue_hist.count:
+            out["queue_wait"] = self._queue_hist.percentiles_ms()
+        if self._service_hist.count:
+            out["service"] = self._service_hist.percentiles_ms()
+        out["histogram"] = h.to_dict()
+        return out
 
     def report(self) -> Dict:
         """The `telemetry_report()["resources"]` section: occupancy,
@@ -638,6 +757,8 @@ class Observatory:
                 "events": [dict(e) for e in self.events[-16:]],
                 "horizon_s": self.horizon_s,
                 "warn_frac": self.warn_frac,
+                "slo_ms": self.slo_ms,
+                "slo_burn_window_s": self.slo_burn_window_s,
             },
             "samples": self.samples,
         }

@@ -57,6 +57,13 @@ PH_CHUNK_FENCED = 14  # instrumented dispatch + device fence (profiled runs)
 # end(phase, t0, dur=...) from the feeder's consumer side.
 PH_STAGE_WAIT_FEEDER = 15
 PH_STAGE_WAIT_UPLOAD = 16
+# Query-observatory lifecycle stages (PR 17, batched/fleet.py): the
+# queue-wait half (submit -> lane admission) and the service half
+# (admission -> horizon drain) of every lane-async query, both recorded
+# with explicit host durations via end(phase, t0, dur=...) and linked by
+# a submit->drain Chrome flow arrow per query.
+PH_QUERY_QUEUE = 17
+PH_QUERY_SERVICE = 18
 
 PHASE_NAMES = (
     "window_chunk",
@@ -76,11 +83,18 @@ PHASE_NAMES = (
     "chunk_fenced",
     "stage_wait_feeder",
     "stage_wait_upload",
+    "query_queue",
+    "query_service",
 )
 
 _N_PHASES = len(PHASE_NAMES)
 _FLOW_START = 0
 _FLOW_END = 1
+
+# Chrome-trace process ids: pid 0 = host spans, pid 1 = device-ring
+# sim-time counter tracks (telemetry/ring.py), pid 2 = fleet lane
+# swimlanes (one tid per lane, spans named by the occupying query id).
+LANE_PID = 2
 
 
 class _AnnotatedSpan:
@@ -117,7 +131,12 @@ class _AnnotatedSpan:
 
 
 class SpanTracer:
-    def __init__(self, capacity: int = 1 << 16, flow_capacity: int = 1 << 14):
+    def __init__(
+        self,
+        capacity: int = 1 << 16,
+        flow_capacity: int = 1 << 14,
+        lane_capacity: int = 1 << 14,
+    ):
         # Span event ring: [t0_ns, dur_ns, phase]; kept events wrap, the
         # per-phase aggregates below stay exact regardless.
         self._spans = np.zeros((capacity, 3), np.int64)
@@ -126,6 +145,11 @@ class SpanTracer:
         self._flows = np.zeros((flow_capacity, 4), np.int64)
         self._n_flows = 0
         self._next_flow = 1
+        # Lane-occupancy ring (query observatory): [t0_ns, dur_ns, lane,
+        # qid] — rendered as one Perfetto swimlane per fleet lane with
+        # the occupying query id as the span name.
+        self._lane_spans = np.zeros((lane_capacity, 4), np.int64)
+        self._n_lane_spans = 0
         # Exact per-phase aggregates (ns).
         self._agg_count = np.zeros(_N_PHASES, np.int64)
         self._agg_total = np.zeros(_N_PHASES, np.int64)
@@ -178,6 +202,18 @@ class SpanTracer:
         buf[i, 2] = fid
         buf[i, 3] = kind
         self._n_flows += 1
+
+    def lane_event(self, lane: int, qid: int, t0: int, dur: int) -> None:
+        """One lane-occupancy interval: query ``qid`` held fleet lane
+        ``lane`` for ``dur`` ns starting at ``t0`` (host clock). Ring
+        write only — O(1), no allocation, no device touch."""
+        i = self._n_lane_spans % self._lane_spans.shape[0]
+        buf = self._lane_spans
+        buf[i, 0] = t0
+        buf[i, 1] = dur
+        buf[i, 2] = lane
+        buf[i, 3] = qid
+        self._n_lane_spans += 1
 
     def span(self, phase: int) -> _AnnotatedSpan:
         """Context-manager span for cold paths (checkpoint I/O, the
@@ -244,6 +280,39 @@ class SpanTracer:
                     "tid": 0,
                 }
             )
+        lane_rows = self._kept(self._lane_spans, self._n_lane_spans).tolist()
+        if lane_rows:
+            ev.append(
+                {
+                    "ph": "M",
+                    "name": "process_name",
+                    "pid": LANE_PID,
+                    "tid": 0,
+                    "args": {"name": "ktpu-lanes"},
+                }
+            )
+            for lane in sorted({int(r[2]) for r in lane_rows}):
+                ev.append(
+                    {
+                        "ph": "M",
+                        "name": "thread_name",
+                        "pid": LANE_PID,
+                        "tid": lane,
+                        "args": {"name": f"lane {lane}"},
+                    }
+                )
+            for t0, dur, lane, qid in lane_rows:
+                ev.append(
+                    {
+                        "ph": "X",
+                        "name": f"q{int(qid)}",
+                        "cat": "lane",
+                        "ts": (t0 - epoch) / 1e3,
+                        "dur": dur / 1e3,
+                        "pid": LANE_PID,
+                        "tid": int(lane),
+                    }
+                )
         if extra_events:
             ev.extend(extra_events)
         return {
@@ -284,6 +353,12 @@ class SpanTracer:
                 "recorded": int(self._n_spans),
                 "kept": int(min(self._n_spans, self._spans.shape[0])),
             },
+            "lane_spans": {
+                "recorded": int(self._n_lane_spans),
+                "kept": int(
+                    min(self._n_lane_spans, self._lane_spans.shape[0])
+                ),
+            },
         }
 
 
@@ -323,11 +398,19 @@ class NullTracer:
     def flow_end(self, phase: int, fid: int) -> None:
         pass
 
+    def lane_event(self, lane: int, qid: int, t0: int, dur: int) -> None:
+        pass
+
     def span(self, phase: int) -> _NullSpan:
         return _NULL_SPAN
 
     def report(self) -> dict:
-        return {"spans": {}, "counters": {}, "span_events": {"recorded": 0, "kept": 0}}
+        return {
+            "spans": {},
+            "counters": {},
+            "span_events": {"recorded": 0, "kept": 0},
+            "lane_spans": {"recorded": 0, "kept": 0},
+        }
 
 
 NULL_TRACER = NullTracer()

@@ -20,6 +20,11 @@ the per-lane window clocks of DESIGN §13).
    sentinel); the occupancy ledger and latency percentiles account every
    query; the trace mux masks per-lane row spans and never re-offers a
    flying lane.
+5. QUERY OBSERVATORY (DESIGN §14): poll() of a never-submitted qid is a
+   loud KeyError carrying the known-qid inventory; reset_query_stats()
+   zeroes the bounded latency histograms without discarding results
+   (poll-after-reset still streams each completion exactly once); every
+   polled query's lifecycle stages are host-clock monotone.
 """
 
 import os
@@ -198,6 +203,64 @@ def test_async_ledger_and_latency_account_every_query(async_ab_runs):
     asy_p.reset_query_stats()
     assert asy_p.query_latency_percentiles() == {"count": 0}
     assert asy_p.lane_occupancy()["mean"] == 1.0  # pristine ledger
+
+
+def test_async_poll_unknown_qid_raises_with_inventory(async_ab_runs):
+    """poll(qid) on a never-submitted query id is a LOUD KeyError that
+    names the qid and inventories what the fleet has actually seen —
+    never a silent empty list (a typo'd qid would otherwise read as
+    'still pending' forever)."""
+    _, _, asy, _, _, _, _ = async_ab_runs
+    with pytest.raises(KeyError, match=r"poll\(9999\).*never submitted"):
+        asy.poll(9999)
+    with pytest.raises(KeyError, match="never submitted"):
+        asy.poll(-1)
+    try:
+        asy.poll(9999)
+    except KeyError as err:
+        msg = str(err)
+        assert "submitted (qids 0.." in msg  # the known-qid inventory
+    # A known-but-pending qid is NOT an error: it returns [] (qid 0 was
+    # submitted and already polled, so it's known and not completed).
+    assert asy.poll(0) == []
+
+
+def test_async_poll_after_reset_streams_results(async_ab_runs):
+    """Poll-after-reset semantics: reset_query_stats() clears the latency
+    HISTOGRAMS (count back to 0) but never discards RESULTS — a query
+    completed before the reset is still polled exactly once after it."""
+    _, _, _, _, asy_p, qids_p, _ = async_ab_runs
+    # The ledger gate above already reset asy_p's stats; its results were
+    # never polled.
+    assert asy_p.query_latency_percentiles() == {"count": 0}
+    first = asy_p.poll(qids_p[0])
+    assert len(first) == 1 and first[0].query == qids_p[0]
+    assert asy_p.poll(qids_p[0]) == []  # streamed once, even post-reset
+    rest = asy_p.poll()
+    assert sorted(r.query for r in rest) == sorted(qids_p[1:])
+    # Polling completions from BEFORE the reset does not repopulate the
+    # histograms: recording happens at drain time, not poll time.
+    assert asy_p.query_latency_percentiles() == {"count": 0}
+
+
+def test_async_query_lifecycle_stages_are_monotone(async_ab_runs):
+    """Every polled query's lifecycle record carries the five host-clock
+    stages of DESIGN §14 in order (submitted <= admitted <=
+    first-dispatch <= drained <= polled) and a real lane assignment."""
+    _, _, asy, qids, _, _, _ = async_ab_runs
+    for qid in qids:
+        rec = asy.query_lifecycle(qid)
+        assert rec["lane"] >= 0
+        assert "flow_id" in rec  # 0 here: the fixture runs untraced
+        assert (
+            rec["submitted_ns"]
+            <= rec["admitted_ns"]
+            <= rec["first_dispatch_ns"]
+            <= rec["drained_ns"]
+            <= rec["polled_ns"]
+        ), rec
+    with pytest.raises(KeyError, match="no lifecycle record"):
+        asy.query_lifecycle(31337)
 
 
 def test_async_matches_scalar_oracles_at_own_horizons():

@@ -523,6 +523,169 @@ def test_prometheus_textfile(tmp_path):
     assert not os.path.exists(path + ".tmp")
 
 
+# --- query observatory (PR 17, DESIGN §14) --------------------------------
+
+
+def test_latency_histogram_percentiles_within_one_bucket_width():
+    """Property gate for the bounded log-bucket histogram: over random
+    log-uniform latency streams, count and sum are EXACT and every
+    bucket-derived percentile lands within one bucket width of
+    numpy.percentile(..., method="higher") over the raw samples."""
+    from kubernetriks_tpu.telemetry import LatencyHistogram
+
+    rng = np.random.default_rng(1234)
+    for trial in range(6):
+        n = int(rng.integers(3, 4000))
+        lat = np.exp(rng.uniform(np.log(1e-5), np.log(120.0), n))
+        h = LatencyHistogram()
+        for v in lat.tolist():
+            h.record(v)
+        assert h.count == n
+        assert h.sum_s == pytest.approx(math.fsum(lat.tolist()), rel=1e-9)
+        assert h.min_s == lat.min() and h.max_s == lat.max()
+        for q in (0.0, 50.0, 90.0, 95.0, 99.0, 100.0):
+            exact = float(np.percentile(lat, q, method="higher"))
+            got = h.percentile(q)
+            assert abs(got - exact) <= h.bucket_width(exact) + 1e-15, (
+                trial,
+                q,
+                got,
+                exact,
+                h.bucket_width(exact),
+            )
+
+
+def test_latency_histogram_memory_is_o_buckets_under_100k_soak():
+    """The bounded-memory claim, observed: 100k samples spanning the
+    underflow and overflow buckets leave the footprint EXACTLY where it
+    started — O(buckets), never O(queries) — while count stays exact and
+    the sparse cumulative dump stays monotone and complete."""
+    from kubernetriks_tpu.telemetry import LatencyHistogram
+
+    h = LatencyHistogram()
+    base = h.footprint_bytes()
+    assert 0 < base < 8192  # ~522 int64 buckets, nothing per-sample
+    rng = np.random.default_rng(7)
+    vals = np.exp(rng.uniform(np.log(1e-7), np.log(1e6), 100_000))
+    for v in vals.tolist():
+        h.record(v)
+    assert h.count == 100_000
+    assert h.footprint_bytes() == base
+    bks = h.buckets()
+    cums = [c for _, c in bks]
+    assert cums[-1] == 100_000 and bks[-1][0] == math.inf
+    assert all(cums[i] < cums[i + 1] for i in range(len(cums) - 1))
+    # The overflow percentile reports the exact observed maximum (the
+    # bucket boundary is +Inf — useless as a number).
+    assert h.percentile(100.0) == float(vals.max())
+    h.reset()
+    assert h.count == 0 and h.sum_s == 0.0
+    assert h.percentiles_ms() == {} and h.buckets() == []
+    assert h.to_dict() == {"count": 0, "sum_s": 0.0, "buckets": []}
+    assert h.footprint_bytes() == base
+
+
+def test_slo_burn_rate_fires_before_occupancy_and_recovers():
+    """The SLO verdict (KTPU_SLO_MS): a burst of over-SLO queries burns
+    the 1% error budget past BOTH burn thresholds while every occupancy
+    gauge stays healthy — so the latency regression pages strictly
+    before any reserve/idle-lane verdict could notice. Fast queries then
+    dilute the fast window below half its threshold: the fast verdict
+    RECOVERS (event on the trail, no warning) while the slow verdict
+    stays fired without re-warning, and reset_query_stats() re-arms
+    everything atomically."""
+    obs = Observatory(
+        interval=10.0, capacities={}, slo_ms=10.0, slo_burn_window_s=60.0
+    )
+    obs.ingest(_ring_buf([(w, 0, 0, UNBOUNDED_SENTINEL) for w in range(6)]))
+    for _ in range(32):
+        obs.note_query(0.05, queue_wait_s=0.001, service_s=0.049)
+    with pytest.warns(SaturationWarning, match="slo fast burn"):
+        rec = obs.observe()
+    kinds = {e["kind"] for e in rec["watchdog"]}
+    assert kinds == {"slo_fast_burn", "slo_slow_burn"}, kinds  # ONLY slo
+    ev = [e for e in rec["watchdog"] if e["kind"] == "slo_fast_burn"][0]
+    assert ev["burn_rate"] == pytest.approx(100.0)  # (32/32)/0.01
+    assert ev["violations"] == 32 and ev["samples"] == 32
+    stats = obs.query_stats()
+    assert stats["count"] == 32
+    assert stats["queue_wait"]["p50_ms"] == pytest.approx(1.0, rel=0.06)
+    assert stats["service"]["p99_ms"] == pytest.approx(49.0, rel=0.06)
+    assert stats["histogram"]["count"] == 32
+    report = obs.report()
+    assert report["watchdog"]["slo_ms"] == 10.0
+    assert report["watchdog"]["slo_burn_window_s"] == 60.0
+
+    for _ in range(600):
+        obs.note_query(0.001)  # healthy: 1ms << 10ms SLO
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        rec2 = obs.observe()
+    assert not [x for x in w if issubclass(x.category, SaturationWarning)]
+    kinds2 = {e["kind"] for e in rec2["watchdog"]}
+    # fast burn: (32/632)/1% = 5.1x <= 14.4/2 -> recovered; slow burn:
+    # 5.1x is under 6x (no re-fire) but above 3x (no recovery) -> held.
+    assert kinds2 == {"slo_fast_burn_recovered"}, kinds2
+    assert "slo_fast_burn" not in obs.fired
+    assert "slo_slow_burn" in obs.fired
+
+    obs.reset_query_stats()
+    assert obs.query_stats() == {"count": 0}
+    assert "slo_slow_burn" not in obs.fired  # re-armed with the stats
+
+
+def test_slo_verdict_disarmed_without_flag():
+    """No KTPU_SLO_MS, no slo kwarg: note_query records latencies but the
+    SLO verdict never fires, no matter how slow the queries are."""
+    obs = Observatory(interval=10.0, capacities={})
+    assert obs.slo_ms is None
+    obs.ingest(_ring_buf([(0, 0, 0, UNBOUNDED_SENTINEL)]))
+    for _ in range(64):
+        obs.note_query(10.0)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        rec = obs.observe()
+    assert not [x for x in w if issubclass(x.category, SaturationWarning)]
+    assert rec["watchdog"] == []
+    assert obs.query_stats()["count"] == 64
+
+
+def test_prometheus_native_histogram_rendering():
+    """The exporter renders the observatory's query section as a native
+    Prometheus histogram — cumulative _bucket{le=...} samples with the
+    precision-preserving value rule, "+Inf" passed through as the
+    literal label, exact _sum/_count — and never leaks the histogram
+    dict as a flattened gauge."""
+    report = {
+        "resources": {
+            "queries": {
+                "count": 3,
+                "p50_ms": 1.5,
+                "p95_ms": 2.0,
+                "p99_ms": 2.0,
+                "queue_wait": {"p50_ms": 0.25, "p95_ms": 0.5, "p99_ms": 0.5},
+                "service": {"p50_ms": 1.25, "p95_ms": 1.5, "p99_ms": 1.5},
+                "histogram": {
+                    "count": 3,
+                    "sum_s": 0.00525,
+                    "buckets": [[0.001, 1], [0.002, 2], ["+Inf", 3]],
+                },
+            },
+        },
+    }
+    text = "\n".join(prometheus_lines(report))
+    assert 'ktpu_query_latency{stat="count"} 3' in text
+    assert 'ktpu_query_latency{stat="p50_ms"} 1.5' in text
+    assert 'ktpu_query_latency{stat="queue_wait_p50_ms"} 0.25' in text
+    assert 'ktpu_query_latency{stat="service_p99_ms"} 1.5' in text
+    assert 'ktpu_query_latency_seconds_bucket{le="0.001"} 1' in text
+    assert 'ktpu_query_latency_seconds_bucket{le="0.002"} 2' in text
+    assert 'ktpu_query_latency_seconds_bucket{le="+Inf"} 3' in text
+    assert "ktpu_query_latency_seconds_sum 0.00525" in text
+    assert "ktpu_query_latency_seconds_count 3" in text
+    assert 'stat="histogram' not in text
+
+
 def test_watchdog_without_telemetry_raises():
     with pytest.raises(ValueError, match="watchdog"):
         _build_composed(telemetry=False, watchdog=True)

@@ -156,12 +156,23 @@ def validate_chrome_trace(path, expect_flows):
     )
     if expect_flows:
         assert phases["s"] > 0, "no async-readback flow events"
-    # Every span name is a known phase (schema, not free text).
+    # Every span name is a known phase (schema, not free text) — except
+    # the lane-swimlane process (pid LANE_PID, PR 17), whose spans are
+    # named by the occupying query id ("q<qid>").
+    import re
+
     from kubernetriks_tpu.telemetry import PHASE_NAMES
+    from kubernetriks_tpu.telemetry.tracer import LANE_PID
 
     for ev in events:
         if ev["ph"] == "X":
-            assert ev["name"] in PHASE_NAMES
+            if ev["pid"] == LANE_PID:
+                assert re.fullmatch(r"q\d+", ev["name"]), (
+                    f"lane swimlane span named {ev['name']!r}, expected "
+                    "q<qid>"
+                )
+            else:
+                assert ev["name"] in PHASE_NAMES
 
 
 def test_chrome_trace_schema(cheap_pair, tmp_path):
@@ -362,6 +373,69 @@ def test_tracer_span_cost_microbench():
     rep = tr.report()
     assert rep["spans"]["window_chunk"]["count"] == n
     assert rep["span_events"]["kept"] == 1 << 12  # ring wrapped, report exact
+
+
+def test_tracer_lane_swimlanes_and_query_phases(tmp_path):
+    """Query-observatory tracer surface (PR 17): the queue-wait/service
+    phases exist in the taxonomy, lane_event renders one pid-LANE_PID
+    swimlane per lane with the occupying query id as the span name (plus
+    process/thread metadata), the submit->drain flow pairs match, and
+    report() discloses the lane-span ring's recorded/kept counts."""
+    from kubernetriks_tpu.telemetry import PHASE_NAMES
+    from kubernetriks_tpu.telemetry.tracer import (
+        LANE_PID,
+        PH_QUERY_QUEUE,
+        PH_QUERY_SERVICE,
+        NullTracer,
+    )
+
+    assert PHASE_NAMES[PH_QUERY_QUEUE] == "query_queue"
+    assert PHASE_NAMES[PH_QUERY_SERVICE] == "query_service"
+    tr = SpanTracer()
+    t0 = tr.begin()
+    fid = tr.flow_start(PH_QUERY_QUEUE)
+    tr.end(PH_QUERY_QUEUE, t0, dur=1_000)
+    tr.end(PH_QUERY_SERVICE, t0 + 1_000, dur=5_000)
+    tr.lane_event(2, 7, t0 + 1_000, 5_000)
+    tr.lane_event(0, 8, t0 + 1_000, 4_000)
+    tr.flow_end(PH_QUERY_QUEUE, fid)
+    doc = tr.chrome_trace()
+    evs = doc["traceEvents"]
+    lanes = [e for e in evs if e.get("pid") == LANE_PID and e["ph"] == "X"]
+    assert {e["name"] for e in lanes} == {"q7", "q8"}
+    assert {e["tid"] for e in lanes} == {0, 2}
+    assert all(e["dur"] > 0 for e in lanes)
+    meta = [
+        e
+        for e in evs
+        if e.get("pid") == LANE_PID and e["ph"] == "M"
+    ]
+    names = {e["name"]: e["args"]["name"] for e in meta}
+    assert names["process_name"] == "ktpu-lanes"
+    thread_names = {
+        e["args"]["name"] for e in meta if e["name"] == "thread_name"
+    }
+    assert thread_names == {"lane 0", "lane 2"}
+    flows = [e for e in evs if e["ph"] in ("s", "f")]
+    assert {e["id"] for e in flows if e["ph"] == "s"} == {
+        e["id"] for e in flows if e["ph"] == "f"
+    }
+    rep = tr.report()
+    assert rep["lane_spans"] == {"recorded": 2, "kept": 2}
+    assert rep["spans"]["query_queue"]["count"] == 1
+    assert rep["spans"]["query_service"]["count"] == 1
+    # The written file passes the shared schema validator's span-name
+    # rules (no C counter track here — a unit tracer has no device ring
+    # extra_events — so only the span/flow/name assertions apply).
+    path = tr.write_chrome_trace(str(tmp_path / "lanes.json"))
+    with open(path) as fh:
+        for ev in json.load(fh)["traceEvents"]:
+            if ev["ph"] == "X" and ev["pid"] == LANE_PID:
+                assert ev["name"].startswith("q")
+    # NullTracer mirrors the whole surface as no-ops.
+    nt = NullTracer()
+    nt.lane_event(0, 0, 0, 0)
+    assert nt.report()["lane_spans"] == {"recorded": 0, "kept": 0}
 
 
 def test_overhead_gate_smoke_scenario():

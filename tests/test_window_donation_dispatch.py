@@ -222,9 +222,9 @@ def test_steady_state_dispatch_counts():
     log = []
     orig = sim._dispatch_windows
 
-    def recording(idxs, fuse_slide=False):
+    def recording(idxs, fuse_slide=False, freeze_lanes=False):
         log.append((len(idxs), fuse_slide))
-        return orig(idxs, fuse_slide=fuse_slide)
+        return orig(idxs, fuse_slide=fuse_slide, freeze_lanes=freeze_lanes)
 
     sim._dispatch_windows = recording
     sim.step_until_time(400.0)
