@@ -1403,6 +1403,226 @@ def run_open_loop(
     return out
 
 
+def run_host_chaos(
+    n_queries: int = 24,
+    n_lanes: int = 4,
+    n_nodes: int = 8,
+    *,
+    rate_per_second: float = 0.375,
+    horizon: float = 300.0,
+    query_horizon: float = 350.0,
+    max_group_pods: int = 16,
+    burst: tuple = (100.0, 150.0, 250.0),
+    max_pods_per_cycle: int = 64,
+    rounds: int = 4,
+    chaos_seed: int = 7,
+    dispatch_rate: float = 0.05,
+    stall_rate: float = 0.05,
+    stall_ms: float = 1.0,
+    smoke: bool = False,
+    json_path: str = None,
+) -> dict:
+    """The HOST-CHAOS line (fault-tolerant serving, DESIGN §15): the
+    open-loop query stream through a lane-async fleet while a
+    deterministic `HostChaos` injector (counter-seeded threefry, like the
+    in-simulation chaos engine) fails dispatches and stalls lanes — the
+    unit of failure must be a query or a lane, never the fleet.
+
+    Protocol and in-bench gates:
+    - QUIET A/B (the robustness layer is free when off): a plain fleet
+      and the chaos-configured fleet (injector NOT yet armed, aggressive
+      quarantine thresholds configured) run the same stream —
+      bit-identical per-query results AND equal engine dispatch_stats,
+      with the recompile sentinel armed and zero chaos events.
+    - CHAOS phase (pinned seed => the exact same fault schedule every
+      run): `rounds` repeats of the stream with the injector armed.
+      The fleet must finish every round (no engine death), availability
+      over the injected phase >= 90%, every failed qid streams exactly
+      ONE typed error through poll() (stream-once audit), every lane
+      faults at least once (the injector's least-faulted victim rule
+      makes coverage deterministic), at least one lane quarantines AND
+      is later re-admitted, and zero post-warm-up recompiles
+      (quarantine/reset are data ops — jit-cache counts + sentinel).
+    """
+    import warnings as _warnings
+
+    from kubernetriks_tpu.batched.faults import HostChaos
+    from kubernetriks_tpu.batched.fleet import ScenarioFleet, jit_cache_sizes
+    from kubernetriks_tpu.recompile import RecompileSentinel, sentinel_mode
+
+    base_yaml, config, cluster_events, workload = _sweep_setup(
+        n_nodes, rate_per_second, horizon, max_group_pods, burst
+    )
+    scenarios, _ = _sweep_scenarios(n_queries)
+    mix = OPEN_LOOP_HORIZON_MIX
+    horizons = [
+        query_horizon * mix[i % len(mix)] for i in range(n_queries)
+    ]
+
+    sentinel = (
+        RecompileSentinel("raise").install()
+        if sentinel_mode() is not False
+        else None
+    )
+
+    def build(**kw):
+        return ScenarioFleet(
+            config,
+            cluster_events,
+            workload,
+            n_lanes=n_lanes,
+            horizon=query_horizon,
+            max_pods_per_cycle=max_pods_per_cycle,
+            use_pallas=None if not smoke else False,
+            lane_async=True,
+            telemetry=True,
+            **kw,
+        )
+
+    def submit_stream(fleet):
+        return [fleet.submit(s, h) for s, h in zip(scenarios, horizons)]
+
+    # QUIET layer A/B: plain fleet vs chaos-configured-but-disarmed
+    # fleet. quarantine_faults=1 + a 2-round backoff makes the chaos
+    # phase's fire -> probe -> re-admit cycle fast and deterministic;
+    # when quiet it must cost NOTHING observable.
+    plain = build()
+    fl = build(
+        quarantine_faults=1, quarantine_window=64, quarantine_backoff=2
+    )
+    q_plain = submit_stream(plain)
+    plain.run_async()
+    q_warm = submit_stream(fl)
+    fl.run_async()
+    for i, (qp, qw) in enumerate(zip(q_plain, q_warm)):
+        rp, rw = plain.results[qp], fl.results[qw]
+        assert (
+            rp.counters == rw.counters
+            and rp.hpa_replicas == rw.hpa_replicas
+            and rp.ca_nodes == rw.ca_nodes
+        ), (
+            f"host-chaos: query {i} diverges between the plain fleet and "
+            "the chaos-configured (disarmed) fleet — the robustness "
+            f"layer is NOT free when quiet:\n{rp.counters}\n{rw.counters}"
+        )
+    stats_plain = dict(plain.engine.dispatch_stats)
+    stats_quiet = dict(fl.engine.dispatch_stats)
+    assert stats_plain == stats_quiet, (
+        "host-chaos: dispatch_stats diverge between the plain fleet and "
+        "the chaos-configured (disarmed) fleet on the same stream: "
+        f"{stats_plain} vs {stats_quiet}"
+    )
+    assert fl.fault_report()["chaos"] is None
+    plain.close()
+
+    sizes_after_warm = jit_cache_sizes()
+    if sentinel is not None:
+        sentinel.seal("host-chaos warm-up (quiet A/B, full stream)")
+    fl.poll()
+
+    # CHAOS phase: pinned seed => deterministic fault schedule.
+    chaos = HostChaos(
+        seed=chaos_seed,
+        dispatch_rate=dispatch_rate,
+        stall_rate=stall_rate,
+        stall_ms=stall_ms,
+    )
+    fl.arm_host_chaos(chaos)
+    qids = []
+    outcomes: dict = {}
+    with _warnings.catch_warnings():
+        # Quarantine verdicts warn by design (SaturationWarning); the
+        # bench run expects them — the JSON record carries the counts.
+        _warnings.simplefilter("ignore")
+        for _ in range(max(1, rounds)):
+            qids += submit_stream(fl)
+            fl.run_async()
+            for outcome in fl.poll():
+                outcomes[outcome.query] = outcomes.get(outcome.query, 0) + 1
+    res = [fl.results[q] for q in qids]
+    fails = [r for r in res if not r.ok]
+    availability = 1.0 - len(fails) / float(len(res))
+    victim_lanes = sorted({r.lane for r in fails if r.lane >= 0})
+    report = fl.fault_report()
+    failed_by_kind = dict(report["failed"])
+
+    # Stream-once audit: every chaos-phase qid produced exactly one
+    # terminal outcome through poll(), result or typed error alike.
+    missing = [q for q in qids if outcomes.get(q, 0) != 1]
+    assert not missing, (
+        f"host-chaos: {len(missing)} qids did not stream exactly one "
+        f"terminal outcome via poll() (first: {missing[:5]})"
+    )
+    assert all(isinstance(r.kind, str) and not r.ok for r in fails)
+    assert availability >= 0.90, (
+        f"host-chaos: availability {availability:.4f} < 0.90 over the "
+        f"injected phase ({len(fails)}/{len(res)} failed)"
+    )
+    assert victim_lanes == list(range(n_lanes)), (
+        f"host-chaos: dispatch faults hit lanes {victim_lanes}, not all "
+        f"{n_lanes} lanes — the least-faulted victim rule regressed"
+    )
+    assert report["quarantine_events"] >= 1, "no lane ever quarantined"
+    assert report["readmissions"] >= 1, (
+        "no quarantined lane was re-admitted (probe/backoff path dead)"
+    )
+
+    sizes_after = jit_cache_sizes()
+    recompiled = {
+        name: (sizes_after[name], sizes_after_warm[name])
+        for name in sizes_after_warm
+        if sizes_after[name] != sizes_after_warm[name]
+    }
+    assert not recompiled, (
+        "host-chaos: the injected phase RECOMPILED jit entries — "
+        "quarantine/lane-reset must stay data ops "
+        f"(compiled-variant counts moved: {recompiled})"
+    )
+    sentinel_events = 0
+    if sentinel is not None:
+        sentinel.check("the host-chaos injected phase")
+        sentinel_events = len(sentinel.post_seal_events())
+        sentinel.uninstall()
+    fl.close()
+
+    out = {
+        "value": availability,
+        "host_chaos": {
+            "queries_per_round": n_queries,
+            "rounds": max(1, rounds),
+            "lanes": n_lanes,
+            "seed": chaos_seed,
+            "rates": {
+                "dispatch": dispatch_rate,
+                "stall": stall_rate,
+                "stall_ms": stall_ms,
+            },
+            "availability": round(availability, 4),
+            "submitted": len(res),
+            "failed": len(fails),
+            "failed_by_kind": failed_by_kind,
+            "victim_lanes": victim_lanes,
+            "quarantine_events": report["quarantine_events"],
+            "readmissions": report["readmissions"],
+            "lane_states_final": report["lane_states"],
+            "chaos_events": report["chaos"]["events"],
+            "stream_once_audited": len(qids),
+            "quiet_ab_identity_checked": n_queries,
+            "quiet_dispatch_stats_equal": True,
+            "recompiles_after_warmup": 0,
+            "recompile_sentinel": {
+                "armed": sentinel is not None,
+                "post_warmup_events": sentinel_events,
+            },
+        },
+    }
+    if json_path:
+        with open(json_path, "w") as fh:
+            json.dump(out["host_chaos"], fh, indent=2)
+            fh.write("\n")
+    return out
+
+
 def _sweep_path() -> str:
     from kubernetriks_tpu.flags import flag_str
 
@@ -1417,6 +1637,16 @@ def _open_loop_path() -> str:
 
     stem = flag_str("KTPU_SWEEP_PATH") or "ktpu_sweep"
     return f"{stem}_openloop.json"
+
+
+def _host_chaos_path() -> str:
+    """The host-chaos line's JSON artifact rides the sweep stem:
+    <KTPU_SWEEP_PATH or ./ktpu_sweep>_hostchaos.json (CI uploads it as
+    the `ktpu-host-chaos` artifact)."""
+    from kubernetriks_tpu.flags import flag_str
+
+    stem = flag_str("KTPU_SWEEP_PATH") or "ktpu_sweep"
+    return f"{stem}_hostchaos.json"
 
 
 def _trace_path(label: str) -> str:
@@ -1466,6 +1696,19 @@ def _emit_open_loop(metric: str, value: dict) -> None:
     print(json.dumps(rec), flush=True)
 
 
+def _emit_host_chaos(metric: str, value: dict) -> None:
+    """The host-chaos line's unit is availability (completed/submitted
+    over the injected phase) — a robustness gate, not a throughput
+    number; the full fault-domain disclosure rides in the record."""
+    rec = {
+        "metric": metric,
+        "host_chaos": value["host_chaos"],
+        "value": round(value["value"], 4),
+        "unit": "availability",
+    }
+    print(json.dumps(rec), flush=True)
+
+
 def _emit(metric: str, value) -> None:
     # run_composed returns {"value": median, "spans": {n, min, max}} plus,
     # under --trace, a "telemetry" summary — both ride along in the same
@@ -1493,6 +1736,12 @@ def main(argv=None) -> None:
     args = argv if argv is not None else sys.argv[1:]
     smoke = "--smoke" in args
     faults = "--faults" in args
+    # --host-chaos: append the fault-tolerant-serving line (DESIGN §15)
+    # after the open-loop line — a deterministic HostChaos injector
+    # failing dispatches/stalling lanes with the availability,
+    # quarantine and zero-recompile gates armed in-bench. Rides both
+    # --smoke and --sweep; the sweep line stays LAST in smoke mode.
+    host_chaos = "--host-chaos" in args
     # --trace: arm the flight recorder on the composed lines — the
     # telemetry summary lands in their JSON records and each traced line
     # writes a Perfetto-loadable Chrome trace (see _trace_path).
@@ -1546,6 +1795,12 @@ def main(argv=None) -> None:
                 ),
             ),
         )
+        if host_chaos:
+            _emit_host_chaos(
+                "availability (host-chaos lane-async fleet: deterministic "
+                "dispatch faults + stalls, quarantine/backoff armed)",
+                run_host_chaos(json_path=_host_chaos_path()),
+            )
         return
     # --endurance [N]: the bounded-memory endurance line standalone — N
     # (default 96) churn waves through the 4-slot-per-lane CA reserve with
@@ -1721,6 +1976,25 @@ def main(argv=None) -> None:
                 ),
             ),
         )
+        if host_chaos:
+            _emit_host_chaos(
+                # The HOST-CHAOS line (DESIGN §15): the open-loop stream
+                # under a pinned-seed HostChaos injector — quiet-layer
+                # A/B bit-identity, availability >= 90%, every-lane
+                # fault coverage, quarantine fire -> probe -> re-admit,
+                # stream-once error delivery and zero post-warm-up
+                # recompiles are all asserted inside run_host_chaos.
+                # AFTER the open-loop line (shares its warm jit caches),
+                # BEFORE the sweep line (which must stay LAST: its
+                # cold-process baseline clears the jit caches) —
+                # tests/test_bench_smoke.py pins this order.
+                "availability (SMOKE, host-chaos lane-async fleet: "
+                "deterministic dispatch faults + stalls over 4 lanes)",
+                run_host_chaos(
+                    smoke=True,
+                    json_path=_host_chaos_path(),
+                ),
+            )
         _emit_sweep(
             # The scenario-FLEET line: 8 heterogeneous what-if scenarios
             # through one resident 4-lane fleet (batched/fleet.py) — the

@@ -903,6 +903,23 @@ class BatchedSimulation:
         # dispatch_stats["feeder_slabs_produced"] is cumulative.
         self._feeder = None
         self._feeder_produced_total = 0
+        # Feeder supervisor (PR 19, DESIGN §15): producer death surfaces
+        # as FeederProducerError at get_stage; the supervisor rebuilds
+        # the feeder with exponential backoff, carrying the dead ring's
+        # retired-slab high-water mark so never-re-offer spans restarts.
+        # A chaos injector (KTPU_HOST_CHAOS, or set directly by tests)
+        # rides into every feeder built so the kill channel draws inside
+        # the producer thread.
+        self._feeder_restarts = 0
+        self._feeder_restart_cap = 5
+        self._feeder_backoff_s = 0.005
+        self._feeder_chaos = None
+        if flag_str("KTPU_HOST_CHAOS") is not None:
+            from kubernetriks_tpu.batched.faults import HostChaos
+
+            self._feeder_chaos = HostChaos.from_flag(
+                flag_str("KTPU_HOST_CHAOS")
+            )
         # Lane-major hot node state (KTPU_LANE_MAJOR / lane_major arg): the
         # window programs carry state.NODE_HOT_LEAVES transposed (N, C) —
         # the Pallas kernels' layout — killing the per-kernel-boundary
@@ -2740,11 +2757,13 @@ class BatchedSimulation:
             self._stream and self._superspan and self.pod_window is not None
         )
 
-    def _ensure_feeder(self):
+    def _ensure_feeder(self, retired_lo: int = -1):
         """The live StreamFeeder, built lazily at the current base and
         geometry (stage width is a jit static, so the feeder is re-built —
         re-seeked — whenever geometry or base moves non-monotonically:
-        window growth, checkpoint restore)."""
+        window growth, checkpoint restore). `retired_lo` is the supervisor
+        restart path's carry-over: the dead ring's retired-slab
+        high-water mark, so never-re-offer spans restarts."""
         if self._feeder is None:
             from kubernetriks_tpu.batched.stream import StreamFeeder
 
@@ -2757,8 +2776,41 @@ class BatchedSimulation:
                 window=W,
                 trace_cols=int(self.consts.trace_pod_bound) + W,
                 depth=self._stream_depth,
+                retired_lo=retired_lo,
+                chaos=self._feeder_chaos,
             )
         return self._feeder
+
+    def _restart_feeder(self, feeder, err):
+        """Supervisor restart after a producer death (FeederProducerError
+        from get_stage): close the dead feeder, back off exponentially,
+        rebuild at the current base carrying the retired-slab high-water
+        mark (never-re-offer survives the restart — slab content is a
+        pure function of (lo, width), so the rebuilt ring cannot
+        diverge). Past the restart cap the error propagates — a
+        persistently dying producer is a real bug, not weather — and the
+        lane-async fleet above converts it to per-lane FeederErrors."""
+        import logging
+        import time as _time
+
+        self._feeder_restarts += 1
+        if self._feeder_restarts > self._feeder_restart_cap:
+            raise err
+        retired = feeder.retired_watermark()
+        self._feeder_produced_total += feeder.produced
+        feeder.close(timeout=1.0)
+        self._feeder = None
+        delay = self._feeder_backoff_s * (2 ** (self._feeder_restarts - 1))
+        logging.getLogger(__name__).warning(
+            "stream feeder producer died (%s); supervisor restart "
+            "%d/%d after %.0f ms backoff",
+            err,
+            self._feeder_restarts,
+            self._feeder_restart_cap,
+            delay * 1e3,
+        )
+        _time.sleep(delay)
+        return self._ensure_feeder(retired_lo=retired)
 
     def _close_feeder(self) -> None:
         """Stop + drop the feeder (re-seek half 1): the next staged
@@ -2790,10 +2842,17 @@ class BatchedSimulation:
         successor when it covers the current base, else rebuild at the
         base."""
         if self._stream_on():
+            from kubernetriks_tpu.batched.faults import FeederProducerError
+
             feeder = self._ensure_feeder()
-            stage, lo, fresh = feeder.get_stage(
-                self._pod_base, tracer=self.tracer
-            )
+            while True:
+                try:
+                    stage, lo, fresh = feeder.get_stage(
+                        self._pod_base, tracer=self.tracer
+                    )
+                    break
+                except FeederProducerError as err:
+                    feeder = self._restart_feeder(feeder, err)
             if fresh:
                 self.dispatch_stats["stage_refills"] += 1
             self.dispatch_stats["feeder_slabs_produced"] = (
@@ -3855,6 +3914,7 @@ class BatchedSimulation:
         feeder_rep = None
         if self._feeder is not None:
             feeder_rep = self._feeder.report()
+            feeder_rep["restarts"] = self._feeder_restarts
             self.dispatch_stats["feeder_slabs_produced"] = (
                 self._feeder_produced_total + feeder_rep["slabs_produced"]
             )
@@ -4008,6 +4068,7 @@ class BatchedSimulation:
             # from the same report keeps the cumulative counter a superset
             # of the section even while the producer is mid-publish.
             feeder_rep = self._feeder.report()
+            feeder_rep["restarts"] = self._feeder_restarts
             self.dispatch_stats["feeder_slabs_produced"] = (
                 self._feeder_produced_total + feeder_rep["slabs_produced"]
             )

@@ -1,0 +1,314 @@
+# ktpu: threaded
+"""Fault domain for the serving fleet: typed query outcomes + host chaos.
+
+The lane-async fleet (fleet.py) turns the batched engine into a serving
+host, and a serving host needs failure SEMANTICS, not just failure
+propagation: the unit of failure must be a query or a lane, never the
+fleet. This module owns the two halves of that contract:
+
+- **The `QueryError` taxonomy** — terminal typed outcomes delivered
+  *through* `ScenarioFleet.poll()` exactly like `FleetResult`s (the
+  stream-once contract is preserved: every submitted qid streams exactly
+  one terminal outcome, result or error). Clients discriminate with the
+  shared `.ok` / `.kind` protocol — `FleetResult.ok is True`, every
+  error's `.ok is False` — so a poll loop never needs isinstance
+  ladders. Errors are real `Exception` subclasses: the same class is
+  *raised* where no query exists to carry it (e.g. `submit()` after
+  `close()` raises `ShutdownError`) and *streamed* where one does.
+
+- **`HostChaos`** — a deterministic host-fault injector built on the
+  same counter-based threefry derivation as the in-simulation chaos
+  engine (`chaos.object_uniforms`): every decision is a pure function of
+  (seed, stream, counter), so a pinned seed replays the exact same fault
+  schedule on every run and platform. It claims host-side stream ids
+  disjoint from the device chaos streams (1-3). Dispatch-fault victims
+  are the LEAST-FAULTED active lane (ties to the lowest index), so a
+  run long enough to hit N faults provably faults min(N, n_lanes)
+  distinct lanes even while the active set churns — lane coverage by
+  construction, not by luck.
+
+Thread story (`# ktpu: threaded`): `HostChaos` is called from the fleet
+pump loop AND from the stream-feeder producer thread (feeder kills), so
+all mutable state (`_counters`, `_victim_counts`, `events`) lives under
+`self._lock`; the feederlock lint pass patrols exactly that. The
+derivation call itself happens outside the lock — nothing blocking is
+ever held under it.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Sequence
+
+from .. import chaos as _chaos
+
+# Host-side chaos streams — disjoint from the device chaos streams
+# (STREAM_NODE=1, STREAM_GROUP=2, STREAM_POD=3 in chaos.py).
+STREAM_HOST_DISPATCH = 11
+STREAM_HOST_FEEDER = 12
+STREAM_HOST_STALL = 13
+
+
+# --- typed query outcomes ----------------------------------------------------
+
+
+class QueryError(Exception):
+    """Terminal typed outcome for one query, streamed via `poll()`.
+
+    Mirrors the `FleetResult` readout protocol: `.query`, `.lane`,
+    `.horizon`, `.scenario` where known, plus `.ok is False` and a
+    stable `.kind` string for JSON-friendly counting.
+    """
+
+    kind = "query_error"
+    ok = False
+
+    def __init__(
+        self,
+        query: int,
+        message: str,
+        *,
+        lane: int = -1,
+        scenario=None,
+        horizon=None,
+    ) -> None:
+        super().__init__(message)
+        self.query = int(query)
+        self.message = message
+        self.lane = int(lane)
+        self.scenario = scenario
+        self.horizon = horizon
+
+
+class RejectedError(QueryError):
+    """Refused at admission (bounded queue full, policy='reject').
+
+    Carries a `retry_after_s` hint derived from the observed service
+    rate, so an open-loop client can back off intelligently.
+    """
+
+    kind = "rejected"
+
+    def __init__(self, query, message, *, retry_after_s=None, **kw) -> None:
+        super().__init__(query, message, **kw)
+        self.retry_after_s = retry_after_s
+
+
+class DeadlineExceededError(QueryError):
+    """Deadline passed while queued — failed WITHOUT occupying a lane."""
+
+    kind = "deadline_exceeded"
+
+    def __init__(self, query, message, *, deadline_s=None, late_s=None, **kw):
+        super().__init__(query, message, **kw)
+        self.deadline_s = deadline_s
+        self.late_s = late_s
+
+
+class LaneFaultError(QueryError):
+    """The occupying lane's dispatch failed; the lane was crash-reset
+    from the pristine snapshot and only THIS query died."""
+
+    kind = "lane_fault"
+
+    def __init__(self, query, message, *, cause=None, **kw) -> None:
+        super().__init__(query, message, **kw)
+        # repr, not the exception object: errors outlive the engine and
+        # must stay picklable / JSON-summarizable.
+        self.cause = cause if isinstance(cause, str) else repr(cause)
+
+
+class FeederError(QueryError):
+    """The stream-feeder producer died under this query's lanes; carries
+    the originating slab context from `FeederProducerError`."""
+
+    kind = "feeder"
+
+    def __init__(self, query, message, *, slab_lo=None, restarts=None, **kw):
+        super().__init__(query, message, **kw)
+        self.slab_lo = slab_lo
+        self.restarts = restarts
+
+
+class ShutdownError(QueryError):
+    """Queued at `close()` — the graceful drain finishes in-flight work
+    but fails what never reached a lane. Also RAISED by `submit()` after
+    close (no qid exists to stream it under)."""
+
+    kind = "shutdown"
+
+
+# --- low-level fault carriers (not query outcomes) ---------------------------
+
+
+class FeederProducerError(RuntimeError):
+    """Stream-feeder producer death with slab context preserved across
+    the thread boundary: the slab index (`slab_lo`) and payload span
+    (`[slab_lo, slab_lo + width)`) the producer was building when it
+    died. `stream.StreamFeeder.get_stage` raises this; the engine's
+    feeder supervisor catches it and decides restart vs `FeederError`."""
+
+    def __init__(self, message, *, slab_lo=None, width=None) -> None:
+        super().__init__(message)
+        self.slab_lo = slab_lo
+        self.width = width
+
+
+class InjectedFault(RuntimeError):
+    """Raised by `HostChaos` at a dispatch boundary in place of the real
+    dispatch; `.lane` names the victim so isolation stays per-lane."""
+
+    def __init__(self, message, *, lane=None) -> None:
+        super().__init__(message)
+        self.lane = lane
+
+
+class InjectedFeederKill(RuntimeError):
+    """Raised inside the stream-feeder producer thread by `HostChaos`."""
+
+
+# --- deterministic host-fault injector ---------------------------------------
+
+_CHAOS_DEFAULTS = dict(
+    seed=7, dispatch=0.04, feeder=0.05, stall=0.03, stall_ms=2.0
+)
+
+
+class HostChaos:
+    """Counter-seeded host-fault injector (threefry, like chaos.py).
+
+    Each channel draws from its own (stream, counter) sequence, so the
+    fault schedule is a pure function of the seed and the deterministic
+    call sequence — independent of wall clock, thread timing (each draw
+    atomically claims its counter under the lock) and platform.
+    """
+
+    def __init__(
+        self,
+        seed: int = 7,
+        *,
+        dispatch_rate: float = 0.0,
+        feeder_rate: float = 0.0,
+        stall_rate: float = 0.0,
+        stall_ms: float = 2.0,
+    ) -> None:
+        self.seed = int(seed)
+        self.dispatch_rate = float(dispatch_rate)
+        self.feeder_rate = float(feeder_rate)
+        self.stall_rate = float(stall_rate)
+        self.stall_ms = float(stall_ms)
+        self._lock = threading.Lock()
+        self._counters: Dict[int, int] = {}
+        self._victim_counts: Dict[int, int] = {}
+        self.events: Dict[str, int] = {
+            "draws": 0,
+            "dispatch_faults": 0,
+            "feeder_kills": 0,
+            "stalls": 0,
+        }
+
+    # -- flag parsing --------------------------------------------------------
+
+    @classmethod
+    def from_flag(cls, spec: Optional[str]) -> Optional["HostChaos"]:
+        """Build from a `KTPU_HOST_CHAOS` value. None/falsy -> None
+        (injection OFF — the fleet takes the exact pre-chaos code path).
+        '1'/'true'/'on' -> documented defaults; otherwise a 'k=v,k=v'
+        spec with keys seed, dispatch, feeder, stall, stall_ms."""
+        if spec is None:
+            return None
+        text = str(spec).strip()
+        if text.lower() in ("", "0", "false", "no", "off"):
+            return None
+        params = dict(_CHAOS_DEFAULTS)
+        if text.lower() not in ("1", "true", "yes", "on"):
+            for item in text.split(","):
+                item = item.strip()
+                if not item:
+                    continue
+                if "=" not in item:
+                    raise ValueError(
+                        f"KTPU_HOST_CHAOS: bad item {item!r} (expected "
+                        "'key=value' with keys "
+                        f"{sorted(_CHAOS_DEFAULTS)}, or '1' for defaults)"
+                    )
+                key, _, value = item.partition("=")
+                key = key.strip()
+                if key not in _CHAOS_DEFAULTS:
+                    raise ValueError(
+                        f"KTPU_HOST_CHAOS: unknown key {key!r} (expected "
+                        f"one of {sorted(_CHAOS_DEFAULTS)})"
+                    )
+                params[key] = float(value)
+        return cls(
+            seed=int(params["seed"]),
+            dispatch_rate=params["dispatch"],
+            feeder_rate=params["feeder"],
+            stall_rate=params["stall"],
+            stall_ms=params["stall_ms"],
+        )
+
+    # -- channels ------------------------------------------------------------
+
+    def _draw(self, stream: int) -> float:
+        with self._lock:
+            counter = self._counters.get(stream, 0)
+            self._counters[stream] = counter + 1
+            self.events["draws"] += 1
+        u, _ = _chaos.object_uniforms(self.seed, stream, 0, 0, counter)
+        return float(u)
+
+    def dispatch_fault(self, active_lanes: Sequence[int]) -> Optional[int]:
+        """One draw per dispatch attempt; on a hit, the victim is the
+        LEAST-faulted active lane (ties break to the lowest index) — a
+        plain round-robin over the momentary active list would re-fault
+        the same lanes whenever the set shrinks mid-run. Returns the
+        victim lane or None."""
+        lanes = sorted(int(v) for v in active_lanes)
+        if not lanes or self.dispatch_rate <= 0.0:
+            return None
+        if self._draw(STREAM_HOST_DISPATCH) >= self.dispatch_rate:
+            return None
+        with self._lock:
+            victim = min(
+                lanes, key=lambda v: (self._victim_counts.get(v, 0), v)
+            )
+            self._victim_counts[victim] = (
+                self._victim_counts.get(victim, 0) + 1
+            )
+            self.events["dispatch_faults"] += 1
+        return victim
+
+    def feeder_kill(self) -> bool:
+        """One draw per produced slab (called from the producer thread)."""
+        if self.feeder_rate <= 0.0:
+            return False
+        hit = self._draw(STREAM_HOST_FEEDER) < self.feeder_rate
+        if hit:
+            with self._lock:
+                self.events["feeder_kills"] += 1
+        return hit
+
+    def stall_s(self) -> float:
+        """Slow-lane stall: seconds to sleep before this dispatch (0.0
+        almost always). Exercises the latency/SLO paths, not failures."""
+        if self.stall_rate <= 0.0:
+            return 0.0
+        if self._draw(STREAM_HOST_STALL) >= self.stall_rate:
+            return 0.0
+        with self._lock:
+            self.events["stalls"] += 1
+        return self.stall_ms / 1e3
+
+    def report(self) -> Dict:
+        with self._lock:
+            events = dict(self.events)
+        return {
+            "seed": self.seed,
+            "rates": {
+                "dispatch": self.dispatch_rate,
+                "feeder": self.feeder_rate,
+                "stall": self.stall_rate,
+            },
+            "events": events,
+        }

@@ -63,15 +63,36 @@ submit→drain Chrome flow plus a per-lane swimlane event, and the latency
 statistics live in bounded log-bucketed streaming histograms
 (telemetry/histogram.py: O(buckets) forever, never O(queries)) with the
 queue-wait (submit→admit) vs service (admit→drain) split.
+
+Fault domains (PR 19, DESIGN §15): the unit of failure is a QUERY or a
+LANE, never the fleet. Terminal failures are TYPED results
+(batched/faults.py QueryError taxonomy) streamed through `poll()` under
+the same stream-once contract as `FleetResult`s — every submitted qid
+streams exactly one terminal outcome, so a client never hangs on a dead
+query. A failing dispatch fails only the occupying lane's query
+(`LaneFaultError`), the lane is crash-reset from the pristine snapshot
+(the PR 13 donated-select machinery reused as recovery — pure data ops,
+zero recompiles), and a lane faulting repeatedly inside a window is
+QUARANTINED out of the admission rotation with exponential-backoff probe
+re-admission (observatory `lane_state` gauge + `lane_quarantine`
+verdict). `submit()` gains a bounded queue with reject/block
+backpressure and per-query deadlines enforced at host boundaries the
+pump already crosses; `close()` is a graceful drain (stop admitting,
+finish in-flight, fail queued with `ShutdownError`). With
+`KTPU_HOST_CHAOS` unset and no injector armed, every new path is gated
+on `self._chaos is None` / empty fault ledgers — the layer is provably
+free when quiet (per-query A/B bit-identity + dispatch_stats equality,
+pinned in tests/test_fleet_async.py and bench.py --host-chaos).
 """
 
 from __future__ import annotations
 
 import time
 from collections import deque
+from collections.abc import Mapping
 from dataclasses import dataclass, fields
 from functools import partial
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Union
 
 import numpy as np
 
@@ -80,8 +101,19 @@ from kubernetriks_tpu.config import (
     KubeHorizontalPodAutoscalerConfig,
     SimulationConfig,
 )
+from kubernetriks_tpu.batched.faults import (
+    DeadlineExceededError,
+    HostChaos,
+    InjectedFault,
+    LaneFaultError,
+    QueryError,
+    RejectedError,
+    ShutdownError,
+)
 from kubernetriks_tpu.telemetry.histogram import LatencyHistogram
 from kubernetriks_tpu.telemetry.tracer import (
+    PH_LANE_QUARANTINE,
+    PH_QUERY_FAIL,
     PH_QUERY_QUEUE,
     PH_QUERY_SERVICE,
 )
@@ -301,7 +333,13 @@ def scenario_leaves(
 
 @dataclass
 class FleetResult:
-    """One drained what-if query."""
+    """One drained what-if query. Shares the `.ok` / `.kind`
+    discrimination protocol with the `QueryError` taxonomy
+    (batched/faults.py): a poll loop filters terminal outcomes with
+    `outcome.ok` instead of isinstance ladders."""
+
+    ok = True
+    kind = "result"
 
     query: int
     wave: int
@@ -413,10 +451,16 @@ class ScenarioFleet:
         build_scenarios: Optional[Sequence[Optional[Scenario]]] = None,
         lane_async: bool = False,
         span_windows: Optional[int] = None,
+        max_queue: Optional[int] = None,
+        queue_policy: Optional[str] = None,
+        quarantine_faults: int = 3,
+        quarantine_window: int = 64,
+        quarantine_backoff: int = 8,
+        host_chaos: Optional[HostChaos] = None,
         **engine_kwargs,
     ) -> None:
         from kubernetriks_tpu.batched.engine import build_batched_from_traces
-        from kubernetriks_tpu.flags import flag_int
+        from kubernetriks_tpu.flags import flag_int, flag_str
 
         if n_lanes < 1:
             raise ValueError("a fleet needs at least one lane")
@@ -450,7 +494,9 @@ class ScenarioFleet:
         )
         self._queue: deque = deque()
         self._next_query = 0
-        self.results: Dict[int, FleetResult] = {}
+        # Terminal outcome per qid: FleetResult (ok=True) or a typed
+        # QueryError (ok=False) — both stream through poll() once.
+        self.results: Dict[int, Union[FleetResult, QueryError]] = {}
         self.waves_run = 0
         # Wave 0 runs on the build-fresh engine; later waves reset first.
         self._dirty = False
@@ -506,20 +552,164 @@ class ScenarioFleet:
         self._warm_spans: set = set()
         self.lane_busy_windows = np.zeros((self.n_lanes,), np.int64)
         self.lane_total_windows = np.zeros((self.n_lanes,), np.int64)
+        # Fault-domain state (PR 19, DESIGN §15). Bounded admission:
+        # queue depth + backpressure policy, flag defaults
+        # (KTPU_FLEET_QUEUE / KTPU_FLEET_QUEUE_POLICY), unset = the
+        # pre-fault-domain unbounded queue.
+        if max_queue is None:
+            max_queue = flag_int("KTPU_FLEET_QUEUE")
+        self.max_queue = int(max_queue) if max_queue is not None else None
+        if self.max_queue is not None and self.max_queue < 1:
+            raise ValueError(
+                "max_queue must be >= 1 (or None for unbounded), "
+                f"got {self.max_queue}"
+            )
+        policy = queue_policy or flag_str("KTPU_FLEET_QUEUE_POLICY") or "reject"
+        if policy not in ("reject", "block"):
+            raise ValueError(
+                f"queue_policy must be 'reject' or 'block', got {policy!r}"
+            )
+        self.queue_policy = policy
+        # Host-chaos injector: explicit arg wins, else the registered
+        # flag. None = injection OFF — every chaos branch below is gated
+        # on it, so an unset flag takes the exact pre-chaos code path.
+        if host_chaos is None:
+            host_chaos = HostChaos.from_flag(flag_str("KTPU_HOST_CHAOS"))
+        self._chaos = host_chaos
+        # Quarantine policy: a lane faulting `quarantine_faults` times
+        # within `quarantine_window` pump rounds leaves the admission
+        # rotation for `quarantine_backoff` rounds, then re-admits ONE
+        # probe query; a faulting probe doubles the backoff, a completing
+        # probe restores the lane and clears its fault history.
+        self.quarantine_faults = max(1, int(quarantine_faults))
+        self.quarantine_window = max(1, int(quarantine_window))
+        self.quarantine_backoff = max(1, int(quarantine_backoff))
+        self._lane_fault_rounds: Dict[int, deque] = {}
+        self._quarantine: Dict[int, Dict] = {}
+        self.quarantine_events = 0
+        self.readmissions = 0
+        self.failed_queries: Dict[str, int] = {}
+        # True once any queued entry ever carried a deadline — the pump's
+        # deadline sweep is skipped entirely (zero added host work) for
+        # deadline-free streams.
+        self._deadlines_ever = False
+        self._closing = False
+        self._closed = False
 
     # -- query intake --------------------------------------------------------
 
+    # Scenario fields that must be finite and non-negative (seconds /
+    # ratios); the remaining keys are bool/int control values.
+    _NONNEG_KEYS = (
+        "hpa_scan_interval",
+        "hpa_tolerance",
+        "ca_scan_interval",
+        "ca_threshold",
+        "as_to_ca_network_delay",
+    )
+
+    def _validate_scenario(self, scenario) -> Scenario:
+        """Loud pre-admission validation: unknown keys and wrong axis
+        shapes raise HERE (naming the field and the legal set) instead of
+        becoming in-flight poison at a lane-reseed boundary."""
+        if scenario is None:
+            return Scenario()
+        if isinstance(scenario, Scenario):
+            overrides = scenario.overrides()
+        elif isinstance(scenario, Mapping):
+            overrides = dict(scenario)
+            unknown = [k for k in overrides if k not in SCENARIO_KEYS]
+            if unknown:
+                raise ValueError(
+                    f"submit(): unknown scenario key(s) {sorted(unknown)} "
+                    f"— legal keys: {list(SCENARIO_KEYS)}"
+                )
+        else:
+            raise ValueError(
+                "submit(): scenario must be a Scenario or a mapping of "
+                f"scenario keys, got {type(scenario).__name__}"
+            )
+        for key, val in overrides.items():
+            arr = np.asarray(val)
+            if arr.ndim != 0:
+                raise ValueError(
+                    f"submit(): scenario[{key!r}] must be a per-query "
+                    f"SCALAR override (axis shape ()), got shape "
+                    f"{arr.shape} — per-lane (C,) vectors belong to "
+                    "build_scenarios / engine.update_scenario"
+                )
+            if key in self._NONNEG_KEYS:
+                v = float(arr)
+                if not np.isfinite(v) or v < 0:
+                    raise ValueError(
+                        f"submit(): scenario[{key!r}] must be a finite "
+                        f"value >= 0, got {val!r}"
+                    )
+        if isinstance(scenario, Scenario):
+            return scenario
+        return Scenario(**overrides)
+
+    @staticmethod
+    def _validate_positive(name: str, value, unit: str) -> float:
+        try:
+            out = float(value)
+        except (TypeError, ValueError):
+            out = float("nan")
+        if not np.isfinite(out) or out <= 0:
+            raise ValueError(
+                f"submit(): {name} must be a finite number > 0 "
+                f"({unit}), got {value!r}"
+            )
+        return out
+
+    def _retry_after_hint(self) -> Optional[float]:
+        """Backpressure hint for RejectedError: the observed median
+        service wall scaled by the queue depth ahead, None before any
+        query completed."""
+        if self.service_hist.count == 0:
+            return None
+        p50_s = self.service_hist.percentile(50.0)
+        waves_ahead = (len(self._queue) + 1) / max(1, self.n_lanes)
+        return round(p50_s * waves_ahead, 6)
+
     def submit(
         self,
-        scenario: Optional[Scenario] = None,
+        scenario: Optional[Union[Scenario, Mapping]] = None,
         horizon: Optional[float] = None,
         trace_rows: Optional[tuple] = None,
+        deadline_s: Optional[float] = None,
     ) -> int:
         """Queue one what-if query; returns its id (the key into
         `results` after `run()` / the pump's drains). trace_rows:
         optional (lo, hi) workload row-range for the query's lane
         (lane-async builds only — engine.set_lane_trace installs it at
-        the lane's reseed boundary)."""
+        the lane's reseed boundary). deadline_s: optional relative
+        deadline (host seconds from now); a query still QUEUED past its
+        deadline fails with DeadlineExceededError without ever occupying
+        a lane (checked at pump boundaries — an admitted query always
+        runs to its horizon).
+
+        Validation happens BEFORE admission (loud ValueError naming the
+        field); a full bounded queue applies the configured backpressure
+        (reject: the query's qid streams a RejectedError through poll();
+        block: pump inline until a slot frees). After close(), raises
+        ShutdownError."""
+        if self._closing:
+            raise ShutdownError(
+                -1,
+                "submit() after close(): the fleet is draining/closed "
+                "and admits no new queries",
+            )
+        scen = self._validate_scenario(scenario)
+        h = (
+            self._validate_positive("horizon", horizon, "simulated seconds")
+            if horizon is not None
+            else self.default_horizon
+        )
+        if deadline_s is not None:
+            deadline_s = self._validate_positive(
+                "deadline_s", deadline_s, "host seconds from submit"
+            )
         if trace_rows is not None:
             if not self.lane_async:
                 raise ValueError(
@@ -527,28 +717,125 @@ class ScenarioFleet:
                     "trace multiplexer)"
                 )
             lo, hi = trace_rows
-            self._trace_rows[self._next_query] = (int(lo), hi)
+            lo = int(lo)
+            hi = None if hi is None else int(hi)
+            if lo < 0 or (hi is not None and hi <= lo):
+                raise ValueError(
+                    "submit(): trace_rows must satisfy 0 <= lo < hi "
+                    f"(hi=None = end of trace), got {trace_rows!r}"
+                )
+            trace_rows = (lo, hi)
+        # Bounded admission: the queue depth check runs after validation
+        # (a malformed query is a caller bug, not backpressure).
+        if (
+            self.max_queue is not None
+            and len(self._queue) >= self.max_queue
+            and self.queue_policy == "block"
+        ):
+            # Inline pump/run until a slot frees — the fleet is
+            # single-threaded, so blocking IS making progress.
+            while len(self._queue) >= self.max_queue:
+                if self.lane_async:
+                    self.pump()
+                else:
+                    self.run()
         qid = self._next_query
         self._next_query += 1
+        t_submit = time.perf_counter_ns()
         # Lifecycle birth: host stamp + the submit->drain flow arrow's id
         # (NULL_TRACER returns 0 = no flow; all pure host, zero syncs).
         self._lifecycle[qid] = {
-            "submitted_ns": time.perf_counter_ns(),
+            "submitted_ns": t_submit,
             "flow_id": self.engine.tracer.flow_start(PH_QUERY_QUEUE),
             "lane": -1,
         }
-        self._queue.append(
-            (
+        if self.max_queue is not None and len(self._queue) >= self.max_queue:
+            # policy == "reject": the qid still streams exactly one
+            # terminal outcome (a RejectedError via poll), preserving the
+            # stream-once contract for refused work too.
+            self._fail_query(
                 qid,
-                scenario if scenario is not None else Scenario(),
-                float(horizon) if horizon is not None else self.default_horizon,
+                RejectedError(
+                    qid,
+                    f"query {qid} rejected at admission: queue full "
+                    f"({len(self._queue)}/{self.max_queue} queued; "
+                    "policy 'reject')",
+                    retry_after_s=self._retry_after_hint(),
+                    scenario=scen,
+                    horizon=h,
+                ),
             )
-        )
+            return qid
+        if trace_rows is not None:
+            self._trace_rows[qid] = trace_rows
+        deadline_ns = None
+        if deadline_s is not None:
+            deadline_ns = t_submit + int(deadline_s * 1e9)
+            self._deadlines_ever = True
+        self._queue.append((qid, scen, h, deadline_ns))
         return qid
 
     @property
     def pending(self) -> int:
         return len(self._queue)
+
+    # -- fault delivery ------------------------------------------------------
+
+    def _fail_query(self, qid: int, err: QueryError) -> None:
+        """Deliver one terminal TYPED failure through the completion
+        stream: same `results` + `_completed` path as a drained result,
+        so poll() streams it exactly once and every counter/lifecycle
+        readout stays coherent."""
+        rec = self._lifecycle.get(qid)
+        t_fail = time.perf_counter_ns()
+        if rec is not None:
+            rec["failed_ns"] = t_fail
+            if err.lane >= 0:
+                rec["lane"] = err.lane
+            tracer = self.engine.tracer
+            tracer.end(
+                PH_QUERY_FAIL,
+                rec["submitted_ns"],
+                dur=t_fail - rec["submitted_ns"],
+            )
+            if rec["flow_id"]:
+                tracer.flow_end(PH_QUERY_QUEUE, rec["flow_id"])
+        self._trace_rows.pop(qid, None)
+        self.results[qid] = err
+        self._completed.append(qid)
+        self.failed_queries[err.kind] = (
+            self.failed_queries.get(err.kind, 0) + 1
+        )
+
+    def _expire_deadlines(self) -> None:
+        """Fail queued-past-deadline queries WITHOUT occupying a lane —
+        runs at pump/wave boundaries the host already crosses (pure
+        queue arithmetic, zero new syncs), and only when a deadline was
+        ever submitted."""
+        if not self._deadlines_ever or not self._queue:
+            return
+        now = time.perf_counter_ns()
+        keep: deque = deque()
+        while self._queue:
+            entry = self._queue.popleft()
+            qid, scen, horizon, deadline_ns = entry
+            if deadline_ns is not None and now >= deadline_ns:
+                late_s = (now - deadline_ns) / 1e9
+                self._fail_query(
+                    qid,
+                    DeadlineExceededError(
+                        qid,
+                        f"query {qid} deadline exceeded while queued "
+                        f"({late_s:.3f}s late) — failed without "
+                        "occupying a lane",
+                        late_s=round(late_s, 6),
+                        scenario=scen,
+                        horizon=horizon,
+                    ),
+                )
+            else:
+                keep.append(entry)
+        self._queue = keep
 
     # -- wave machinery ------------------------------------------------------
 
@@ -624,7 +911,7 @@ class ScenarioFleet:
         vectors = scenario_vectors(
             self.config,
             self.n_lanes,
-            [scen for _, scen, _ in wave],
+            [scen for _, scen, _, _ in wave],
             base_vectors=self._vectors,
         )
         eng.update_scenario(vectors)
@@ -635,7 +922,7 @@ class ScenarioFleet:
         # whole wave shares one admission stamp (queue-wait on this path
         # is wave-packing delay, not lane contention).
         t_admit = time.perf_counter_ns()
-        for lane, (qid, _, _) in enumerate(wave):
+        for lane, (qid, _, _, _) in enumerate(wave):
             rec = self._lifecycle.get(qid)
             if rec is not None:
                 rec["admitted_ns"] = t_admit
@@ -643,7 +930,7 @@ class ScenarioFleet:
         # Step to each distinct horizon once; lanes finishing there are
         # read back while the host is already blocked at the step exit.
         by_horizon: Dict[float, list] = {}
-        for lane, (qid, scen, horizon) in enumerate(wave):
+        for lane, (qid, scen, horizon, _) in enumerate(wave):
             by_horizon.setdefault(horizon, []).append((qid, lane, scen))
         tracer = eng.tracer
         for horizon in sorted(by_horizon):
@@ -668,12 +955,14 @@ class ScenarioFleet:
         """Drain the queue: pack pending queries into C-lane waves and run
         each on the resident engine. Returns {query id: FleetResult} for
         everything drained (also accumulated in `self.results`)."""
+        self._expire_deadlines()
         while self._queue:
             wave = [
                 self._queue.popleft()
                 for _ in range(min(self.n_lanes, len(self._queue)))
             ]
             self._run_wave(wave)
+            self._expire_deadlines()
         return self.results
 
     # -- lane-async pump (continuous submit/poll, DESIGN §13) ----------------
@@ -711,14 +1000,30 @@ class ScenarioFleet:
 
     def _pump_inner(self, span: int) -> int:
         eng = self.engine
+        # 0. Host-boundary deadline sweep: queued-past-deadline queries
+        # fail here, before they can occupy a lane. No-op (one attribute
+        # read) unless a deadline was ever submitted.
+        self._expire_deadlines()
         # 1. Seed idle lanes: rewrite ONLY their _live_vectors rows (base
         # row + this query's overrides), reset their state in place, and
         # start their clocks at the engine's current global window.
+        # Quarantined lanes sit out the rotation until their backoff
+        # expires, then take ONE probe query; a closing fleet admits
+        # nothing (graceful drain).
         assigned = []
         for lane in range(self.n_lanes):
-            if lane in self._active or not self._queue:
+            if lane in self._active or not self._queue or self._closing:
                 continue
-            assigned.append((lane, *self._queue.popleft()))
+            q = self._quarantine.get(lane)
+            if q is not None:
+                if q["probing"] or self.pump_rounds < q["until_round"]:
+                    continue
+                q["probing"] = True
+                self._push_lane_states()
+            # Admission drops the deadline: an admitted query always
+            # runs to its horizon (deadlines bound QUEUE time only —
+            # enforcing them mid-flight would need new device syncs).
+            assigned.append((lane, *self._queue.popleft()[:3]))
         if assigned:
             for lane, qid, scen, horizon in assigned:
                 for key in SCENARIO_KEYS:
@@ -779,23 +1084,39 @@ class ScenarioFleet:
         remaining0 = eng.lane_windows_remaining()
         queue_fed = bool(self._queue)
         stepped = 0
-        if len(self._active) == self.n_lanes:
-            left = span
-            remaining = remaining0.copy()
-            while left > 0:
-                m = int(min(left, remaining.min()))
-                sub = 1 << (m.bit_length() - 1)
-                eng.step_windows(sub)
-                stepped += sub
-                left -= sub
-                remaining = remaining - sub
-                if (remaining <= 0).any():
-                    # A plan completed exactly at the chunk edge: stop the
-                    # round so the drain/reseed below runs promptly.
-                    break
-        else:
-            eng.step_windows(span)
-            stepped = span
+        try:
+            if len(self._active) == self.n_lanes:
+                left = span
+                remaining = remaining0.copy()
+                while left > 0:
+                    m = int(min(left, remaining.min()))
+                    sub = 1 << (m.bit_length() - 1)
+                    self._dispatch(sub)
+                    stepped += sub
+                    left -= sub
+                    remaining = remaining - sub
+                    if (remaining <= 0).any():
+                        # A plan completed exactly at the chunk edge:
+                        # stop the round so the drain/reseed below runs
+                        # promptly.
+                        break
+            else:
+                self._dispatch(span)
+                stepped = span
+        except Exception as exc:
+            # FAULT DOMAIN: a failing dispatch kills the occupying
+            # lane's query (or, unattributable, every active query) —
+            # never the fleet. The lane is crash-reset below; neighbors
+            # keep their trajectories (lanes are independent pure
+            # functions of scenario + horizon). Recompile-sentinel and
+            # strict-divergence errors are NOT lane faults and must stay
+            # loud — they indicate a fleet-level contract break.
+            from kubernetriks_tpu.recompile import RecompileError
+
+            if isinstance(exc, RecompileError):
+                raise
+            self._on_dispatch_fault(exc)
+            return 0
         # 3. Occupancy ledger (host ints): a lane is busy for
         # min(stepped, windows left on its plan). Idle lanes count as
         # wasted dispatch only while queries were WAITING (queue fed) —
@@ -823,6 +1144,22 @@ class ScenarioFleet:
             self._drain_lane(
                 qid, lane, horizon, scen, rows, wave=self.pump_rounds
             )
+            q = self._quarantine.get(lane)
+            if q is not None and q["probing"]:
+                # Probe query COMPLETED: full re-admission — clear the
+                # quarantine and the lane's fault history, close the
+                # quarantine span (fire -> re-admission).
+                del self._quarantine[lane]
+                self._lane_fault_rounds.pop(lane, None)
+                self.readmissions += 1
+                tracer.end(
+                    PH_LANE_QUARANTINE,
+                    q["since_ns"],
+                    dur=t_drain - q["since_ns"],
+                )
+                if obs is not None:
+                    obs.note_lane_readmitted(lane, probes=q["probes"] + 1)
+                self._push_lane_states()
             # Lifecycle: horizon-drained — close the service span
             # (admit -> here), land the flow arrow, and draw the lane
             # swimlane interval; then fold the total / queue-wait /
@@ -853,6 +1190,158 @@ class ScenarioFleet:
                 obs.note_query(lat, queue_wait, service)
         return len(finished)
 
+    # -- fault isolation + quarantine (lane-async) ---------------------------
+
+    def _dispatch(self, n_windows: int) -> None:
+        """One engine dispatch, with the host-chaos injection point: a
+        stall sleeps before the dispatch (slow-lane latency, no failure),
+        a dispatch fault raises InjectedFault in PLACE of the dispatch
+        (the engine state is untouched — exactly like an XLA error
+        surfacing before results land). Chaos off = straight call."""
+        chaos = self._chaos
+        if chaos is not None:
+            stall = chaos.stall_s()
+            if stall > 0.0:
+                time.sleep(stall)
+            victim = chaos.dispatch_fault(self._active)
+            if victim is not None:
+                raise InjectedFault(
+                    f"host-chaos: injected dispatch fault on lane "
+                    f"{victim} (seed {chaos.seed})",
+                    lane=victim,
+                )
+        self.engine.step_windows(n_windows)
+
+    def _on_dispatch_fault(self, exc: Exception) -> None:
+        """Poison isolation: fail the victim lane's query (typed, via
+        the completion stream), crash-reset the lane from the pristine
+        snapshot, and zero its plan so the clock mirrors stay coherent.
+        An exception that names no lane (no `.lane` attribute) is
+        unattributable and fails every active query — still never the
+        fleet."""
+        eng = self.engine
+        victim = getattr(exc, "lane", None)
+        if victim is not None and victim in self._active:
+            lanes = [int(victim)]
+        else:
+            lanes = sorted(self._active)
+        for lane in lanes:
+            qid, scen, horizon = self._active.pop(lane)
+            self._fail_query(
+                qid,
+                LaneFaultError(
+                    qid,
+                    f"query {qid}: lane {lane} dispatch failed "
+                    f"({type(exc).__name__}: {exc}) — lane crash-reset, "
+                    "neighbors unaffected",
+                    lane=lane,
+                    cause=exc,
+                    scenario=scen,
+                    horizon=horizon,
+                ),
+            )
+            self._note_lane_fault(lane)
+        # Crash recovery = the donated-select lane reset (pure data ops,
+        # no structure swap, no recompile) + a zero-window plan so the
+        # lane reads as "done" to the host mirrors until re-seeded.
+        eng.lane_reset(lanes)
+        eng.set_lane_plan(lanes, eng.next_window_idx, [0] * len(lanes))
+
+    def _note_lane_fault(self, lane: int) -> None:
+        """Quarantine bookkeeping for one lane fault. A faulting PROBE
+        doubles the backoff; `quarantine_faults` faults within
+        `quarantine_window` pump rounds fire a fresh quarantine."""
+        obs = getattr(self.engine, "observatory", None)
+        q = self._quarantine.get(lane)
+        if q is not None:
+            q["backoff"] = min(q["backoff"] * 2, 1 << 16)
+            q["until_round"] = self.pump_rounds + q["backoff"]
+            q["probing"] = False
+            q["probes"] += 1
+            if obs is not None:
+                obs.note_lane_quarantined(
+                    lane, backoff_rounds=q["backoff"], probed=True
+                )
+            self._push_lane_states()
+            return
+        rounds = self._lane_fault_rounds.setdefault(
+            lane, deque(maxlen=self.quarantine_faults)
+        )
+        rounds.append(self.pump_rounds)
+        if (
+            len(rounds) >= self.quarantine_faults
+            and self.pump_rounds - rounds[0] <= self.quarantine_window
+        ):
+            self._quarantine[lane] = {
+                "backoff": self.quarantine_backoff,
+                "until_round": self.pump_rounds + self.quarantine_backoff,
+                "probing": False,
+                "probes": 0,
+                "since_ns": time.perf_counter_ns(),
+            }
+            rounds.clear()
+            self.quarantine_events += 1
+            if obs is not None:
+                obs.note_lane_quarantined(
+                    lane,
+                    backoff_rounds=self.quarantine_backoff,
+                    probed=False,
+                )
+            self._push_lane_states()
+
+    def lane_states(self) -> List[str]:
+        """Per-lane admission state: 'active' (query in flight), 'idle'
+        (admissible), 'quarantined' (out of rotation, backoff pending),
+        'probe' (backoff expired — next admission is a probe, or the
+        probe is in flight)."""
+        out = []
+        for lane in range(self.n_lanes):
+            q = self._quarantine.get(lane)
+            if q is not None:
+                if q["probing"] or self.pump_rounds >= q["until_round"]:
+                    out.append("probe")
+                else:
+                    out.append("quarantined")
+            elif lane in self._active:
+                out.append("active")
+            else:
+                out.append("idle")
+        return out
+
+    def _push_lane_states(self) -> None:
+        obs = getattr(self.engine, "observatory", None)
+        if obs is not None:
+            obs.note_lane_states(self.lane_states())
+
+    def arm_host_chaos(self, chaos: Optional[HostChaos]) -> None:
+        """Attach (or detach, with None) the host-fault injector —
+        bench.py arms chaos AFTER warm-up so the zero-post-warm-up
+        recompile assert runs under injection."""
+        self._chaos = chaos
+
+    def fault_report(self) -> Dict:
+        """Availability + fault-domain counters (the bench's host-chaos
+        record): completed/failed split by kind, quarantine activity,
+        current lane states, injector event counts."""
+        completed_ok = sum(
+            1 for r in self.results.values() if getattr(r, "ok", True)
+        )
+        submitted = self._next_query
+        return {
+            "submitted": submitted,
+            "completed": completed_ok,
+            "failed": dict(self.failed_queries),
+            "availability": (
+                completed_ok / submitted if submitted else 1.0
+            ),
+            "quarantine_events": self.quarantine_events,
+            "readmissions": self.readmissions,
+            "lane_states": self.lane_states(),
+            "chaos": (
+                self._chaos.report() if self._chaos is not None else None
+            ),
+        }
+
     def _qid_inventory(self) -> str:
         """The known-qid inventory for loud lookup errors: what this
         fleet has seen, where everything currently is."""
@@ -873,16 +1362,23 @@ class ScenarioFleet:
             rec["polled_ns"] = t_poll_ns
             self._polled_lifecycles.append((qid, rec))
 
-    def poll(self, qid: Optional[int] = None) -> List[FleetResult]:
-        """Results completed since the last poll, in completion order —
-        the read side of the continuous submit/pump/poll engine.
+    def poll(
+        self, qid: Optional[int] = None
+    ) -> List[Union[FleetResult, QueryError]]:
+        """Terminal outcomes delivered since the last poll, in
+        completion order — the read side of the continuous
+        submit/pump/poll engine. Outcomes are FleetResults (ok=True) OR
+        typed QueryErrors (ok=False: rejected / deadline_exceeded /
+        lane_fault / feeder / shutdown) under ONE stream-once contract:
+        every submitted qid streams exactly one terminal outcome, so a
+        client never hangs on a dead query.
 
-        ``poll(qid)`` narrows to one query: its result (as a one-element
-        list) exactly once after it completes, ``[]`` while it is still
-        queued/in-flight (or after its result was already streamed), and
-        a loud ``KeyError`` carrying the known-qid inventory when the
-        qid was never submitted here — silence is reserved for
-        not-ready, never for a caller bug."""
+        ``poll(qid)`` narrows to one query: its outcome (as a
+        one-element list) exactly once after it lands, ``[]`` while it
+        is still queued/in-flight (or after its outcome was already
+        streamed), and a loud ``KeyError`` carrying the known-qid
+        inventory when the qid was never submitted here — silence is
+        reserved for not-ready, never for a caller bug."""
         t_poll = time.perf_counter_ns()
         if qid is None:
             out = [self.results[q] for q in self._completed]
@@ -1008,7 +1504,50 @@ class ScenarioFleet:
         self.run()
         return [self.results[q] for q in qids]
 
-    def close(self) -> None:
+    def close(self, drain: bool = True) -> None:
+        """Graceful shutdown: stop admitting (submit() now raises
+        ShutdownError), finish in-flight queries (drain=True pumps the
+        lane-async fleet until every active lane completes), then fail
+        everything still queued with a typed ShutdownError through the
+        completion stream — every submitted qid still streams exactly
+        one terminal outcome, and poll() keeps working after close (the
+        results are host state). drain=False fails in-flight queries
+        too, without stepping the engine further."""
+        if self._closed:
+            return
+        self._closing = True
+        if self.lane_async and self._active:
+            if drain:
+                while self._active:
+                    self.pump()
+            else:
+                for lane in sorted(self._active):
+                    qid, scen, horizon = self._active.pop(lane)
+                    self._fail_query(
+                        qid,
+                        ShutdownError(
+                            qid,
+                            f"query {qid} was in flight at "
+                            "close(drain=False)",
+                            lane=lane,
+                            scenario=scen,
+                            horizon=horizon,
+                        ),
+                    )
+        while self._queue:
+            qid, scen, horizon, _deadline = self._queue.popleft()
+            self._fail_query(
+                qid,
+                ShutdownError(
+                    qid,
+                    f"query {qid} was still queued at close() — the "
+                    "graceful drain finishes in-flight queries and "
+                    "fails queued ones",
+                    scenario=scen,
+                    horizon=horizon,
+                ),
+            )
+        self._closed = True
         if self._sentinel is not None:
             self._sentinel.uninstall()
             self._sentinel = None

@@ -80,6 +80,10 @@ import time
 from collections import deque
 from typing import Callable, Optional
 
+from kubernetriks_tpu.batched.faults import (
+    FeederProducerError,
+    InjectedFeederKill,
+)
 from kubernetriks_tpu.telemetry import NULL_TRACER
 from kubernetriks_tpu.telemetry.tracer import (
     PH_STAGE_WAIT_FEEDER,
@@ -123,6 +127,15 @@ class StreamFeeder:
     - depth: ring capacity K (the memory bound); K = 1 degenerates to
       synchronous-but-off-thread staging and stays exact.
     - settle: H2D settle hook (tests inject a no-op for numpy slabs).
+    - retired_lo: retired-slab high-water mark carried over from a dead
+      predecessor — a SUPERVISOR restart (engine._restart_feeder) builds
+      the replacement feeder with the old feeder's mark so the
+      never-re-offer invariant spans restarts: the new ring starts empty
+      but still refuses every slab the old ring already served spent.
+    - chaos: optional `faults.HostChaos`; when armed, each produced slab
+      first draws the feeder-kill channel and a hit raises
+      `InjectedFeederKill` inside the producer thread (exercising the
+      whole death -> FeederProducerError -> supervisor path).
     """
 
     def __init__(
@@ -136,10 +149,13 @@ class StreamFeeder:
         trace_cols: int,
         depth: int = 3,
         settle: Optional[Callable[[object], None]] = _settle_default,
+        retired_lo: int = -1,
+        chaos=None,
     ) -> None:
         self._assemble = assemble
         self._upload = upload
         self._settle = settle
+        self._chaos = chaos
         self.width = int(width)
         self.window = int(window)
         self.depth = max(1, int(depth))
@@ -160,8 +176,9 @@ class StreamFeeder:
         self._next_lo = int(base)
         self._demand_lo = int(base)
         self._last_lo = -1  # highest slab lo ever published
-        self._retired_lo = -1  # highest explicitly-retired slab lo
+        self._retired_lo = int(retired_lo)  # highest explicitly-retired lo
         self._served_lo = -1  # last slab lo handed to the consumer
+        self._building_lo = -1  # slab the producer is currently building
         self._done = False  # producer published the final slab
         self._stop = False
         self._error: Optional[BaseException] = None
@@ -222,6 +239,15 @@ class StreamFeeder:
                             # non-streaming path's miss-rebuild point).
                             lo = self._demand_lo
                             self.demand_fastforwards += 1
+                    # Record what we are about to build so a death
+                    # mid-build surfaces with its slab context
+                    # (FeederProducerError.slab_lo).
+                    self._building_lo = lo
+                if self._chaos is not None and self._chaos.feeder_kill():
+                    raise InjectedFeederKill(
+                        f"host chaos: injected stream-feeder kill while "
+                        f"building slab lo={lo}"
+                    )
                 # Build OUTSIDE the lock: assembly + upload are the slow
                 # halves and must overlap the consumer's dispatches.
                 t0 = time.perf_counter_ns()
@@ -268,6 +294,30 @@ class StreamFeeder:
 
     # -- consumer (engine thread) ------------------------------------------
 
+    def _producer_error(self) -> FeederProducerError:
+        """Build the consumer-facing producer-death error with the slab
+        context carried across the thread boundary (call under the
+        lock): the slab index `lo` and payload span the producer was
+        building when it died."""
+        lo = self._building_lo  # ktpu: lock-ok(only called from get_stage while holding self._cond)
+        span = (
+            f"slab lo={lo} span=[{lo}, {lo + self.width})"
+            if lo >= 0
+            else "before the first slab"
+        )
+        return FeederProducerError(
+            f"stream feeder producer failed ({span}): {self._error!r}",  # ktpu: lock-ok(only called from get_stage while holding self._cond)
+            slab_lo=lo if lo >= 0 else None,
+            width=self.width,
+        )
+
+    def retired_watermark(self) -> int:
+        """Highest retired slab lo — the supervisor passes this as the
+        replacement feeder's `retired_lo` so never-re-offer survives a
+        restart."""
+        with self._cond:
+            return self._retired_lo
+
     def get_stage(self, base: int, tracer=NULL_TRACER):
         """Return (stage, lo, fresh) for the LARGEST-lo ring slab covering
         `base` (lo <= base and base - lo + W <= L; dominated predecessors
@@ -287,9 +337,7 @@ class StreamFeeder:
                 self._cond.notify_all()
             while True:
                 if self._error is not None:
-                    raise RuntimeError(
-                        "stream feeder producer failed"
-                    ) from self._error
+                    raise self._producer_error() from self._error
                 # Drop slabs that can no longer cover any base >= `base`,
                 # and DOMINATED slabs — a head whose successor also sits
                 # at or below the base serves strictly less headroom than
@@ -348,9 +396,7 @@ class StreamFeeder:
                 if self._error is not None:
                     # The settle failed — the event was set only so this
                     # wait could observe the failure, not a usable slab.
-                    raise RuntimeError(
-                        "stream feeder producer failed"
-                    ) from self._error
+                    raise self._producer_error() from self._error
             tracer.end(PH_STAGE_WAIT_UPLOAD, t_wait, dur=dur)
         return slot.stage, slot.lo, fresh
 

@@ -297,6 +297,42 @@ _FLAGS = [
         "and idle-lane waste at more dispatch overhead. Unset: 8.",
     ),
     Flag(
+        "KTPU_HOST_CHAOS",
+        "str",
+        None,
+        "Deterministic HOST-fault injection for the serving fleet "
+        "(batched/faults.py HostChaos): counter-seeded threefry draws "
+        "inject dispatch exceptions (victim lane cycles round-robin), "
+        "stream-feeder producer kills, and slow-lane stalls, so the "
+        "fault-domain machinery (typed QueryError results, lane_reset "
+        "crash recovery, quarantine, feeder supervisor) is provable in "
+        "CI. '1' selects the documented defaults "
+        "(seed=7,dispatch=0.04,feeder=0.05,stall=0.03,stall_ms=2.0); a "
+        "'k=v,...' spec overrides them. Unset: injection OFF — the fleet "
+        "runs the exact pre-chaos code path (per-query bit-identity and "
+        "dispatch_stats equality, gated in tests and bench).",
+    ),
+    Flag(
+        "KTPU_FLEET_QUEUE",
+        "int",
+        None,
+        "Bounded admission queue depth for ScenarioFleet.submit(): at "
+        "most this many queries may be QUEUED (in-flight lanes excluded). "
+        "A full queue applies the KTPU_FLEET_QUEUE_POLICY backpressure. "
+        "Unset: unbounded (the pre-fault-domain behavior).",
+    ),
+    Flag(
+        "KTPU_FLEET_QUEUE_POLICY",
+        "str",
+        "reject",
+        "Backpressure policy when the bounded admission queue is full: "
+        "'reject' streams a RejectedError (with a retry_after_s hint "
+        "derived from the observed service rate) through poll() for the "
+        "refused query; 'block' makes submit() pump the fleet inline "
+        "until a queue slot frees. Ignored while KTPU_FLEET_QUEUE is "
+        "unset.",
+    ),
+    Flag(
         "KTPU_SLO_MS",
         "int",
         None,

@@ -229,6 +229,13 @@ class Observatory:
         self._mem_high: Dict[str, int] = {}
         self._last_resources: Dict = {}
         self._last_stall_not_ready = 0
+        # Lane fault-domain gauge (PR 19): per-lane state strings pushed
+        # by the fleet at every transition ("active"/"idle"/
+        # "quarantined"/"probe"), plus cumulative quarantine counters —
+        # O(C) host strings, never device values.
+        self._lane_states: List[str] = []
+        self._quarantine_total = 0
+        self._readmit_total = 0
         self.events: List[Dict] = []
         self.fired: Dict[str, int] = {}
         self.samples = 0
@@ -653,6 +660,21 @@ class Observatory:
         """Current + high-water occupancy per gauge (cross-cluster worst),
         with capacity and fraction where a reserve exists."""
         out: Dict = {}
+        # Lane fault-domain gauge (PR 19): counts per state plus the
+        # cumulative quarantine counters. Numeric-only on purpose — the
+        # Prometheus exporter's generic occupancy flattener renders each
+        # entry as a gauge with zero export-side changes. Pushed by the
+        # fleet, so it is current even before the first ring drain.
+        if self._lane_states:
+            states = self._lane_states
+            out["lane_state"] = {
+                "active": states.count("active"),
+                "idle": states.count("idle"),
+                "quarantined": states.count("quarantined"),
+                "probe": states.count("probe"),
+                "quarantine_events": self._quarantine_total,
+                "readmissions": self._readmit_total,
+            }
         if not self._points:
             return out
         last = self._points[-1]
@@ -696,6 +718,55 @@ class Observatory:
             "min": round(float(fracs.min()), 4),
         }
         return out
+
+    # -- lane fault domain (lane-async fleet) -------------------------------
+
+    def note_lane_states(self, states: Sequence[str]) -> None:
+        """Record the fleet's per-lane state strings ("active"/"idle"/
+        "quarantined"/"probe") — pushed at every quarantine/probe/
+        re-admission transition so the `lane_state` occupancy gauge and
+        the Prometheus export stay current between ring drains."""
+        self._lane_states = [str(s) for s in states]
+
+    def note_lane_quarantined(
+        self, lane: int, *, backoff_rounds: int, probed: bool = False
+    ) -> Dict:
+        """Fire the `lane_quarantine` verdict: the fleet pulled a lane
+        out of the admission rotation after repeated dispatch faults
+        (`probed=True` = a probe dispatch failed and the backoff
+        doubled). Clears with hysteresis at re-admission
+        (note_lane_readmitted), like the reserve verdicts."""
+        self._quarantine_total += 1
+        verb = (
+            "failed its re-admission probe and was re-quarantined"
+            if probed
+            else "was quarantined after repeated dispatch faults"
+        )
+        return self._warn(
+            "lane_quarantine",
+            f"saturation watchdog: lane {lane} {verb}; probe "
+            f"re-admission in {backoff_rounds} pump rounds (exponential "
+            "backoff) — queries route around it; a lane that never "
+            "re-admits points at poisoned lane state, not weather",
+            lane=int(lane),
+            backoff_rounds=int(backoff_rounds),
+            probed=bool(probed),
+        )
+
+    def note_lane_readmitted(self, lane: int, *, probes: int = 1) -> Dict:
+        """Quarantine recovery: a probe dispatch drained cleanly and the
+        lane rejoined the rotation — the fired verdict clears and
+        re-arms (recover -> re-warn cycle, reserve-verdict semantics)."""
+        self._readmit_total += 1
+        self.fired.pop("lane_quarantine", None)
+        return self._event(
+            "lane_quarantine_recovered",
+            f"saturation watchdog: lane {lane} re-admitted after "
+            f"{probes} probe round(s) — quarantine cleared; the verdict "
+            "re-arms",
+            lane=int(lane),
+            probes=int(probes),
+        )
 
     # -- query latency (lane-async fleet) -----------------------------------
 
@@ -751,6 +822,7 @@ class Observatory:
                 "high_water": dict(self._mem_high),
             },
             "queries": self.query_stats(),
+            "lane_states": list(self._lane_states),
             "watchdog": {
                 "enabled": self.watchdog,
                 "fired": dict(self.fired),

@@ -64,6 +64,12 @@ PH_STAGE_WAIT_UPLOAD = 16
 # a submit->drain Chrome flow arrow per query.
 PH_QUERY_QUEUE = 17
 PH_QUERY_SERVICE = 18
+# Fault-domain phases (batched/fleet.py): a query's terminal failure
+# (span covers submit -> failure delivery, dur from host stamps) and a
+# lane's quarantine interval (span covers quarantine fire -> full
+# re-admission). Both host-stamped via end(phase, t0, dur=...).
+PH_QUERY_FAIL = 19
+PH_LANE_QUARANTINE = 20
 
 PHASE_NAMES = (
     "window_chunk",
@@ -85,6 +91,8 @@ PHASE_NAMES = (
     "stage_wait_upload",
     "query_queue",
     "query_service",
+    "query_fail",
+    "lane_quarantine",
 )
 
 _N_PHASES = len(PHASE_NAMES)

@@ -44,6 +44,12 @@ def _smoke_records(capsys, args):
             assert set(rec) == {"metric", "value", "unit", "open_loop"}
             assert rec["value"] > 0
             continue
+        if rec.get("unit") == "availability":
+            # The host-chaos line (DESIGN §15): availability over the
+            # injected phase + the full host_chaos block.
+            assert set(rec) == {"metric", "value", "unit", "host_chaos"}
+            assert 0.0 <= rec["value"] <= 1.0
+            continue
         assert set(rec) - {"spans", "telemetry", "endurance"} == {
             "metric", "value", "unit", "vs_baseline",
         }
@@ -253,3 +259,39 @@ def test_bench_smoke_faults_adds_chaos_line(capsys, tmp_path, monkeypatch):
     assert "telemetry" not in records[7]
     assert "open-loop lane-async fleet" in records[8]["metric"]
     assert "scenario-vector fleet" in records[9]["metric"]
+
+
+@pytest.mark.slow
+def test_bench_smoke_host_chaos_adds_availability_line(
+    capsys, tmp_path, monkeypatch
+):
+    """--host-chaos inserts the fault-tolerant-serving line (DESIGN §15)
+    AFTER the open-loop line (shared warm jit caches) and BEFORE the
+    sweep (which must stay LAST: its baseline clears the jit caches).
+    run_host_chaos's in-bench gates already ran — quiet-layer A/B
+    bit-identity + dispatch_stats equality, stream-once typed-error
+    delivery, availability >= 90% under the pinned-seed injector, every
+    lane faulted, quarantine fired AND re-admitted, zero post-warm-up
+    recompiles; pin the disclosure + the JSON artifact CI uploads. Slow
+    lane: the nine-line test covers the default contract (no flag = no
+    line); fault-path unit coverage lives in test_fleet_faults.py."""
+    monkeypatch.setenv("KTPU_SWEEP_PATH", str(tmp_path / "ktpu_sweep"))
+    records = _smoke_records(capsys, ["--smoke", "--host-chaos"])
+    assert len(records) == 10, records
+    assert "open-loop lane-async fleet" in records[7]["metric"]
+    assert "host-chaos" in records[8]["metric"]
+    assert "scenario-vector fleet" in records[9]["metric"]
+    hc = records[8]["host_chaos"]
+    assert hc["availability"] >= 0.90
+    assert hc["lanes"] == 4 and hc["victim_lanes"] == [0, 1, 2, 3]
+    assert hc["quarantine_events"] >= 1 and hc["readmissions"] >= 1
+    assert sum(hc["failed_by_kind"].values()) == hc["failed"]
+    assert hc["stream_once_audited"] == hc["submitted"]
+    assert hc["quiet_ab_identity_checked"] > 0
+    assert hc["quiet_dispatch_stats_equal"] is True
+    assert hc["recompiles_after_warmup"] == 0
+    assert hc["recompile_sentinel"]["post_warmup_events"] == 0
+    hc_doc = json.loads(
+        (tmp_path / "ktpu_sweep_hostchaos.json").read_text()
+    )
+    assert hc_doc == hc

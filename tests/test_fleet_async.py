@@ -366,4 +366,41 @@ def test_trace_mux_masks_and_never_reoffers():
     with pytest.raises(RuntimeError, match="fly|flight|active"):
         flying.engine.set_lane_trace(0, 0, E // 2)
     flying.close()
-    fleet.close()
+
+
+def test_async_every_qid_streams_exactly_one_terminal_outcome(
+    async_ab_runs,
+):
+    """The stream-once contract covers FAILURES too (the poll()
+    hang-forever fix): a mixed stream — one query doomed by an
+    already-expired deadline, one healthy — delivers exactly one
+    terminal outcome per qid through poll(), discriminated by the shared
+    `.ok`/`.kind` protocol, and a dead query never leaves its client
+    polling forever."""
+    from kubernetriks_tpu.batched.faults import (
+        DeadlineExceededError,
+        QueryError,
+    )
+
+    _, _, asy, qids, _, _, _ = async_ab_runs
+    reference = asy.results[qids[0]]
+    asy.poll()  # drain completions earlier gates may not have polled
+    q_dead = asy.submit(*SCENS[0], deadline_s=1e-9)  # expired on arrival
+    q_live = asy.submit(*SCENS[0])
+    asy.run_async()
+    outcomes = asy.poll()
+    assert sorted(o.query for o in outcomes) == sorted([q_dead, q_live])
+    by_qid = {o.query: o for o in outcomes}
+    dead, live = by_qid[q_dead], by_qid[q_live]
+    assert isinstance(dead, DeadlineExceededError)
+    assert isinstance(dead, QueryError)  # a real Exception subclass
+    assert (dead.ok, dead.kind) == (False, "deadline_exceeded")
+    assert dead.lane == -1, "deadline failure must never occupy a lane"
+    assert dead.late_s >= 0.0 and "deadline exceeded" in dead.message
+    assert (live.ok, live.kind) == (True, "result")
+    assert _same_result(live, reference)
+    # Streamed exactly once: the broadcast poll and the per-qid poll are
+    # both empty now, for the error exactly like for the result.
+    assert asy.poll() == []
+    assert asy.poll(q_dead) == [] and asy.poll(q_live) == []
+    assert asy.failed_queries.get("deadline_exceeded", 0) >= 1

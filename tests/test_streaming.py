@@ -507,3 +507,117 @@ def test_feeder_producer_error_propagates():
     with pytest.raises(RuntimeError, match="stream feeder producer failed"):
         f.get_stage(0)
     f.close()
+
+
+# --- fault domain: death context, chaos kills, supervised restart ---------
+
+
+def test_feeder_producer_error_carries_slab_context():
+    """Producer death crosses the thread boundary WITH its slab context:
+    the consumer-facing FeederProducerError names the slab index and
+    payload span the producer was building when it died, and chains the
+    original exception (DESIGN §15)."""
+    from kubernetriks_tpu.batched.faults import FeederProducerError
+
+    def assemble(lo, width):
+        if lo >= 96:
+            raise RuntimeError("disk on fire at lo=%d" % lo)
+        return {"lo": lo, "width": width}
+
+    f = StreamFeeder(
+        assemble,
+        lambda seg: ("slab", seg["lo"]),
+        base=0,
+        width=96,
+        window=64,
+        trace_cols=10_000,
+        depth=2,
+        settle=None,
+    )
+    _, lo0, _ = f.get_stage(0)
+    assert lo0 == 0
+    f.retire(0)
+    with pytest.raises(FeederProducerError) as exc_info:
+        f.get_stage(96)
+    err = exc_info.value
+    assert isinstance(err, RuntimeError)  # the pre-existing contract class
+    assert (err.slab_lo, err.width) == (96, 96)
+    assert "stream feeder producer failed" in str(err)
+    assert "slab lo=96 span=[96, 192)" in str(err)
+    assert "disk on fire" in str(err)
+    assert isinstance(err.__cause__, RuntimeError)
+    f.close()
+
+
+def test_feeder_chaos_kill_surfaces_with_slab_context():
+    """The KTPU_HOST_CHAOS feeder channel draws INSIDE the producer
+    thread: an injected kill surfaces to the consumer exactly like a real
+    producer death — typed, with the slab being built named."""
+    from kubernetriks_tpu.batched.faults import (
+        FeederProducerError,
+        HostChaos,
+        InjectedFeederKill,
+    )
+
+    f = _fake_feeder(
+        width=96, depth=2, chaos=HostChaos(seed=3, feeder_rate=1.0)
+    )
+    with pytest.raises(FeederProducerError) as exc_info:
+        f.get_stage(0)
+    err = exc_info.value
+    assert err.slab_lo == 0
+    assert "injected stream-feeder kill" in str(err)
+    assert isinstance(err.__cause__, InjectedFeederKill)
+    f.close()
+
+
+def test_feeder_retired_watermark_survives_restart():
+    """The supervisor's carry-over: a replacement feeder built with the
+    dead ring's retired-slab high-water mark keeps the never-re-offer
+    invariant across the restart — at/below the watermark asserts,
+    strictly past it serves."""
+    f = _fake_feeder(width=256, depth=2)
+    _, lo0, _ = f.get_stage(0)
+    f.retire(lo0)
+    assert f.retired_watermark() == lo0
+    f.close()
+    reoffer = _fake_feeder(width=256, depth=2, base=0, retired_lo=lo0)
+    with pytest.raises(AssertionError, match="retired"):
+        reoffer.get_stage(0)
+    reoffer.close()
+    onward = _fake_feeder(width=256, depth=2, base=160, retired_lo=lo0)
+    _, lo, fresh = onward.get_stage(160)
+    assert lo > lo0 and fresh
+    onward.close()
+
+
+class _KillNth:
+    """Duck-typed chaos for the supervisor test: kill exactly the Nth
+    slab-build attempts (deterministic, schedule-independent)."""
+
+    def __init__(self, kills):
+        self.kills = set(kills)
+        self.calls = 0
+
+    def feeder_kill(self):
+        self.calls += 1
+        return self.calls in self.kills
+
+
+def test_feeder_supervisor_restarts_and_preserves_bit_identity(ladder_ff):
+    """The engine's feeder supervisor: two injected producer deaths
+    mid-run each restart the feeder (backoff + retired-watermark carry) —
+    the run completes, bit-matches the resident ladder on every state
+    leaf and metric, and the restart count lands in
+    telemetry_report()['feeder']."""
+    sim = _stream_build()
+    kills = _KillNth({1, 3})
+    sim._feeder_chaos = kills  # before the first staged dispatch
+    _run(sim)
+    _assert_streamed(sim)
+    assert kills.calls >= 4, "the killed builds were never retried"
+    rep = sim.telemetry_report()["feeder"]
+    assert rep["restarts"] == 2
+    assert compare_states(strip_telemetry(sim.state), ladder_ff.state) == []
+    assert sim.metrics_summary() == ladder_ff.metrics_summary()
+    sim.close()
