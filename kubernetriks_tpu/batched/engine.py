@@ -64,7 +64,13 @@ from kubernetriks_tpu.batched.trace_compile import (
 )
 from kubernetriks_tpu.config import SimulationConfig
 from kubernetriks_tpu import sanitize
-from kubernetriks_tpu.flags import flag_bool, flag_int, flag_str, flag_tristate
+from kubernetriks_tpu.flags import (
+    flag_bool,
+    flag_int,
+    flag_set,
+    flag_str,
+    flag_tristate,
+)
 from kubernetriks_tpu.telemetry import (
     GaugeSeries,
     NULL_TRACER,
@@ -697,8 +703,8 @@ class BatchedSimulation:
         donate: Optional[bool] = None,
         fuse_slide: Optional[bool] = None,
         superspan: Optional[bool] = None,
-        superspan_k: int = 16,
-        superspan_chunk: int = 8,
+        superspan_k: Optional[int] = None,
+        superspan_chunk: Optional[int] = None,
         superspan_stage_cols: Optional[int] = None,
         stream: Optional[bool] = None,
         stream_depth: Optional[int] = None,
@@ -715,8 +721,29 @@ class BatchedSimulation:
         scheduler_profile=None,
         scenario=None,
         lane_async: bool = False,
+        tuned_profile=None,
     ) -> None:
         self.config = config
+        # Tuned-statics profile seam (PR 20, tune/): resolution order for
+        # the profile SOURCE is explicit arg > KTPU_TUNED_PROFILE (a
+        # path, or 1/auto resolving artifacts/tuned/ then the bundled
+        # tune/profiles/ dir by backend + geometry) > nothing; per KNOB
+        # the order stays explicit kwarg > the knob's own env flag >
+        # tuned-profile entry > hand-picked platform default, so a
+        # profile never overrides a value someone pinned by hand. An
+        # explicitly named profile raises on backend/geometry mismatch
+        # (naming the field); the n_nodes half of the key is re-checked
+        # after the statics build below, where N is finally known.
+        from kubernetriks_tpu.tune.profile import resolve_build_profile
+
+        self.tuned_profile = resolve_build_profile(
+            tuned_profile,
+            backend=jax.default_backend(),
+            n_clusters=len(compiled_traces),
+        )
+        _tuned = (
+            self.tuned_profile.statics if self.tuned_profile else {}
+        )
         # Scenario-vector fleet (batched/fleet.py): optional per-lane
         # override vectors for the autoscaler control-law parameters.
         # Validated + normalized to (C,) numpy arrays here; the statics
@@ -813,6 +840,8 @@ class BatchedSimulation:
             self.donate = bool(donate)
         else:
             env = flag_tristate("KTPU_DONATE")
+            if env is None:
+                env = _tuned.get("donate")
             self.donate = (
                 env if env is not None else jax.default_backend() != "cpu"
             )
@@ -829,6 +858,8 @@ class BatchedSimulation:
             self._fuse_slide = bool(fuse_slide)
         else:
             env = flag_tristate("KTPU_FUSED_SLIDE")
+            if env is None:
+                env = _tuned.get("fuse_slide")
             self._fuse_slide = (
                 env if env is not None else jax.default_backend() != "cpu"
             )
@@ -847,9 +878,17 @@ class BatchedSimulation:
             self._superspan = bool(superspan)
         else:
             env = flag_tristate("KTPU_SUPERSPAN")
+            if env is None:
+                env = _tuned.get("superspan")
             self._superspan = bool(
                 env if env is not None else jax.default_backend() != "cpu"
             )
+        if superspan_k is None:
+            superspan_k = _tuned.get("superspan_k", 16)
+        if superspan_chunk is None:
+            superspan_chunk = _tuned.get("superspan_chunk", 8)
+        if superspan_stage_cols is None:
+            superspan_stage_cols = _tuned.get("superspan_stage_cols")
         self._superspan_k = max(1, int(superspan_k))
         self._superspan_chunk = max(1, int(superspan_chunk))
         self._superspan_stage_cols = superspan_stage_cols
@@ -874,6 +913,8 @@ class BatchedSimulation:
                 )
         else:
             env = flag_tristate("KTPU_STREAM")
+            if env is None:
+                env = _tuned.get("stream")
             self._stream = (
                 bool(env if env is not None else jax.default_backend() != "cpu")
                 and self._superspan
@@ -889,10 +930,20 @@ class BatchedSimulation:
             # device-slide payload path.
             self._stream = False
         if stream_depth is None:
-            stream_depth = flag_int("KTPU_STREAM_DEPTH")
+            # KTPU_STREAM_DEPTH has a concrete registry default (3), so
+            # "flag unset" is checked explicitly — otherwise a tuned
+            # depth could never apply.
+            if flag_set("KTPU_STREAM_DEPTH"):
+                stream_depth = flag_int("KTPU_STREAM_DEPTH")
+            else:
+                stream_depth = _tuned.get(
+                    "stream_depth", flag_int("KTPU_STREAM_DEPTH")
+                )
         self._stream_depth = max(1, int(stream_depth))
         if stream_segment is None:
             stream_segment = flag_int("KTPU_STREAM_SEGMENT")
+        if stream_segment is None:
+            stream_segment = _tuned.get("stream_segment")
         self._stream_segment = (
             None if stream_segment is None else int(stream_segment)
         )
@@ -934,6 +985,8 @@ class BatchedSimulation:
             self.lane_major = bool(lane_major)
         else:
             env = flag_tristate("KTPU_LANE_MAJOR")
+            if env is None:
+                env = _tuned.get("lane_major")
             self.lane_major = bool(
                 env if env is not None else jax.default_backend() != "cpu"
             )
@@ -952,14 +1005,19 @@ class BatchedSimulation:
             self.window_razor = bool(window_razor)
         else:
             env = flag_tristate("KTPU_WINDOW_RAZOR")
+            if env is None:
+                env = _tuned.get("window_razor")
             self.window_razor = bool(
                 env if env is not None else jax.default_backend() != "cpu"
             )
-        self.ca_descatter = (
-            bool(ca_descatter)
-            if ca_descatter is not None
-            else flag_bool("KTPU_CA_DESCATTER")
-        )
+        if ca_descatter is not None:
+            self.ca_descatter = bool(ca_descatter)
+        elif flag_set("KTPU_CA_DESCATTER"):
+            self.ca_descatter = flag_bool("KTPU_CA_DESCATTER")
+        else:
+            self.ca_descatter = bool(
+                _tuned.get("ca_descatter", flag_bool("KTPU_CA_DESCATTER"))
+            )
         # CA slot reclaim (KTPU_RECLAIM / reclaim arg): a periodic
         # in-trace compaction returns fully-retired CA reserve slots, so
         # ca_cursor tracks LIVE occupancy and sustained churn never
@@ -981,7 +1039,12 @@ class BatchedSimulation:
         if self._reclaim_requested is None:
             self._reclaim_requested = flag_tristate("KTPU_RECLAIM")
         if reclaim_period is None:
-            reclaim_period = flag_int("KTPU_RECLAIM_PERIOD")
+            if flag_set("KTPU_RECLAIM_PERIOD"):
+                reclaim_period = flag_int("KTPU_RECLAIM_PERIOD")
+            else:
+                reclaim_period = _tuned.get(
+                    "reclaim_period", flag_int("KTPU_RECLAIM_PERIOD")
+                )
         self.reclaim_period = max(1, int(reclaim_period))
         self.reclaim = False
         # (lo, RefillStage) staging buffers for the superspan executor when
@@ -1394,6 +1457,12 @@ class BatchedSimulation:
         self.n_clusters = C
         self.n_nodes = node_cap_cpu.shape[1]
         self.n_pods = pod_req_cpu.shape[1]
+        # N is only known here (derived from the traces + CA reserve
+        # groups), so the tuned profile's node-axis key is re-checked
+        # post-build: strict (explicit) profiles raise GeometryMismatch,
+        # auto-resolved ones warn loudly and keep the applied statics.
+        if self.tuned_profile is not None:
+            self.tuned_profile.check_geometry(n_nodes=self.n_nodes)
         # Real (trace-defined) pod slots, before the 128-alignment padding
         # of the device axis — the count completion/terminal asserts want.
         self.n_real_pods = max((c.n_pods for c in compiled_traces), default=0)
@@ -3689,6 +3758,28 @@ class BatchedSimulation:
                     "(autoscale.decimal_string_key) — name-ordered "
                     "victim/walk selection is no longer exact past it"
                 )
+
+    def tuning_statics(self) -> Dict[str, object]:
+        """The RESOLVED values of every closed-domain tuning knob
+        (tune/knobs.py) this build compiled in — after the full per-knob
+        precedence (explicit kwarg > env flag > tuned profile > platform
+        default) played out. The autotuner's profile-roundtrip gates
+        compare this table across builds: a profile that 'loads back
+        build-identical' means equal tables here."""
+        # Every field below is a plain Python jit-static the constructor
+        # already normalised to bool/int — no array readout happens here.
+        return {
+            "superspan": self._superspan,
+            "fuse_slide": self._fuse_slide,
+            "superspan_k": int(self._superspan_k),
+            "superspan_chunk": int(self._superspan_chunk),
+            "lane_major": self.lane_major,
+            "window_razor": self.window_razor,
+            "ca_descatter": self.ca_descatter,
+            "donate": self.donate,
+            "stream": self._stream,
+            "stream_depth": int(self._stream_depth),
+        }
 
     def metrics_summary(self) -> Dict:  # ktpu: sync-ok(readout: one-shot cross-cluster metric reduction after the run)
         """Cross-cluster reduction into the scalar printer's shape. On a

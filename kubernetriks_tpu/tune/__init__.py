@@ -1,0 +1,63 @@
+"""Self-tuning statics: the measurement-driven autotuner that closes the
+telemetry -> configuration loop (ROADMAP #5, DESIGN SS16).
+
+Every performance-critical static the engine grew — the superspan
+executor and its K/chunk shape, the streaming-feeder ring depth, the
+lane-major / window-razor / CA-de-scatter program variants, buffer
+donation, the fused chunk+slide megastep — was A/B'd by hand once
+(BENCH_r07) and frozen into platform defaults. This package makes them
+SEARCHABLE instead:
+
+- `knobs.py`     — the declarative knob registry: name, legal values,
+                   which engine kwarg (jit-static) each knob feeds,
+                   whether changing it forces a recompile, and the
+                   activation predicates (`stream` rides `superspan`).
+- `measure.py`   — the pluggable measurement backend: the real bench
+                   protocol (median of >= 5 valid spans, zero-decision
+                   spans dropped, recompile sentinel armed per
+                   candidate, bit-identity asserted across the grid)
+                   and a pinned-measurements fake for tests and CI.
+- `search.py`    — deterministic, resumable staged coordinate descent
+                   over the registry, budgeted by KTPU_TUNE_BUDGET.
+- `profile.py`   — the per-hardware tuned-statics profile: a JSON table
+                   keyed by backend + geometry (artifacts/tuned/
+                   <backend>_<C>x<N>.json) recording the chosen config
+                   AND every measured candidate, loaded at engine/fleet
+                   build via KTPU_TUNED_PROFILE.
+
+Tuning changes statics only, never semantics: every candidate the
+search measures must reproduce the reference final state bit for bit
+(state.compare_states) with equal committed decisions — the same
+parity contract the hand A/Bs enforced. The objective is the
+observatory's readout (telemetry/observatory.tuning_objective): the
+per-window window-program cost line scaled by a penalty for fired
+stall/occupancy verdicts.
+
+This is cold-path host code: no hot-path pragma, no jit, no device
+work of its own (the measurement backend drives engines that do).
+"""
+
+from kubernetriks_tpu.tune.knobs import (  # noqa: F401
+    KNOBS,
+    Knob,
+    active_knobs,
+    knob_by_name,
+    validate_statics,
+)
+from kubernetriks_tpu.tune.measure import (  # noqa: F401
+    BenchMeasurementBackend,
+    FakeMeasurementBackend,
+    Measurement,
+)
+from kubernetriks_tpu.tune.profile import (  # noqa: F401
+    GeometryMismatch,
+    TunedProfile,
+    load_profile,
+    profile_path,
+    resolve_build_profile,
+    save_profile,
+)
+from kubernetriks_tpu.tune.search import (  # noqa: F401
+    TuneResult,
+    staged_coordinate_descent,
+)

@@ -1,0 +1,275 @@
+"""The declarative knob registry: every tunable performance static.
+
+A Knob names ONE engine build kwarg (a jit-static or host dispatch
+parameter that is bit-identity-safe by the repo's own parity gates),
+its legal candidate values, the stage the coordinate-descent sweep
+visits it in, whether changing it forces a recompile (so the search
+can disclose compile cost per candidate), and the activation
+predicates (`requires`) that keep the sweep off configurations the
+engine rejects (stream without the superspan executor) or where the
+knob is inert (superspan_k on a ladder engine).
+
+Closed-domain knobs (`values` is a tuple) are swept; open-domain knobs
+(`values is None`) are registered — profiles may carry them, the
+engine seam applies them, validation type-checks them — but the
+default sweep skips them (their useful range is geometry-specific:
+staging-slab widths scale with the pod window, not with a universal
+candidate list).
+
+Deliberately NOT knobs:
+- `reclaim` (the tristate): an explicit reclaim=True RAISES on traces
+  whose node-name classes interleave (engine build contract) — a
+  tuner candidate must never turn a measurement into a build error.
+  `reclaim_period` is registered open-domain for engines that already
+  reclaim.
+- fleet lane count / pod window: those are GEOMETRY — the profile is
+  keyed by them (backend_<C>x<N>), they are not searched within one
+  profile.
+
+Adding a knob (DESIGN SS16): add the engine kwarg with a None default
+and the explicit-arg > env-flag > tuned-profile > platform-default
+resolution, register it here with its legal values and `requires`,
+and the sweep, the profile schema, validation and the engine seam all
+pick it up — no other edits.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple, Optional, Tuple
+
+
+class Knob(NamedTuple):
+    name: str  # == the BatchedSimulation build kwarg it feeds
+    kind: str  # "bool" | "int" — value type in profiles
+    values: Optional[Tuple]  # legal sweep candidates; None = open domain
+    default: object  # the hand-picked value the sweep starts from
+    stage: str  # coordinate-descent stage (visited in registry order)
+    recompile: bool  # changing it forces an XLA recompile
+    requires: Tuple  # ((knob, value), ...) — active only when all hold
+    doc: str
+
+
+KNOBS: Tuple[Knob, ...] = (
+    # -- executor stage: which steady-state dispatch program runs --------
+    Knob(
+        "superspan",
+        "bool",
+        (False, True),
+        False,
+        "executor",
+        True,
+        (),
+        "Scanned multi-slide executor (one while_loop program retires up "
+        "to K slide-spans per dispatch) vs the ladder path.",
+    ),
+    Knob(
+        "fuse_slide",
+        "bool",
+        (False, True),
+        False,
+        "executor",
+        True,
+        (("superspan", False),),
+        "Fused chunk+slide megastep on the ladder path (inert under the "
+        "superspan executor, which slides in-program).",
+    ),
+    Knob(
+        "superspan_k",
+        "int",
+        (8, 16, 32),
+        16,
+        "executor",
+        True,
+        (("superspan", True),),
+        "Max slide-spans retired per superspan dispatch (the while_loop "
+        "trip bound; one progress readback amortizes over K spans).",
+    ),
+    Knob(
+        "superspan_chunk",
+        "int",
+        (4, 8, 16),
+        8,
+        "executor",
+        True,
+        (("superspan", True),),
+        "Window-chunk tile inside the superspan body (windows advanced "
+        "per inner iteration).",
+    ),
+    # -- layout stage: the PR 9 window-cost program variants -------------
+    Knob(
+        "lane_major",
+        "bool",
+        (False, True),
+        False,
+        "layout",
+        True,
+        (),
+        "Lane-major (N, C) hot node state inside window programs — kills "
+        "the per-kernel-boundary transposes on accelerator backends.",
+    ),
+    Knob(
+        "window_razor",
+        "bool",
+        (False, True),
+        False,
+        "layout",
+        True,
+        (),
+        "Empty-window identity branch: gate the per-window resolution "
+        "soup behind a cheap due-ness predicate.",
+    ),
+    Knob(
+        "ca_descatter",
+        "bool",
+        (False, True),
+        True,
+        "layout",
+        True,
+        (),
+        "CA scale-down shared 2-key sort (segment-sum + grouping in one "
+        "pass) — the BENCH_r07 -13.3% ms/window front.",
+    ),
+    # -- memory stage: buffer and staging policy -------------------------
+    Knob(
+        "donate",
+        "bool",
+        (False, True),
+        False,
+        "memory",
+        True,
+        (),
+        "Buffer donation for the steady-state dispatch loop (donated jit "
+        "variants consume the input state in place).",
+    ),
+    Knob(
+        "stream",
+        "bool",
+        (False, True),
+        False,
+        "memory",
+        True,
+        (("superspan", True),),
+        "Streaming trace-ingestion feeder ring (requires the superspan "
+        "executor; the engine raises otherwise, so the sweep never "
+        "visits that combination).",
+    ),
+    Knob(
+        "stream_depth",
+        "int",
+        (2, 3, 4),
+        3,
+        "memory",
+        False,
+        (("stream", True),),
+        "Feeder ring depth K: at most K staging slabs live on device at "
+        "once. Host-side staging policy — no recompile.",
+    ),
+    # -- open-domain knobs: registered, applied, validated, NOT swept ----
+    Knob(
+        "superspan_stage_cols",
+        "int",
+        None,
+        None,
+        "executor",
+        True,
+        (("superspan", True),),
+        "Staging-slab width (payload columns) of the superspan refill "
+        "stage. Geometry-specific; profiles may pin it, the default "
+        "sweep leaves the engine's clamp rule in charge.",
+    ),
+    Knob(
+        "stream_segment",
+        "int",
+        None,
+        None,
+        "memory",
+        True,
+        (("stream", True),),
+        "Staging-segment width of the streaming feeder's slabs (a jit "
+        "static). Geometry-specific, like superspan_stage_cols.",
+    ),
+    Knob(
+        "reclaim_period",
+        "int",
+        None,
+        1,
+        "memory",
+        True,
+        (),
+        "Reclaim compaction cadence in windows, for engines whose "
+        "reclaim tristate is already on (the knob never TURNS reclaim "
+        "on — see the module docstring).",
+    ),
+)
+
+_BY_NAME: Dict[str, Knob] = {k.name: k for k in KNOBS}
+
+STAGES: Tuple[str, ...] = tuple(dict.fromkeys(k.stage for k in KNOBS))
+
+
+def knob_by_name(name: str) -> Knob:
+    """The registered knob, or a ValueError NAMING the unknown field —
+    the error profile validation surfaces for stale/typo'd JSON."""
+    knob = _BY_NAME.get(name)
+    if knob is None:
+        raise ValueError(
+            f"unknown tuning knob {name!r} — not in the tune.knobs "
+            f"registry (known: {', '.join(sorted(_BY_NAME))})"
+        )
+    return knob
+
+
+def default_statics() -> Dict[str, object]:
+    """The hand-picked starting point of every sweep: each swept knob at
+    its registered default (open-domain knobs stay unset — the engine's
+    own clamp/flag rules keep deciding them)."""
+    return {k.name: k.default for k in KNOBS if k.values is not None}
+
+
+def validate_value(knob: Knob, value: object) -> None:
+    """Legality check for one (knob, value) pair, naming the field."""
+    if knob.values is not None:
+        if value not in knob.values:
+            raise ValueError(
+                f"tuning knob {knob.name!r}: value {value!r} is not in "
+                f"the registered legal set {knob.values!r}"
+            )
+        return
+    # Open domain: type-check only. None is always legal (= engine rule).
+    if value is None:
+        return
+    if knob.kind == "int" and not isinstance(value, bool) and isinstance(value, int):
+        return
+    if knob.kind == "bool" and isinstance(value, bool):
+        return
+    raise ValueError(
+        f"tuning knob {knob.name!r}: value {value!r} is not a valid "
+        f"{knob.kind} (open-domain knobs type-check against the "
+        "registry kind)"
+    )
+
+
+def validate_statics(statics: Dict[str, object]) -> Dict[str, object]:
+    """Validate a whole statics table (profile `statics`/candidate
+    entries): every key must be a registered knob, every value legal.
+    Returns the table unchanged so call sites can chain."""
+    for name, value in statics.items():
+        validate_value(knob_by_name(name), value)
+    return statics
+
+
+def is_active(knob: Knob, config: Dict[str, object]) -> bool:
+    """Whether the knob is live under `config` (its `requires` hold —
+    missing keys fall back to the required knob's registered default)."""
+    for dep, want in knob.requires:
+        have = config.get(dep, _BY_NAME[dep].default)
+        if have != want:
+            return False
+    return True
+
+
+def active_knobs(config: Dict[str, object]) -> Tuple[Knob, ...]:
+    """The swept knobs live under `config`, in registry (stage) order."""
+    return tuple(
+        k for k in KNOBS if k.values is not None and is_active(k, config)
+    )

@@ -1,0 +1,314 @@
+"""Pluggable measurement backends for the autotuner.
+
+The search (search.py) is backend-agnostic: it hands a fully-pinned
+statics table to `backend.measure(statics)` and gets a `Measurement`
+back. Two backends exist:
+
+- `BenchMeasurementBackend` — the real capture path: builds an engine
+  with the candidate statics on the caller's composed traces, runs the
+  bench protocol (warm-up + >= 5 valid timed spans, zero-decision
+  spans dropped and disclosed, in-measure asserts instead of silent
+  fallbacks), reads the observatory objective
+  (telemetry/observatory.tuning_objective: per-window window-program
+  cost scaled by fired stall/occupancy verdicts), and enforces the
+  statics-only contract PER CANDIDATE: the recompile sentinel is armed
+  across the measured spans (zero post-warm-up compiles), and every
+  candidate's final state must be bit-identical to the first
+  candidate's (state.compare_states) with equal committed decisions —
+  the whole-grid bit-identity gate.
+
+- `FakeMeasurementBackend` — pinned measurements for tests, smoke and
+  the CI tune-smoke job: a deterministic additive cost model (base
+  cost minus a per-knob/per-value bonus table), so tests can pin the
+  expected winner, resume behavior and budget accounting without
+  building engines.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import time
+from typing import Dict, List, NamedTuple, Optional
+
+from kubernetriks_tpu.tune.knobs import validate_statics
+
+
+class Measurement(NamedTuple):
+    objective: float  # the score the search minimizes (lower = better)
+    ms_per_window: float  # the raw per-window telemetry cost line
+    decisions_per_s: float  # median composed rate (disclosure)
+    spans: Dict[str, object]  # {n, min, max, dropped, spread_frac}
+    verdicts_fired: Dict[str, int]  # observatory watchdog verdicts
+    fingerprint: str  # semantic digest: final state + decisions
+    recompiles_after_warmup: int  # sentinel events past seal (must be 0)
+    wall_s: float  # capture cost (disclosure only — never an input
+    #               to the search, so resumed runs stay deterministic)
+
+    def as_record(self) -> Dict[str, object]:
+        return {
+            "objective": round(self.objective, 4),
+            "ms_per_window": round(self.ms_per_window, 4),
+            "decisions_per_s": round(self.decisions_per_s, 3),
+            "spans": self.spans,
+            "verdicts_fired": self.verdicts_fired,
+            "fingerprint": self.fingerprint,
+            "recompiles_after_warmup": self.recompiles_after_warmup,
+            "wall_s": round(self.wall_s, 3),
+        }
+
+
+def canonical_key(statics: Dict[str, object]) -> str:
+    """THE candidate identity: sorted-key JSON of the full statics
+    table. Resume caches, dedup and profile candidate matching all key
+    on this, so a reordered dict is the same candidate."""
+    return json.dumps(statics, sort_keys=True, default=str)
+
+
+class FakeMeasurementBackend:
+    """Deterministic pinned measurements: objective = base minus the
+    bonus table's entry for each (knob, value) in the candidate. Knobs
+    absent from the table contribute 0 — independent contributions, so
+    coordinate descent provably reaches the global optimum and tests
+    can pin the winner."""
+
+    def __init__(
+        self,
+        bonuses: Optional[Dict[str, Dict[object, float]]] = None,
+        base: float = 100.0,
+    ):
+        self.bonuses = bonuses or {}
+        self.base = float(base)
+        self.measure_calls: List[Dict[str, object]] = []
+
+    def measure(self, statics: Dict[str, object]) -> Measurement:
+        validate_statics(statics)
+        self.measure_calls.append(dict(statics))
+        cost = self.base
+        for name, value in statics.items():
+            table = self.bonuses.get(name)
+            if table:
+                cost -= float(table.get(value, 0.0))
+        assert cost > 0, (
+            f"fake measurement backend: bonus table drove the objective "
+            f"to {cost} <= 0 for {statics!r} — raise base"
+        )
+        return Measurement(
+            objective=cost,
+            ms_per_window=cost,
+            decisions_per_s=1e6 / cost,
+            spans={"n": 5, "min": 1, "max": 1, "dropped": 0,
+                   "spread_frac": 1.0},
+            verdicts_fired={},
+            # One constant fingerprint: the fake grid is trivially
+            # bit-identical, mirroring the real backend's contract.
+            fingerprint="fake:pinned",
+            recompiles_after_warmup=0,
+            wall_s=0.0,
+        )
+
+
+class BenchMeasurementBackend:
+    """Real capture: one engine build + bench-protocol measurement per
+    candidate on a fixed composed trace set.
+
+    The traces, geometry and shared build kwargs are pinned at
+    construction; `measure()` varies ONLY the candidate statics. The
+    first measured candidate becomes the bit-identity reference: every
+    later candidate must reproduce its final state exactly
+    (compare_states — the documented parity policy) with equal
+    committed decisions, or measure() raises. fast_forward is pinned
+    off so executor candidates actually dispatch the program they name
+    (the bench smoke lines' precedent)."""
+
+    def __init__(
+        self,
+        config,
+        cluster_events,
+        workload_events,
+        *,
+        n_clusters: int,
+        warm_until: float,
+        t_end: float,
+        step: float,
+        build_kwargs: Optional[Dict[str, object]] = None,
+        min_valid_spans: int = 5,
+    ):
+        self.config = config
+        self.cluster_events = cluster_events
+        self.workload_events = workload_events
+        self.n_clusters = int(n_clusters)
+        self.warm_until = float(warm_until)
+        self.t_end = float(t_end)
+        self.step = float(step)
+        self.build_kwargs = dict(build_kwargs or {})
+        self.min_valid_spans = int(min_valid_spans)
+        self.n_nodes: Optional[int] = None  # known after first build
+        self._reference = None  # (statics, final state, decisions)
+        self.measure_calls: List[Dict[str, object]] = []
+
+    def _decisions(self, sim) -> int:
+        import numpy as np
+
+        return int(
+            np.asarray(sim.state.metrics.scheduling_decisions).sum()
+        )
+
+    def measure(self, statics: Dict[str, object]) -> Measurement:
+        import numpy as np
+
+        from kubernetriks_tpu.batched.engine import (
+            build_batched_from_traces,
+        )
+        from kubernetriks_tpu.batched.state import compare_states
+        from kubernetriks_tpu.recompile import (
+            RecompileSentinel,
+            sentinel_mode,
+        )
+        from kubernetriks_tpu.telemetry.observatory import (
+            tuning_objective,
+        )
+
+        validate_statics(statics)
+        self.measure_calls.append(dict(statics))
+        wall_t0 = time.perf_counter()
+        # Per-candidate sentinel: any compile after the seal (engine
+        # build + warm-up + precompile) breaks the candidate — tuned
+        # statics must keep the compile-once contract the flag defaults
+        # keep. KTPU_EXPLAIN_RECOMPILES=0 force-disarms (the documented
+        # escape hatch), matching the bench in-line asserts.
+        sentinel = None
+        if sentinel_mode() is not False:
+            sentinel = RecompileSentinel("raise").install()
+        sim = build_batched_from_traces(
+            self.config,
+            self.cluster_events,
+            self.workload_events,
+            n_clusters=self.n_clusters,
+            telemetry=True,
+            fast_forward=False,
+            tuned_profile=False,  # candidates pin every knob explicitly
+            **statics,
+            **self.build_kwargs,
+        )
+        try:
+            self.n_nodes = sim.n_nodes
+            sim.step_until_time(self.warm_until)
+            # The pod window must SLIDE inside the warm-up
+            # (run_endurance's rule): the slide shift/apply programs
+            # compile on first use, so a first slide inside a timed
+            # span would land seconds of compile post-seal and trip
+            # the armed sentinel. The slide time is a function of the
+            # trace alone (semantics, identical across candidates), so
+            # every candidate extends by the same amount and the
+            # measured span sequence stays grid-uniform.
+            warm_end = self.warm_until
+            if sim.pod_window is not None:
+                while sim._pod_base == 0 and warm_end < self.t_end:
+                    warm_end += self.step
+                    sim.step_until_time(warm_end)
+                assert sim._pod_base != 0, (
+                    f"tune candidate {statics!r}: the pod window never "
+                    f"slid by t_end={self.t_end} — a later first slide "
+                    "would compile inside a timed span; enlarge the "
+                    "capture horizon or shrink pod_window"
+                )
+            sim.precompile_chunks()
+            if sentinel is not None:
+                sentinel.seal(f"tune candidate warm-up {statics!r}")
+            # The bench span protocol: >= min_valid timed spans, each
+            # decision fetch a real sync, zero-decision spans dropped
+            # and disclosed, re-arm past t_end up to +5 steps before
+            # failing loudly (bench.run_composed's r7 rule).
+            rates, span_decisions = [], []
+            end = warm_end + self.step
+            max_end = self.t_end + 5 * self.step
+            while end <= self.t_end or (
+                sum(1 for d in span_decisions if d > 0)
+                < self.min_valid_spans
+                and end <= max_end
+            ):
+                before = self._decisions(sim)
+                t0 = time.perf_counter()
+                sim.step_until_time(end)
+                decided = self._decisions(sim) - before
+                span_decisions.append(decided)
+                rates.append(decided / (time.perf_counter() - t0))
+                end += self.step
+            valid = [r for r, d in zip(rates, span_decisions) if d > 0]
+            dropped = len(rates) - len(valid)
+            assert len(valid) >= self.min_valid_spans, (
+                f"tune candidate {statics!r}: only {len(valid)} valid "
+                f"timed spans ({dropped} dropped as zero-decision) — "
+                "extend the capture horizon"
+            )
+            rep = sim.telemetry_report()
+            obj = tuning_objective(rep)
+            assert obj["ms_per_window"] > 0, (
+                f"tune candidate {statics!r}: telemetry report carries "
+                "no per-window cost line (no windows recorded?)"
+            )
+            recompiles = 0
+            if sentinel is not None:
+                sentinel.check(f"tune candidate {statics!r}")
+                recompiles = len(sentinel.post_seal_events())
+            decisions_total = self._decisions(sim)
+            # Whole-grid statics-only gate: bit-identical final state +
+            # equal committed decisions vs the first candidate.
+            if self._reference is None:
+                self._reference = (
+                    dict(statics),
+                    sim.state,
+                    decisions_total,
+                )
+            else:
+                ref_statics, ref_state, ref_decisions = self._reference
+                assert decisions_total == ref_decisions, (
+                    f"tune candidate {statics!r} committed "
+                    f"{decisions_total} decisions vs {ref_decisions} "
+                    f"for the reference {ref_statics!r} — a tuning "
+                    "knob changed SEMANTICS, not just statics"
+                )
+                bad = compare_states(ref_state, sim.state)
+                assert not bad, (
+                    f"tune candidate {statics!r} diverged from the "
+                    f"reference {ref_statics!r} final state: {bad} — "
+                    "a tuning knob changed SEMANTICS, not just statics"
+                )
+            digest = hashlib.sha1()
+            digest.update(str(decisions_total).encode())
+            for leaf in _state_leaves(sim.state):
+                digest.update(np.asarray(leaf).tobytes())
+            spread = (
+                round(max(valid) / min(valid), 3) if min(valid) else 0.0
+            )
+            return Measurement(
+                objective=float(obj["score"]),
+                ms_per_window=float(obj["ms_per_window"]),
+                decisions_per_s=float(np.median(valid)),
+                spans={
+                    "n": len(valid),
+                    "min": round(min(valid)),
+                    "max": round(max(valid)),
+                    "dropped": dropped,
+                    "spread_frac": spread,
+                },
+                verdicts_fired=dict(obj["verdicts_fired"]),
+                fingerprint=digest.hexdigest(),
+                recompiles_after_warmup=recompiles,
+                wall_s=time.perf_counter() - wall_t0,
+            )
+        finally:
+            if sentinel is not None:
+                sentinel.uninstall()
+            sim.close()
+
+
+def _state_leaves(state):
+    import jax
+
+    return [
+        leaf
+        for leaf in jax.tree_util.tree_leaves(state)
+        if hasattr(leaf, "dtype")
+    ]

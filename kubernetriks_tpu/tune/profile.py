@@ -1,0 +1,267 @@
+"""Per-hardware tuned-statics profiles: persistence + the build seam.
+
+A profile is a JSON table keyed by backend + geometry — the file name
+IS the key: `artifacts/tuned/<backend>_<C>x<N>.json` — recorded like a
+BENCH_*.json: the chosen statics, the objective they scored, the
+hand-picked baseline they were searched from, and EVERY measured
+candidate disclosed (so a profile is auditable and the search can
+RESUME from it: already-measured candidates are cache hits).
+
+Load seam (BatchedSimulation / ScenarioFleet build):
+
+    profile source:  explicit `tuned_profile` arg
+                   > KTPU_TUNED_PROFILE (a path, or 1/auto = resolve
+                     artifacts/tuned/ then the bundled
+                     kubernetriks_tpu/tune/profiles/ directory for the
+                     build's backend + geometry)
+                   > nothing (hand-picked statics, byte-for-byte the
+                     pre-tuner build)
+    per-knob value:  explicit build kwarg
+                   > the knob's own env flag (KTPU_LANE_MAJOR, ...)
+                   > the loaded profile's statics entry
+                   > the hand-picked platform default
+
+Mismatch policy: an EXPLICITLY loaded profile (arg, or a flag naming a
+path) raises on backend/geometry mismatch, naming the field — you
+asked for that exact file, silently ignoring it would be the
+silent-fallback bug class this repo kills everywhere. Auto-resolved
+profiles only ever match by construction (the file name is the key);
+the engine re-checks n_nodes AFTER the statics build (N is derived
+from the traces + CA groups) and warns LOUDLY on drift, leaving the
+already-applied statics in place and disclosing them.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import warnings
+from typing import Dict, NamedTuple, Optional, Sequence
+
+from kubernetriks_tpu.tune.knobs import validate_statics
+
+SCHEMA_VERSION = 1
+PROFILE_KIND = "ktpu-tuned-profile"
+
+# Where `bench.py --tune` lands profiles (relative to the working
+# directory) and where auto-resolution looks first.
+ARTIFACT_DIR = os.path.join("artifacts", "tuned")
+
+# Profiles bundled with the package (kubernetriks_tpu/tune/profiles/):
+# the lowest-priority source in the auto-resolution chain.
+BUNDLED_DIR = os.path.join(os.path.dirname(__file__), "profiles")
+
+# KTPU_TUNED_PROFILE values that mean "resolve by geometry" rather than
+# naming a file.
+_AUTO_VALUES = frozenset({"1", "auto", "true", "on"})
+
+
+class GeometryMismatch(ValueError):
+    """An explicitly loaded profile does not match the build, naming
+    the mismatched field."""
+
+
+class TunedProfile(NamedTuple):
+    backend: str
+    n_clusters: int
+    n_nodes: int
+    statics: Dict[str, object]
+    doc: Dict[str, object]  # the full JSON document (candidates etc.)
+    source: str  # path it was loaded from, or "<dict>"
+    explicit: bool  # explicitly requested (arg / flag path) -> strict
+
+    def describe(self) -> str:
+        return (
+            f"{self.backend}_{self.n_clusters}x{self.n_nodes} "
+            f"({self.source})"
+        )
+
+    def check_geometry(
+        self,
+        *,
+        backend: Optional[str] = None,
+        n_clusters: Optional[int] = None,
+        n_nodes: Optional[int] = None,
+    ) -> None:
+        """Compare the profile key against the build, field by field.
+        Explicit profiles RAISE GeometryMismatch naming the field;
+        auto-resolved ones warn loudly and keep going (the statics are
+        still bit-identity-safe — only their tuning provenance is for a
+        different shape)."""
+        checks = (
+            ("backend", self.backend, backend),
+            ("geometry.n_clusters", self.n_clusters, n_clusters),
+            ("geometry.n_nodes", self.n_nodes, n_nodes),
+        )
+        for field, have, want in checks:
+            if want is None or have == want:
+                continue
+            msg = (
+                f"tuned profile {self.describe()}: {field} is {have!r} "
+                f"but this build is {want!r} — the profile was tuned "
+                "for different hardware/geometry"
+            )
+            if self.explicit:
+                raise GeometryMismatch(msg)
+            warnings.warn(
+                msg + "; applying its statics anyway (bit-identity is "
+                "guaranteed, the tuning provenance is not)",
+                RuntimeWarning,
+                stacklevel=3,
+            )
+
+
+def profile_path(
+    backend: str, n_clusters: int, n_nodes: int, root: str = ARTIFACT_DIR
+) -> str:
+    """The canonical on-disk key: <root>/<backend>_<C>x<N>.json."""
+    return os.path.join(root, f"{backend}_{n_clusters}x{n_nodes}.json")
+
+
+def save_profile(doc: Dict[str, object], path: str) -> str:
+    """Validate + write a profile document (creating directories);
+    returns the path. The document must already carry the full record
+    — this is persistence, not authoring (search.py authors)."""
+    _validate_doc(doc, path)
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    with open(path, "w") as fh:
+        json.dump(doc, fh, indent=2)
+        fh.write("\n")
+    return path
+
+
+def _validate_doc(doc: Dict[str, object], source: str) -> None:
+    if doc.get("kind") != PROFILE_KIND:
+        raise ValueError(
+            f"tuned profile {source}: 'kind' is {doc.get('kind')!r}, "
+            f"expected {PROFILE_KIND!r}"
+        )
+    if doc.get("schema") != SCHEMA_VERSION:
+        raise ValueError(
+            f"tuned profile {source}: 'schema' is {doc.get('schema')!r}, "
+            f"this build reads version {SCHEMA_VERSION}"
+        )
+    geo = doc.get("geometry")
+    if not isinstance(geo, dict) or not {
+        "n_clusters",
+        "n_nodes",
+    } <= set(geo):
+        raise ValueError(
+            f"tuned profile {source}: 'geometry' must carry n_clusters "
+            f"and n_nodes, got {geo!r}"
+        )
+    if not isinstance(doc.get("backend"), str):
+        raise ValueError(
+            f"tuned profile {source}: 'backend' must be a string, got "
+            f"{doc.get('backend')!r}"
+        )
+    statics = doc.get("statics")
+    if not isinstance(statics, dict):
+        raise ValueError(
+            f"tuned profile {source}: 'statics' must be a table, got "
+            f"{statics!r}"
+        )
+    # Unknown knobs and illegal values raise here, naming the field —
+    # a stale profile from a renamed knob fails at load, not by
+    # silently dropping the entry.
+    validate_statics(statics)
+
+
+def _from_doc(
+    doc: Dict[str, object], source: str, explicit: bool
+) -> TunedProfile:
+    _validate_doc(doc, source)
+    geo = doc["geometry"]
+    return TunedProfile(
+        backend=str(doc["backend"]),
+        n_clusters=int(geo["n_clusters"]),
+        n_nodes=int(geo["n_nodes"]),
+        statics=dict(doc["statics"]),
+        doc=doc,
+        source=source,
+        explicit=explicit,
+    )
+
+
+def load_profile(path: str, explicit: bool = True) -> TunedProfile:
+    """Load + validate one profile file. Raises (naming the path and
+    the offending field) on unknown knobs, illegal values, or a
+    malformed document — never a silent partial load."""
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    return _from_doc(doc, path, explicit)
+
+
+def _auto_candidates(
+    backend: str, n_clusters: int
+) -> Sequence[str]:
+    """Auto-resolution search list for KTPU_TUNED_PROFILE=1/auto: every
+    <backend>_<C>x*.json under artifacts/tuned/ then the bundled dir
+    (N is unknown until the statics build; a unique C-match loads and
+    the post-build N check warns on drift)."""
+    out = []
+    prefix = f"{backend}_{n_clusters}x"
+    for root in (ARTIFACT_DIR, BUNDLED_DIR):
+        if not os.path.isdir(root):
+            continue
+        for name in sorted(os.listdir(root)):
+            if name.startswith(prefix) and name.endswith(".json"):
+                out.append(os.path.join(root, name))
+    return out
+
+
+def resolve_build_profile(
+    tuned_profile,
+    *,
+    backend: str,
+    n_clusters: int,
+) -> Optional[TunedProfile]:
+    """The engine-build seam (called from BatchedSimulation.__init__).
+
+    `tuned_profile` — the explicit build arg: a TunedProfile, a profile
+    dict, a path, False (= profile loading OFF even under the flag), or
+    None (= consult KTPU_TUNED_PROFILE). Explicit sources are strict:
+    load failures and backend/C mismatches raise, naming the field.
+    Flag-auto sources are best-effort: no match resolves to None (the
+    hand-picked statics) — quietly, because unset-flag builds must stay
+    byte-for-byte the pre-tuner build and auto is the documented
+    "use one if you have one" mode."""
+    from kubernetriks_tpu.flags import flag_str
+
+    if tuned_profile is False:
+        return None
+    explicit = tuned_profile is not None
+    path: Optional[str] = None
+    if isinstance(tuned_profile, TunedProfile):
+        prof = tuned_profile
+    elif isinstance(tuned_profile, dict):
+        prof = _from_doc(tuned_profile, "<dict>", explicit=True)
+    elif isinstance(tuned_profile, str):
+        path = tuned_profile
+        prof = None
+    elif tuned_profile is None:
+        raw = flag_str("KTPU_TUNED_PROFILE")
+        if raw is None:
+            return None
+        if raw.strip().lower() in _AUTO_VALUES:
+            candidates = _auto_candidates(backend, n_clusters)
+            if not candidates:
+                return None
+            prof, path = None, candidates[0]
+        else:
+            # A flag naming a concrete path is as explicit as an arg:
+            # a missing/stale file raises instead of silently running
+            # the untuned statics the user thought they replaced.
+            prof, path, explicit = None, raw, True
+    else:
+        raise TypeError(
+            "tuned_profile must be a TunedProfile, a profile dict, a "
+            f"path, False or None — got {type(tuned_profile).__name__}"
+        )
+    if prof is None:
+        prof = load_profile(path, explicit=explicit)
+    prof = prof._replace(explicit=explicit)
+    prof.check_geometry(backend=backend, n_clusters=n_clusters)
+    return prof
